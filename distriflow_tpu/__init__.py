@@ -1,0 +1,39 @@
+"""distriflow_tpu — a TPU-native distributed training framework.
+
+Brand-new JAX/XLA/pjit/pallas re-design with the capabilities of
+Christopher-Wang/DistriFlow (a data-parallel distributed training framework
+for TensorFlow.js; reference mounted at /root/reference):
+
+- three training modes: synchronous gradient-mean SGD, asynchronous SGD with
+  *real* bounded staleness (promised in the reference README but never
+  implemented there), and federated averaging (local epochs + periodic
+  weight allreduce);
+- a versioned model store with checkpoint/resume and a ``current`` pointer;
+- an ack/redelivery batch-dispatch dataset;
+- server/client host-coordination APIs mirroring the reference's
+  DistriServer/DistriWorker concepts, with an asyncio binary transport
+  replacing socket.io;
+- a first-class parallel layer: device meshes, XLA collectives over ICI,
+  dp/tp/sp/pp/ep shardings, ring attention for long context;
+- Pallas TPU kernels for the hot fused ops.
+
+The public API is one flat namespace, as in the reference
+(``src/index.ts:1-3`` re-exports client|common|server).
+"""
+
+__version__ = "0.1.0"
+
+from distriflow_tpu.utils import *  # noqa: F401,F403
+
+# Subpackage re-exports are appended here as layers land (models, parallel,
+# data, checkpoint, train, server, client, comm, ops). Keeping imports lazy
+# during the build avoids hard failures from in-progress layers.
+import importlib.util as _ilu
+
+for _mod in ("models", "parallel", "data", "checkpoint", "train", "server", "client", "comm"):
+    if _ilu.find_spec(f"distriflow_tpu.{_mod}") is None:
+        continue  # layer not built yet; real import errors inside a layer still propagate
+    _m = __import__(f"distriflow_tpu.{_mod}", fromlist=["*"])
+    _names = getattr(_m, "__all__", [])
+    globals().update({_n: getattr(_m, _n) for _n in _names})
+del _mod, _ilu

@@ -1,0 +1,196 @@
+"""Strict-key hyperparameter/config system.
+
+TPU-native re-design of the reference's typed option objects with defaults and
+unknown-key-rejecting ``override()`` (cf. reference ``src/common/utils.ts:157-234``).
+Semantics preserved:
+
+- every subsystem has a typed config with explicit defaults,
+- ``override(defaults, overrides)`` merges and raises on unrecognized keys,
+- three-level client hyperparameter precedence (local > server-pushed > defaults)
+  is implemented by :func:`resolve` in ``distriflow_tpu/client/abstract_client.py``.
+
+New (promised in the reference README but unimplemented there, cf.
+``README.md:27``): ``maximum_staleness`` is a first-class server hyperparameter
+enforced by the async-SGD trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping, Optional, Sequence, Type, TypeVar
+
+T = TypeVar("T")
+
+
+class UnknownConfigKeyError(KeyError):
+    """Raised when an override references a key the config does not define."""
+
+
+def override(defaults: Mapping[str, Any], overrides: Optional[Mapping[str, Any]]) -> Dict[str, Any]:
+    """Merge ``overrides`` into ``defaults``, rejecting unknown keys.
+
+    Mirrors reference ``src/common/utils.ts:206-218`` (which throws on
+    unrecognized keys) as a plain-dict utility. Dataclass configs below use
+    :func:`make_config`, which routes through this.
+    """
+    merged = dict(defaults)
+    if overrides:
+        for key, value in overrides.items():
+            if key not in defaults:
+                raise UnknownConfigKeyError(
+                    f"unrecognized config key {key!r}; valid keys: {sorted(defaults)}"
+                )
+            if value is not None:
+                merged[key] = value
+    return merged
+
+
+def make_config(cls: Type[T], overrides: Optional[Mapping[str, Any]] = None, **kw: Any) -> T:
+    """Build a dataclass config from defaults + overrides with strict keys."""
+    if not dataclasses.is_dataclass(cls):
+        raise TypeError(f"{cls!r} is not a dataclass config")
+    defaults = {f.name: getattr(cls(), f.name) for f in dataclasses.fields(cls)}
+    merged = override(defaults, {**(overrides or {}), **kw})
+    return cls(**merged)
+
+
+def asdict(cfg: Any) -> Dict[str, Any]:
+    """Dataclass config -> plain dict (wire-friendly; used by DownloadMsg)."""
+    return dataclasses.asdict(cfg)
+
+
+@dataclass
+class ClientHyperparams:
+    """Client-side training hyperparameters.
+
+    Defaults mirror reference ``src/common/utils.ts:181-186``
+    (``{batchSize:32, learningRate:.001, epochs:5, examplesPerUpdate:5}``).
+    """
+
+    batch_size: int = 32
+    learning_rate: float = 0.001
+    epochs: int = 5
+    examples_per_update: int = 5
+
+    def validate(self) -> "ClientHyperparams":
+        if self.batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {self.batch_size}")
+        if self.learning_rate <= 0:
+            raise ValueError(f"learning_rate must be positive, got {self.learning_rate}")
+        if self.epochs <= 0:
+            raise ValueError(f"epochs must be positive, got {self.epochs}")
+        if self.examples_per_update <= 0:
+            raise ValueError(
+                f"examples_per_update must be positive, got {self.examples_per_update}"
+            )
+        return self
+
+
+@dataclass
+class ServerHyperparams:
+    """Server-side aggregation hyperparameters.
+
+    Defaults mirror reference ``src/common/utils.ts:188-191``
+    (``{aggregation:'mean', minUpdatesPerVersion:20}``), plus the
+    README-promised-but-unimplemented bounded staleness knob
+    (``maximum_staleness``; reference ``README.md:27``). ``staleness_decay``
+    optionally down-weights stale-but-accepted gradients instead of a hard
+    accept/reject cliff.
+    """
+
+    aggregation: str = "mean"
+    min_updates_per_version: int = 20
+    maximum_staleness: int = 0
+    staleness_decay: float = 1.0
+
+    def validate(self) -> "ServerHyperparams":
+        if self.aggregation not in ("mean", "sum"):
+            raise ValueError(f"aggregation must be 'mean' or 'sum', got {self.aggregation!r}")
+        if self.min_updates_per_version <= 0:
+            raise ValueError(
+                f"min_updates_per_version must be positive, got {self.min_updates_per_version}"
+            )
+        if self.maximum_staleness < 0:
+            raise ValueError(f"maximum_staleness must be >= 0, got {self.maximum_staleness}")
+        if not 0.0 < self.staleness_decay <= 1.0:
+            raise ValueError(f"staleness_decay must be in (0, 1], got {self.staleness_decay}")
+        return self
+
+
+@dataclass
+class DatasetConfig:
+    """Dataset sharding config (reference ``src/common/utils.ts:193-197``).
+
+    Unlike the reference — which accepts ``smallLastBatch`` but never honors it
+    and silently over-runs the final slice (``src/server/dataset.ts:69-85``) —
+    ``small_last_batch`` here actually controls whether a final partial batch
+    is emitted (True) or dropped (False).
+    """
+
+    batch_size: int = 32
+    epochs: int = 5
+    small_last_batch: bool = False
+    shuffle: bool = False
+    seed: int = 0
+
+    def validate(self) -> "DatasetConfig":
+        if self.batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {self.batch_size}")
+        if self.epochs <= 0:
+            raise ValueError(f"epochs must be positive, got {self.epochs}")
+        return self
+
+
+@dataclass
+class CompileConfig:
+    """Model compile arguments (reference ``src/common/utils.ts:199-203``).
+
+    The reference hardcodes loss to softmax cross-entropy in ``fit`` regardless
+    of this config (bug, ``src/common/models.ts:139``); here ``loss`` is honored
+    everywhere via the loss registry (``distriflow_tpu/models/losses.py``).
+    """
+
+    loss: str = "softmax_cross_entropy"
+    metrics: Sequence[str] = field(default_factory=lambda: ("accuracy",))
+    optimizer: str = "sgd"
+
+
+@dataclass
+class MeshConfig:
+    """Device-mesh layout for the parallel layer (no reference equivalent —
+    the reference is hub-and-spoke over websockets, ``src/test/package.json:24``).
+
+    Axis sizes of 1 are always legal; the product of axis sizes must equal the
+    number of devices used. ``data`` is the DP axis; ``model`` is TP; ``seq``
+    is SP (ring attention); ``pipe`` is PP; ``expert`` is EP.
+    """
+
+    data: int = 1
+    model: int = 1
+    seq: int = 1
+    pipe: int = 1
+    expert: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.data * self.model * self.seq * self.pipe * self.expert
+
+
+DEFAULT_CLIENT_HYPERPARAMS = ClientHyperparams()
+DEFAULT_SERVER_HYPERPARAMS = ServerHyperparams()
+DEFAULT_DATASET_CONFIG = DatasetConfig()
+
+
+def client_hyperparams(overrides: Optional[Mapping[str, Any]] = None) -> ClientHyperparams:
+    """Validated client hyperparams (reference ``src/common/utils.ts:220-227``)."""
+    return make_config(ClientHyperparams, overrides).validate()
+
+
+def server_hyperparams(overrides: Optional[Mapping[str, Any]] = None) -> ServerHyperparams:
+    """Validated server hyperparams (reference ``src/common/utils.ts:229-234``)."""
+    return make_config(ServerHyperparams, overrides).validate()
+
+
+def dataset_config(overrides: Optional[Mapping[str, Any]] = None) -> DatasetConfig:
+    return make_config(DatasetConfig, overrides).validate()

@@ -1,0 +1,133 @@
+"""Wire protocol message schema.
+
+Re-design of the reference's two-event protocol and message types
+(``src/common/utils.ts:109-155``): ``Events.Download``/``Events.Upload``,
+``ModelMsg``/``GradientMsg`` ``{version, vars}``, ``DataMsg``, ``UploadMsg``,
+``DownloadMsg``. On TPU these survive only at the host-coordination edge
+(async dispatch, multi-process federated mode); the sync-SGD path never
+serializes gradients — aggregation is an in-graph psum.
+
+Messages encode to/from plain dicts of JSON-able values + packed tensor
+buffers (``distriflow_tpu.utils.serialization.pack_bytes``), framed by
+``distriflow_tpu.comm.transport``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from distriflow_tpu.utils.serialization import (
+    SerializedArray,
+    pack_bytes,
+    unpack_bytes,
+)
+
+
+class Events(str, enum.Enum):
+    """Protocol events (reference ``src/common/utils.ts:115-118``)."""
+
+    Download = "downloadVars"
+    Upload = "uploadVars"
+    Connect = "connect"
+    Disconnect = "disconnect"
+
+
+@dataclass
+class ModelMsg:
+    """Versioned weights (reference ``ModelMsg {version, vars}``, ``utils.ts:120-123``)."""
+
+    version: str
+    vars: Dict[str, SerializedArray]
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {"version": self.version, "vars": pack_bytes(self.vars)}
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any]) -> "ModelMsg":
+        return ModelMsg(version=d["version"], vars=unpack_bytes(d["vars"]))
+
+
+# A gradient message has the same shape as a model message: version it was
+# computed against + serialized tensors (reference ``utils.ts:125-128``).
+GradientMsg = ModelMsg
+
+
+@dataclass
+class DataMsg:
+    """A dispatched batch (reference ``DataMsg {batch, epoch, x, y}``, ``utils.ts:130-135``)."""
+
+    batch: int
+    epoch: int
+    x: SerializedArray
+    y: SerializedArray
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "batch": self.batch,
+            "epoch": self.epoch,
+            "xy": pack_bytes({"x": self.x, "y": self.y}),
+        }
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any]) -> "DataMsg":
+        xy = unpack_bytes(d["xy"])
+        return DataMsg(batch=d["batch"], epoch=d["epoch"], x=xy["x"], y=xy["y"])
+
+
+@dataclass
+class UploadMsg:
+    """Client -> server (reference ``UploadMsg``, ``utils.ts:144-149``)."""
+
+    client_id: str
+    gradients: Optional[GradientMsg] = None
+    batch: Optional[int] = None
+    metrics: Optional[List[float]] = None
+
+    def to_wire(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"client_id": self.client_id}
+        if self.gradients is not None:
+            d["gradients"] = self.gradients.to_wire()
+        if self.batch is not None:
+            d["batch"] = self.batch
+        if self.metrics is not None:
+            d["metrics"] = list(self.metrics)
+        return d
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any]) -> "UploadMsg":
+        return UploadMsg(
+            client_id=d["client_id"],
+            gradients=ModelMsg.from_wire(d["gradients"]) if "gradients" in d else None,
+            batch=d.get("batch"),
+            metrics=d.get("metrics"),
+        )
+
+
+@dataclass
+class DownloadMsg:
+    """Server -> client (reference ``DownloadMsg``, ``utils.ts:151-155``).
+
+    ``hyperparams`` carries server-pushed client hyperparameters (the server
+    can centrally set them for every client, reference
+    ``src/server/abstract_server.ts:87``).
+    """
+
+    model: ModelMsg
+    hyperparams: Dict[str, Any] = field(default_factory=dict)
+    data: Optional[DataMsg] = None
+
+    def to_wire(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"model": self.model.to_wire(), "hyperparams": dict(self.hyperparams)}
+        if self.data is not None:
+            d["data"] = self.data.to_wire()
+        return d
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any]) -> "DownloadMsg":
+        return DownloadMsg(
+            model=ModelMsg.from_wire(d["model"]),
+            hyperparams=d.get("hyperparams", {}),
+            data=DataMsg.from_wire(d["data"]) if d.get("data") is not None else None,
+        )

@@ -1,0 +1,225 @@
+"""Tensor wire/storage serialization.
+
+TPU-native re-design of the reference's ``SerializedVariable`` machinery
+(``src/common/utils.ts:7-101``): a dtype/shape/bytes triple per array, a
+byte-level stack for N-client aggregation prep (``stackSerialized``,
+``src/common/utils.ts:53-75``), and a packed flat format for whole pytrees
+(cf. ``flatSerialize``/``flatDeserialize``, reference ``src/server/models.ts:236-267``).
+
+Two deliberate departures from the reference:
+
+- Gradient <-> variable correspondence in the reference is *positional*
+  (insertion order of a JS object, ``src/common/models.ts:140``). Here
+  everything is keyed by pytree path, so structure is explicit and
+  round-trips are safe under any ordering.
+- On TPU the sync-SGD hot path never touches this module: gradients stay
+  device-resident and aggregate via XLA collectives. Serialization survives
+  only at the host-coordination edge (checkpoints, the async/federated wire,
+  multi-process startup).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+# dtype canonicalization: the wire format stores numpy dtype names.
+# (reference maps dtype -> TypedArray ctor at src/common/utils.ts:13-17)
+_SUPPORTED_DTYPES = {
+    "float32",
+    "float16",
+    "bfloat16",
+    "float64",
+    "int32",
+    "int16",
+    "int8",
+    "uint8",
+    "int64",
+    "bool",
+}
+
+
+@dataclass(frozen=True)
+class SerializedArray:
+    """One array on the wire: dtype name, shape, raw bytes.
+
+    Mirrors reference ``SerializedVariable {dtype, shape, data}``
+    (``src/common/utils.ts:7-11``).
+    """
+
+    dtype: str
+    shape: Tuple[int, ...]
+    data: bytes
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.data)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def serialize_array(x: Any) -> SerializedArray:
+    """Array (jax or numpy) -> SerializedArray (host copy).
+
+    The reference copies the typed-array view out of its backing buffer
+    (``src/common/utils.ts:32-37``); ``np.asarray(...).tobytes()`` is the
+    equivalent defensive copy (also forces TPU->host readback for jax arrays).
+    """
+    arr = np.asarray(x)
+    name = arr.dtype.name
+    if name == "bool_":
+        name = "bool"
+    if name not in _SUPPORTED_DTYPES:
+        raise TypeError(f"unsupported dtype for serialization: {arr.dtype}")
+    return SerializedArray(dtype=name, shape=tuple(arr.shape), data=arr.tobytes())
+
+
+def deserialize_array(s: SerializedArray) -> np.ndarray:
+    """SerializedArray -> numpy array (reference ``deserializeVar``, ``utils.ts:77-84``)."""
+    return np.frombuffer(s.data, dtype=_np_dtype(s.dtype)).reshape(s.shape).copy()
+
+
+def serialize_tree(tree: Any) -> Dict[str, SerializedArray]:
+    """Pytree of arrays -> {path: SerializedArray}, keyed not positional."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {jax.tree_util.keystr(path): serialize_array(leaf) for path, leaf in flat}
+
+
+def deserialize_tree(serialized: Dict[str, SerializedArray], like: Any) -> Any:
+    """{path: SerializedArray} -> pytree with the structure of ``like``."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for path, _ in flat:
+        key = jax.tree_util.keystr(path)
+        if key not in serialized:
+            raise KeyError(f"serialized tree missing leaf {key!r}")
+        leaves.append(deserialize_array(serialized[key]))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def stack_serialized(updates: Sequence[Dict[str, SerializedArray]]) -> Dict[str, SerializedArray]:
+    """Stack N clients' serialized trees into one tree with leading dim N.
+
+    Aggregation prep: after this, the server's mean is a single ``mean(axis=0)``
+    per leaf (reference ``stackSerialized``, ``src/common/utils.ts:53-75``,
+    consumed by ``federated_server.ts:98-106``). The byte-level concat is kept:
+    buffers are joined without an intermediate decode.
+    """
+    if not updates:
+        raise ValueError("stack_serialized needs at least one update")
+    keys = list(updates[0].keys())
+    keyset = set(keys)
+    for i, u in enumerate(updates[1:], start=1):
+        if set(u.keys()) != keyset:
+            raise ValueError(f"update {i} has mismatched leaves vs update 0")
+    out: Dict[str, SerializedArray] = {}
+    n = len(updates)
+    for key in keys:
+        first = updates[0][key]
+        for u in updates[1:]:
+            s = u[key]
+            if s.dtype != first.dtype or s.shape != first.shape:
+                raise ValueError(
+                    f"leaf {key!r} mismatch: {s.dtype}{s.shape} vs {first.dtype}{first.shape}"
+                )
+        out[key] = SerializedArray(
+            dtype=first.dtype,
+            shape=(n,) + first.shape,
+            data=b"".join(u[key].data for u in updates),
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Packed flat binary format: one data blob + one JSON meta table.
+# Parity with reference flatSerialize/flatDeserialize (src/server/models.ts:236-267),
+# which packs all variables into a single data.bin + meta.json with
+# shapes/dtypes/byteOffsets. Used by the checkpoint store and the wire protocol.
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"DFTP"  # DistriFlow-TPU packed format
+_VERSION = 1
+
+
+def flat_serialize(serialized: Dict[str, SerializedArray]) -> Tuple[bytes, Dict[str, Any]]:
+    """{path: SerializedArray} -> (packed data blob, meta dict)."""
+    meta: Dict[str, Any] = {"format": "dftp-flat", "version": _VERSION, "leaves": []}
+    chunks: List[bytes] = []
+    offset = 0
+    for key in sorted(serialized):
+        s = serialized[key]
+        meta["leaves"].append(
+            {
+                "name": key,
+                "dtype": s.dtype,
+                "shape": list(s.shape),
+                "byte_offset": offset,
+                "nbytes": s.nbytes,
+            }
+        )
+        chunks.append(s.data)
+        offset += s.nbytes
+    return b"".join(chunks), meta
+
+
+def flat_deserialize(data: bytes, meta: Dict[str, Any]) -> Dict[str, SerializedArray]:
+    """(packed blob, meta dict) -> {path: SerializedArray}."""
+    if meta.get("format") != "dftp-flat":
+        raise ValueError(f"not a dftp-flat blob: {meta.get('format')!r}")
+    out: Dict[str, SerializedArray] = {}
+    for leaf in meta["leaves"]:
+        start = leaf["byte_offset"]
+        end = start + leaf["nbytes"]
+        out[leaf["name"]] = SerializedArray(
+            dtype=leaf["dtype"], shape=tuple(leaf["shape"]), data=data[start:end]
+        )
+    return out
+
+
+def pack_bytes(serialized: Dict[str, SerializedArray]) -> bytes:
+    """Self-describing single-buffer encoding: MAGIC | meta_len | meta_json | blob.
+
+    This is the on-the-wire representation used by ``distriflow_tpu.comm`` —
+    the role socket.io's binary ArrayBuffer mode plays in the reference
+    (``src/common/utils.ts:86-101``).
+    """
+    blob, meta = flat_serialize(serialized)
+    meta_json = json.dumps(meta, separators=(",", ":")).encode("utf-8")
+    return _MAGIC + struct.pack("<I", len(meta_json)) + meta_json + blob
+
+
+def unpack_bytes(buf: bytes) -> Dict[str, SerializedArray]:
+    """Inverse of :func:`pack_bytes`."""
+    if len(buf) < 8 or buf[:4] != _MAGIC:
+        raise ValueError("bad magic: not a dftp packed buffer")
+    (meta_len,) = struct.unpack_from("<I", buf, 4)
+    if len(buf) < 8 + meta_len:
+        raise ValueError(f"truncated dftp buffer: {len(buf)} bytes, meta needs {8 + meta_len}")
+    meta = json.loads(buf[8 : 8 + meta_len].decode("utf-8"))
+    blob = buf[8 + meta_len :]
+    expected = sum(leaf["nbytes"] for leaf in meta.get("leaves", []))
+    if len(blob) < expected:
+        raise ValueError(f"truncated dftp buffer: blob has {len(blob)} bytes, meta declares {expected}")
+    return flat_deserialize(blob, meta)
+
+
+def tree_to_bytes(tree: Any) -> bytes:
+    """Pytree -> single self-describing buffer."""
+    return pack_bytes(serialize_tree(tree))
+
+
+def tree_from_bytes(buf: bytes, like: Any) -> Any:
+    """Single buffer -> pytree with the structure of ``like``."""
+    return deserialize_tree(unpack_bytes(buf), like)

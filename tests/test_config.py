@@ -1,0 +1,95 @@
+"""Config system tests: defaults, strict-key override, validation.
+
+Covers the reference semantics at ``src/common/utils.ts:157-234`` (defaults,
+throw-on-unknown-key override, validators) plus the new bounded-staleness knob.
+"""
+
+import pytest
+
+from distriflow_tpu.utils.config import (
+    ClientHyperparams,
+    DatasetConfig,
+    MeshConfig,
+    ServerHyperparams,
+    UnknownConfigKeyError,
+    client_hyperparams,
+    dataset_config,
+    make_config,
+    override,
+    server_hyperparams,
+)
+
+
+def test_client_defaults():
+    hp = client_hyperparams()
+    assert hp.batch_size == 32
+    assert hp.learning_rate == pytest.approx(0.001)
+    assert hp.epochs == 5
+    assert hp.examples_per_update == 5
+
+
+def test_server_defaults():
+    hp = server_hyperparams()
+    assert hp.aggregation == "mean"
+    assert hp.min_updates_per_version == 20
+    assert hp.maximum_staleness == 0
+    assert hp.staleness_decay == 1.0
+
+
+def test_override_merges_and_rejects_unknown():
+    merged = override({"a": 1, "b": 2}, {"b": 3})
+    assert merged == {"a": 1, "b": 3}
+    with pytest.raises(UnknownConfigKeyError):
+        override({"a": 1}, {"zz": 9})
+
+
+def test_override_none_values_keep_defaults():
+    assert override({"a": 1}, {"a": None}) == {"a": 1}
+
+
+def test_make_config_strict():
+    hp = make_config(ClientHyperparams, {"batch_size": 64})
+    assert hp.batch_size == 64 and hp.epochs == 5
+    with pytest.raises(UnknownConfigKeyError):
+        make_config(ClientHyperparams, {"batchSize": 64})  # camelCase is not a key
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        {"batch_size": 0},
+        {"learning_rate": -1.0},
+        {"epochs": 0},
+        {"examples_per_update": -5},
+    ],
+)
+def test_client_validation(bad):
+    with pytest.raises(ValueError):
+        client_hyperparams(bad)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        {"aggregation": "median"},
+        {"min_updates_per_version": 0},
+        {"maximum_staleness": -1},
+        {"staleness_decay": 0.0},
+        {"staleness_decay": 1.5},
+    ],
+)
+def test_server_validation(bad):
+    with pytest.raises(ValueError):
+        server_hyperparams(bad)
+
+
+def test_dataset_config():
+    cfg = dataset_config({"batch_size": 8, "small_last_batch": True})
+    assert cfg.batch_size == 8 and cfg.small_last_batch
+    with pytest.raises(ValueError):
+        dataset_config({"epochs": 0})
+
+
+def test_mesh_config_size():
+    assert MeshConfig().size == 1
+    assert MeshConfig(data=2, model=2, seq=2).size == 8

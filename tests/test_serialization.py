@@ -1,0 +1,124 @@
+"""Serialization round-trip tests.
+
+Mirrors the reference's unit tier (``src/test/serialization_test.ts``):
+float32/bool/int32 round-trips and stack shapes/dtypes, extended to pytrees,
+the packed flat format, and the self-describing wire buffer.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distriflow_tpu.utils.serialization import (
+    SerializedArray,
+    deserialize_array,
+    deserialize_tree,
+    flat_deserialize,
+    flat_serialize,
+    pack_bytes,
+    serialize_array,
+    serialize_tree,
+    stack_serialized,
+    tree_from_bytes,
+    tree_to_bytes,
+    unpack_bytes,
+)
+
+
+@pytest.mark.parametrize(
+    "arr",
+    [
+        np.array([[1.5, -2.25], [0.0, 3.5]], dtype=np.float32),
+        np.array([True, False, True]),
+        np.array([1, -2, 3], dtype=np.int32),
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.float32(7.0),  # scalar
+    ],
+)
+def test_array_roundtrip(arr):
+    s = serialize_array(arr)
+    out = deserialize_array(s)
+    np.testing.assert_array_equal(out, np.asarray(arr))
+    assert out.dtype == np.asarray(arr).dtype
+    assert out.shape == np.asarray(arr).shape
+
+
+def test_jax_array_roundtrip():
+    x = jnp.linspace(0, 1, 16, dtype=jnp.float32).reshape(4, 4)
+    out = deserialize_array(serialize_array(x))
+    np.testing.assert_allclose(out, np.asarray(x))
+
+
+def test_bfloat16_roundtrip():
+    x = jnp.ones((2, 3), dtype=jnp.bfloat16) * 1.5
+    s = serialize_array(x)
+    assert s.dtype == "bfloat16"
+    out = deserialize_array(s)
+    np.testing.assert_array_equal(np.asarray(out, np.float32), np.asarray(x, np.float32))
+
+
+def test_tree_roundtrip_keyed_not_positional():
+    tree = {
+        "dense1": {"w": np.ones((3, 2), np.float32), "b": np.zeros((2,), np.float32)},
+        "dense2": {"w": np.full((2, 5), 2.0, np.float32), "b": np.arange(5, dtype=np.float32)},
+    }
+    ser = serialize_tree(tree)
+    # keys are pytree paths, so ordering cannot matter
+    shuffled = dict(reversed(list(ser.items())))
+    out = deserialize_tree(shuffled, tree)
+    for k in tree:
+        for k2 in tree[k]:
+            np.testing.assert_array_equal(out[k][k2], tree[k][k2])
+
+
+def test_stack_serialized_shapes():
+    # N clients, each with two weights -> stacked leading dim N
+    # (reference serialization_test.ts:24-49)
+    n = 4
+    updates = []
+    for i in range(n):
+        tree = {"w": np.full((2, 3), float(i), np.float32), "b": np.array([i], np.int32)}
+        updates.append(serialize_tree(tree))
+    stacked = stack_serialized(updates)
+    for key, s in stacked.items():
+        assert s.shape[0] == n
+    w_key = [k for k in stacked if "w" in k][0]
+    w = deserialize_array(stacked[w_key])
+    assert w.shape == (n, 2, 3)
+    np.testing.assert_array_equal(w.mean(axis=0), np.full((2, 3), np.mean(range(n)), np.float32))
+
+
+def test_stack_serialized_mismatch_raises():
+    a = serialize_tree({"w": np.ones((2,), np.float32)})
+    b = serialize_tree({"w": np.ones((3,), np.float32)})
+    with pytest.raises(ValueError):
+        stack_serialized([a, b])
+    c = serialize_tree({"v": np.ones((2,), np.float32)})
+    with pytest.raises(ValueError):
+        stack_serialized([a, c])
+
+
+def test_flat_format_roundtrip():
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.array([True, False])}
+    ser = serialize_tree(tree)
+    blob, meta = flat_serialize(ser)
+    assert meta["format"] == "dftp-flat"
+    out = flat_deserialize(blob, meta)
+    assert set(out) == set(ser)
+    for k in ser:
+        np.testing.assert_array_equal(deserialize_array(out[k]), deserialize_array(ser[k]))
+
+
+def test_pack_unpack_bytes_roundtrip():
+    tree = {"layer": {"w": np.random.RandomState(0).randn(4, 4).astype(np.float32)}}
+    buf = tree_to_bytes(tree)
+    assert isinstance(buf, bytes)
+    out = tree_from_bytes(buf, tree)
+    np.testing.assert_array_equal(out["layer"]["w"], tree["layer"]["w"])
+    with pytest.raises(ValueError):
+        unpack_bytes(b"XXXX" + buf[4:])
+
+
+def test_unsupported_dtype_raises():
+    with pytest.raises(TypeError):
+        serialize_array(np.array(["a", "b"]))
