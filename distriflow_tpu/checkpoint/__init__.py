@@ -1,0 +1,49 @@
+"""Checkpoint layer: versioned pytree store + model-level save/load."""
+
+from typing import Any, Optional
+
+from distriflow_tpu.checkpoint.store import CheckpointStore
+
+
+def save_model(store: CheckpointStore, model: Any, version: Optional[str] = None) -> str:
+    """Checkpoint a DistributedModel's params, recording its spec name so
+    :func:`load_model` can rebuild the architecture from the zoo registry."""
+    spec_name = getattr(getattr(model, "spec", None), "name", None)
+    return store.save(
+        model.get_params(), version=version, extra_meta={"spec_name": spec_name}
+    )
+
+
+def load_model(save_dir: str, spec: Any = None, version: Optional[str] = None, **kw: Any):
+    """Rebuild a SpecModel from a checkpoint directory.
+
+    If ``spec`` is not given, the checkpoint's recorded spec name is resolved
+    against the model zoo (``distriflow_tpu.models.zoo``) — the analog of the
+    reference loading a saved LayersModel topology (``src/server/models.ts:140-150``).
+    """
+    from distriflow_tpu.models import zoo
+    from distriflow_tpu.models.base import ModelSpec, SpecModel
+
+    store = CheckpointStore(save_dir)
+    version = version or store.last()
+    if version is None:
+        raise FileNotFoundError(f"no checkpoints under {save_dir}")
+    if spec is None:
+        name = store.meta(version).get("spec_name")
+        factory = getattr(zoo, name, None) if name else None
+        if factory is None:
+            raise ValueError(
+                f"checkpoint {version} has no resolvable spec name ({name!r}); "
+                "pass spec= explicitly"
+            )
+        spec = factory()
+    if not isinstance(spec, ModelSpec):
+        raise TypeError(f"spec must be a ModelSpec, got {type(spec)}")
+    model = SpecModel(spec, **kw)
+    model.setup()
+    template = model.get_params()
+    model.set_params(store.load(version, template))
+    return model
+
+
+__all__ = ["CheckpointStore", "save_model", "load_model"]

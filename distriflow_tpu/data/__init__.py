@@ -1,0 +1,5 @@
+"""Data layer: batch dispatch with ack/requeue, dataset loaders."""
+
+from distriflow_tpu.data.dataset import Batch, DistributedDataset, batch_to_data_msg
+
+__all__ = ["Batch", "DistributedDataset", "batch_to_data_msg"]

@@ -1,0 +1,42 @@
+"""Model layer: functional specs, stateful parity wrappers, losses, zoo."""
+
+from distriflow_tpu.models.base import (
+    DistributedModel,
+    ModelSpec,
+    SpecModel,
+    fetch_model,
+)
+from distriflow_tpu.models.dynamic import DistributedDynamicModel
+from distriflow_tpu.models.flax_model import DistributedFlaxModel, spec_from_flax
+from distriflow_tpu.models.losses import (
+    LOSSES,
+    METRICS,
+    accuracy,
+    get_loss,
+    get_metric,
+    register_loss,
+    softmax_cross_entropy,
+)
+from distriflow_tpu.models.zoo import MLP, ConvNet, cifar_convnet, mnist_convnet, mnist_mlp
+
+__all__ = [
+    "DistributedModel",
+    "ModelSpec",
+    "SpecModel",
+    "fetch_model",
+    "DistributedDynamicModel",
+    "DistributedFlaxModel",
+    "spec_from_flax",
+    "LOSSES",
+    "METRICS",
+    "accuracy",
+    "get_loss",
+    "get_metric",
+    "register_loss",
+    "softmax_cross_entropy",
+    "MLP",
+    "ConvNet",
+    "cifar_convnet",
+    "mnist_convnet",
+    "mnist_mlp",
+]

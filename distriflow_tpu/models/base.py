@@ -1,0 +1,269 @@
+"""Core model abstraction.
+
+TPU-native re-design of the reference's ``DistributedModel`` interface
+(``src/common/models.ts:7-72``): ``fit(x,y)->grads``, ``update(grads)``,
+``predict``, ``evaluate``, ``get_params``/``set_params``, ``input_shape``/
+``output_shape``.
+
+Two levels, by design:
+
+- :class:`ModelSpec` — the *functional* core trainers consume: pure
+  ``init``/``apply``/``loss`` functions over a params pytree. This is the
+  idiomatic JAX shape (everything jit-able, params explicit); the reference
+  has no equivalent because tfjs models are inherently stateful.
+- :class:`DistributedModel` — the *stateful parity API* matching the
+  reference's surface, built on a ModelSpec. Gradient<->param correspondence
+  is by pytree structure, making explicit the positional invariant the
+  reference leaves implicit (``src/common/models.ts:140``, key-order vs
+  trainableWeights order).
+
+``fit`` computes gradients but does NOT apply them — the reference's
+contract (client computes, server applies; ``src/common/models.ts:137-142``).
+``update`` applies the optimizer step (plain SGD ``v <- v - lr*g`` by
+default, ``src/common/models.ts:128-135``).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distriflow_tpu.models import losses as losses_lib
+from distriflow_tpu.utils.config import CompileConfig
+
+Params = Any  # a pytree of arrays
+Batch = Tuple[jnp.ndarray, jnp.ndarray]
+
+
+def _optimizer(name: str, learning_rate: float) -> optax.GradientTransformation:
+    """Optimizer registry. The reference hardcodes 'sgd' (``models.ts:88``);
+    here sgd is the parity default and the registry is open via optax."""
+    registry: Dict[str, Callable[[float], optax.GradientTransformation]] = {
+        "sgd": optax.sgd,
+        "momentum": lambda lr: optax.sgd(lr, momentum=0.9),
+        "adam": optax.adam,
+        "adamw": optax.adamw,
+        "rmsprop": optax.rmsprop,
+        "adagrad": optax.adagrad,
+    }
+    if name not in registry:
+        raise KeyError(f"unknown optimizer {name!r}; registered: {sorted(registry)}")
+    return registry[name](learning_rate)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Pure-functional model: the unit trainers, servers, and clients share.
+
+    ``apply(params, x)`` returns predictions/logits. ``loss`` is a registry
+    name resolved through ``distriflow_tpu.models.losses`` (fixing the
+    reference bug where the configured loss was ignored,
+    ``src/common/models.ts:139``).
+    """
+
+    init: Callable[[jax.Array], Params]  # rng -> params
+    apply: Callable[[Params, jnp.ndarray], jnp.ndarray]
+    loss: str = "softmax_cross_entropy"
+    input_shape: Tuple[int, ...] = ()
+    output_shape: Tuple[int, ...] = ()
+    name: str = "model"
+
+    def loss_fn(
+        self,
+        params: Params,
+        x: jnp.ndarray,
+        y: jnp.ndarray,
+        weight: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """Weighted-mean loss; ``weight`` (per-example, 0 for padding rows)
+        makes padded partial batches exact on a sharded mesh."""
+        return losses_lib.get_loss(self.loss)(self.apply(params, x), y, weight)
+
+    def grad_fn(self) -> Callable[..., Tuple[jnp.ndarray, Params]]:
+        """(params, x, y[, weight]) -> (loss, grads). Jit-compiled by callers."""
+        return jax.value_and_grad(self.loss_fn)
+
+    def metrics_fn(self, metric_names: Sequence[str]) -> Callable[..., List[jnp.ndarray]]:
+        loss = losses_lib.get_loss(self.loss)
+
+        def compute(
+            params: Params,
+            x: jnp.ndarray,
+            y: jnp.ndarray,
+            weight: Optional[jnp.ndarray] = None,
+        ) -> List[jnp.ndarray]:
+            preds = self.apply(params, x)
+            out = []
+            for m in metric_names:
+                if m == "loss":
+                    out.append(loss(preds, y, weight))
+                else:
+                    out.append(losses_lib.get_metric(m)(preds, y, weight))
+            return out
+
+        return compute
+
+
+class DistributedModel(abc.ABC):
+    """Stateful parity surface (reference ``DistributedModel``,
+    ``src/common/models.ts:7-72``)."""
+
+    @abc.abstractmethod
+    def fit(self, x: jnp.ndarray, y: jnp.ndarray) -> Params:
+        """Compute gradients on a batch WITHOUT applying them."""
+
+    @abc.abstractmethod
+    def update(self, grads: Params) -> None:
+        """Apply one optimizer step with the given gradients."""
+
+    @abc.abstractmethod
+    def predict(self, x: jnp.ndarray) -> jnp.ndarray:
+        ...
+
+    @abc.abstractmethod
+    def evaluate(self, x: jnp.ndarray, y: jnp.ndarray) -> List[float]:
+        ...
+
+    @abc.abstractmethod
+    def get_params(self) -> Params:
+        ...
+
+    @abc.abstractmethod
+    def set_params(self, params: Params) -> None:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def input_shape(self) -> Tuple[int, ...]:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def output_shape(self) -> Tuple[int, ...]:
+        ...
+
+    def setup(self) -> None:
+        """Async-init hook (reference ``fetchInitial``); default no-op."""
+
+
+class SpecModel(DistributedModel):
+    """DistributedModel over a ModelSpec + resident params.
+
+    The common concrete implementation behind both the 'layers-model' (C2)
+    and 'dynamic' (C3) wrappers. All compute paths are jit-compiled once and
+    cached; params live on device.
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        compile_config: Optional[CompileConfig] = None,
+        learning_rate: float = 0.001,
+        params: Optional[Params] = None,
+        rng: Optional[jax.Array] = None,
+    ):
+        self.spec = spec
+        self.compile_config = compile_config or CompileConfig()
+        if self.compile_config.loss is not None and self.compile_config.loss != spec.loss:
+            # honor an explicitly-configured loss over the spec default (the
+            # reference silently ignored it; src/common/models.ts:139)
+            self.spec = dataclasses.replace(spec, loss=self.compile_config.loss)
+        self.learning_rate = learning_rate
+        self._params = params
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._optimizer = _optimizer(self.compile_config.optimizer, learning_rate)
+        self._opt_state = None
+        # jit caches
+        self._jit_grad = jax.jit(self.spec.grad_fn())
+        self._jit_apply = jax.jit(self.spec.apply)
+        self._jit_metrics = jax.jit(self.spec.metrics_fn(["loss", *self.compile_config.metrics]))
+
+        def _apply_update(params: Params, opt_state: Any, grads: Params):
+            updates, new_opt_state = self._optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt_state
+
+        self._jit_update = jax.jit(_apply_update)
+        self.last_loss: Optional[float] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def setup(self) -> None:
+        if self._params is None:
+            self._params = self.spec.init(self._rng)
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init(self._params)
+
+    def _ensure_setup(self) -> None:
+        if self._params is None or self._opt_state is None:
+            self.setup()
+
+    # -- DistributedModel surface -----------------------------------------
+
+    def fit(self, x: jnp.ndarray, y: jnp.ndarray) -> Params:
+        self._ensure_setup()
+        loss, grads = self._jit_grad(self._params, x, y)
+        self.last_loss = float(loss)
+        return grads
+
+    def update(self, grads: Params) -> None:
+        self._ensure_setup()
+        self._params, self._opt_state = self._jit_update(self._params, self._opt_state, grads)
+
+    def predict(self, x: jnp.ndarray) -> jnp.ndarray:
+        self._ensure_setup()
+        return self._jit_apply(self._params, x)
+
+    def evaluate(self, x: jnp.ndarray, y: jnp.ndarray) -> List[float]:
+        self._ensure_setup()
+        return [float(v) for v in self._jit_metrics(self._params, x, y)]
+
+    def get_params(self) -> Params:
+        self._ensure_setup()
+        return self._params
+
+    def set_params(self, params: Params) -> None:
+        self._params = jax.tree.map(jnp.asarray, params)
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init(self._params)
+
+    @property
+    def input_shape(self) -> Tuple[int, ...]:
+        return tuple(self.spec.input_shape)
+
+    @property
+    def output_shape(self) -> Tuple[int, ...]:
+        return tuple(self.spec.output_shape)
+
+
+ModelSource = Union[ModelSpec, DistributedModel, Callable[[], "ModelSpec"], str]
+
+
+def fetch_model(source: ModelSource, **kw: Any) -> DistributedModel:
+    """Resolve a model source to a DistributedModel.
+
+    Parity with reference ``fetchModel`` (``src/common/utils.ts:236-244``),
+    which accepts a string URL, a model instance, or an async factory. Here:
+    a ModelSpec, an existing DistributedModel, a zero-arg factory returning a
+    ModelSpec, or a checkpoint-directory path string (loaded via
+    ``distriflow_tpu.checkpoint``).
+    """
+    if isinstance(source, DistributedModel):
+        return source
+    if isinstance(source, ModelSpec):
+        return SpecModel(source, **kw)
+    if callable(source):
+        spec = source()
+        if not isinstance(spec, ModelSpec):
+            raise TypeError(f"model factory must return a ModelSpec, got {type(spec)}")
+        return SpecModel(spec, **kw)
+    if isinstance(source, str):
+        from distriflow_tpu.checkpoint import load_model  # lazy: layer dependency
+
+        return load_model(source, **kw)
+    raise TypeError(f"cannot resolve model source of type {type(source)}")

@@ -1,0 +1,49 @@
+"""Hand-rolled 'dynamic' model wrapper.
+
+Re-design of the reference's ``DistributedDynamicModel``
+(``src/common/models.ts:153-208``): the same DistributedModel surface for
+users who bring their own variables + predict/loss closures rather than a
+layers model. Here: bring your own params pytree + ``apply(params, x)``
+function (and optionally a loss name or custom loss already registered via
+``distriflow_tpu.models.losses.register_loss``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distriflow_tpu.models.base import ModelSpec, SpecModel
+from distriflow_tpu.utils.config import CompileConfig
+
+
+class DistributedDynamicModel(SpecModel):
+    """DistributedModel over raw params + an apply closure."""
+
+    def __init__(
+        self,
+        params: Any,
+        apply_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+        loss: str = "softmax_cross_entropy",
+        input_shape: Sequence[int] = (),
+        output_shape: Sequence[int] = (),
+        learning_rate: float = 0.001,
+        name: str = "dynamic",
+    ):
+        initial = jax.tree.map(jnp.asarray, params)
+        spec = ModelSpec(
+            init=lambda rng: initial,
+            apply=apply_fn,
+            loss=loss,
+            input_shape=tuple(input_shape),
+            output_shape=tuple(output_shape),
+            name=name,
+        )
+        super().__init__(
+            spec,
+            compile_config=CompileConfig(loss=loss),
+            learning_rate=learning_rate,
+            params=initial,
+        )

@@ -1,0 +1,79 @@
+"""Flax (Linen) module wrapper — the 'layers model' of this framework.
+
+Re-design of the reference's ``DistributedTfModel`` (wraps ``tf.LayersModel``;
+``src/common/models.ts:74-151``). Where the reference wraps a Keras-style
+layers model from tfjs, we wrap any ``flax.linen.Module``: the idiomatic TPU
+layer library whose apply is a pure function XLA can fuse end-to-end.
+
+Differences from the reference, on purpose:
+- the configured loss/optimizer are honored (the reference hardcodes
+  softmaxCrossEntropy in ``fit`` and 'sgd' at ``models.ts:88,139``);
+- parameters are an explicit pytree (no positional grad<->weight coupling);
+- dtype policy: compute in ``param_dtype`` (default float32; pass bfloat16
+  for MXU-friendly training).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distriflow_tpu.models.base import ModelSpec, SpecModel
+from distriflow_tpu.utils.config import CompileConfig
+
+
+def spec_from_flax(
+    module: nn.Module,
+    input_shape: Sequence[int],
+    output_shape: Sequence[int] = (),
+    loss: str = "softmax_cross_entropy",
+    example_batch_size: int = 1,
+    name: Optional[str] = None,
+) -> ModelSpec:
+    """Build a functional ModelSpec from a flax Module.
+
+    ``input_shape``/``output_shape`` exclude the batch dim, matching the
+    reference's ``inputShape``/``outputShape`` convention
+    (``src/common/models.ts:30-36``).
+    """
+    input_shape = tuple(input_shape)
+    output_shape = tuple(output_shape)
+
+    def init(rng: jax.Array) -> Any:
+        dummy = jnp.zeros((example_batch_size,) + input_shape, dtype=jnp.float32)
+        return module.init(rng, dummy)
+
+    def apply(params: Any, x: jnp.ndarray) -> jnp.ndarray:
+        return module.apply(params, x)
+
+    return ModelSpec(
+        init=init,
+        apply=apply,
+        loss=loss,
+        input_shape=input_shape,
+        output_shape=output_shape,
+        name=name or type(module).__name__,
+    )
+
+
+class DistributedFlaxModel(SpecModel):
+    """Stateful parity wrapper over a flax Module (reference ``DistributedTfModel``)."""
+
+    def __init__(
+        self,
+        module: nn.Module,
+        input_shape: Sequence[int],
+        output_shape: Sequence[int] = (),
+        compile_config: Optional[CompileConfig] = None,
+        learning_rate: float = 0.001,
+        rng: Optional[jax.Array] = None,
+    ):
+        cc = compile_config or CompileConfig()
+        spec = spec_from_flax(
+            module, input_shape, output_shape, loss=cc.loss or "softmax_cross_entropy"
+        )
+        super().__init__(spec, compile_config=cc, learning_rate=learning_rate, rng=rng)
+        self.module = module

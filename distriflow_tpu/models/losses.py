@@ -1,0 +1,143 @@
+"""Loss and metric registry.
+
+Re-design of the reference's string->fn loss map over tfjs losses
+(``lossesMap``, ``src/common/utils.ts:19-30``). The reference registers the
+map but then *hardcodes* softmax cross-entropy inside ``fit``
+(``src/common/models.ts:139``) — the configured loss is dead config. Here the
+registry is the single source of truth and ``fit``/``evaluate`` resolve
+through it.
+
+Every loss is defined per-example and reduced by a (optionally weighted)
+mean. The weight path is what makes partial final batches shardable on a
+mesh: the batch is padded to a multiple of the data-axis size and padded
+rows carry weight 0, so the mean is exact (see
+``distriflow_tpu.parallel.mesh.shard_batch_padded``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# per-example form: (preds/logits, targets) -> (batch,) losses
+PerExampleFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+# reduced form: (preds, targets, weight=None) -> scalar
+LossFn = Callable[..., jnp.ndarray]
+
+
+def _flat2(v: jnp.ndarray) -> jnp.ndarray:
+    """Collapse non-batch dims -> (batch, features)."""
+    return v.reshape(v.shape[0], -1)
+
+
+def _weighted_mean(per_example: jnp.ndarray, weight: Optional[jnp.ndarray]) -> jnp.ndarray:
+    if weight is None:
+        return jnp.mean(per_example)
+    weight = weight.astype(per_example.dtype)
+    return jnp.sum(per_example * weight) / jnp.maximum(jnp.sum(weight), 1e-9)
+
+
+def absolute_difference_per_example(preds, targets):
+    return jnp.mean(jnp.abs(_flat2(preds) - _flat2(targets)), axis=-1)
+
+
+def mean_squared_error_per_example(preds, targets):
+    return jnp.mean(jnp.square(_flat2(preds) - _flat2(targets)), axis=-1)
+
+
+def cosine_distance_per_example(preds, targets):
+    return optax.cosine_distance(_flat2(preds), _flat2(targets))
+
+
+def hinge_loss_per_example(preds, targets):
+    # targets in {0,1} (tfjs convention); map to {-1,+1}
+    signs = 2.0 * _flat2(targets) - 1.0
+    return jnp.mean(jnp.maximum(0.0, 1.0 - signs * _flat2(preds)), axis=-1)
+
+
+def huber_loss_per_example(preds, targets):
+    return jnp.mean(optax.huber_loss(_flat2(preds), _flat2(targets), delta=1.0), axis=-1)
+
+
+def log_loss_per_example(preds, targets):
+    eps = 1e-7
+    p = jnp.clip(_flat2(preds), eps, 1.0 - eps)
+    t = _flat2(targets)
+    return jnp.mean(-t * jnp.log(p) - (1.0 - t) * jnp.log(1.0 - p), axis=-1)
+
+
+def sigmoid_cross_entropy_per_example(logits, targets):
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(_flat2(logits), _flat2(targets)), axis=-1)
+
+
+def softmax_cross_entropy_per_example(logits, targets):
+    """The reference's (only actually used) loss
+    (``src/common/models.ts:139``), in float32 for bf16-model safety."""
+    return optax.softmax_cross_entropy(logits.astype(jnp.float32), targets)
+
+
+PER_EXAMPLE: Dict[str, PerExampleFn] = {
+    "absolute_difference": absolute_difference_per_example,
+    "mean_squared_error": mean_squared_error_per_example,
+    "cosine_distance": cosine_distance_per_example,
+    "hinge_loss": hinge_loss_per_example,
+    "huber_loss": huber_loss_per_example,
+    "log_loss": log_loss_per_example,
+    "sigmoid_cross_entropy": sigmoid_cross_entropy_per_example,
+    "softmax_cross_entropy": softmax_cross_entropy_per_example,
+}
+
+
+def _reduced(per_example: PerExampleFn) -> LossFn:
+    def loss(preds, targets, weight=None):
+        return _weighted_mean(per_example(preds, targets), weight)
+
+    return loss
+
+
+LOSSES: Dict[str, LossFn] = {name: _reduced(fn) for name, fn in PER_EXAMPLE.items()}
+
+# convenience module-level reduced forms
+absolute_difference = LOSSES["absolute_difference"]
+mean_squared_error = LOSSES["mean_squared_error"]
+cosine_distance = LOSSES["cosine_distance"]
+hinge_loss = LOSSES["hinge_loss"]
+huber_loss = LOSSES["huber_loss"]
+log_loss = LOSSES["log_loss"]
+sigmoid_cross_entropy = LOSSES["sigmoid_cross_entropy"]
+softmax_cross_entropy = LOSSES["softmax_cross_entropy"]
+
+
+def get_loss(name: str) -> LossFn:
+    if name not in LOSSES:
+        raise KeyError(f"unknown loss {name!r}; registered: {sorted(LOSSES)}")
+    return LOSSES[name]
+
+
+def register_loss(name: str, fn: PerExampleFn) -> None:
+    """Register a per-example loss (the reference map is closed; this one is open)."""
+    PER_EXAMPLE[name] = fn
+    LOSSES[name] = _reduced(fn)
+
+
+# --- metrics -------------------------------------------------------------
+
+
+def accuracy(logits: jnp.ndarray, targets: jnp.ndarray, weight=None) -> jnp.ndarray:
+    """Classification accuracy over one-hot targets (weight-aware)."""
+    correct = (jnp.argmax(logits, axis=-1) == jnp.argmax(targets, axis=-1)).astype(jnp.float32)
+    return _weighted_mean(correct, weight)
+
+
+METRICS: Dict[str, LossFn] = {
+    "accuracy": accuracy,
+}
+
+
+def get_metric(name: str) -> LossFn:
+    if name not in METRICS:
+        raise KeyError(f"unknown metric {name!r}; registered: {sorted(METRICS)}")
+    return METRICS[name]

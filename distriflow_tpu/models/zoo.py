@@ -1,0 +1,94 @@
+"""Model zoo: the benchmark-config model families.
+
+- :func:`mnist_mlp` — parity with the reference experiment's 2-dense softmax
+  MLP (``createDenseModel``: flatten -> dense(10, relu) -> dense(10, softmax),
+  ``experiment/mnist/mnist_server.ts:16-22``). We keep logits un-softmaxed
+  (softmax lives inside the CE loss — numerically superior and MXU-friendly);
+  hidden width configurable.
+- :func:`mnist_convnet` — the Keras ConvNet the reference ships as
+  ``experiment/mnist/model.json`` (Conv2D x2 + MaxPool + dense head).
+- :func:`cifar_convnet` — CIFAR-10 ConvNet for BASELINE config #2.
+- MobileNetV2 lives in ``distriflow_tpu/models/mobilenet.py``; the
+  transformer (long-context flagship) in ``distriflow_tpu/models/transformer.py``.
+
+All models compute in a configurable dtype (default float32; pass
+``jnp.bfloat16`` to target the MXU's native precision).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distriflow_tpu.models.base import ModelSpec
+from distriflow_tpu.models.flax_model import spec_from_flax
+
+
+class MLP(nn.Module):
+    """flatten -> dense(hidden, relu) -> dense(classes) logits."""
+
+    hidden: int = 10
+    classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.classes, dtype=self.dtype)(x)
+        return x
+
+
+class ConvNet(nn.Module):
+    """Conv stack + dense head (reference ``experiment/mnist/model.json`` family)."""
+
+    features: Sequence[int] = (32, 64)
+    classes: int = 10
+    dense: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        for f in self.features:
+            x = nn.Conv(f, kernel_size=(3, 3), dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.dense, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.classes, dtype=self.dtype)(x)
+        return x
+
+
+def mnist_mlp(hidden: int = 10, dtype: Any = jnp.float32) -> ModelSpec:
+    """BASELINE config #1 model (reference ``mnist_server.ts:16-22``)."""
+    return spec_from_flax(
+        MLP(hidden=hidden, classes=10, dtype=dtype),
+        input_shape=(28, 28, 1),
+        output_shape=(10,),
+        name="mnist_mlp",
+    )
+
+
+def mnist_convnet(dtype: Any = jnp.float32) -> ModelSpec:
+    """Reference ``experiment/mnist/model.json`` ConvNet family."""
+    return spec_from_flax(
+        ConvNet(features=(32, 64), classes=10, dense=128, dtype=dtype),
+        input_shape=(28, 28, 1),
+        output_shape=(10,),
+        name="mnist_convnet",
+    )
+
+
+def cifar_convnet(dtype: Any = jnp.float32) -> ModelSpec:
+    """BASELINE config #2/#3 model."""
+    return spec_from_flax(
+        ConvNet(features=(64, 128, 256), classes=10, dense=256, dtype=dtype),
+        input_shape=(32, 32, 3),
+        output_shape=(10,),
+        name="cifar_convnet",
+    )

@@ -1,0 +1,67 @@
+"""Parallel layer: meshes, collectives, sharding rules, multi-host runtime."""
+
+from distriflow_tpu.parallel.collectives import (
+    all_gather,
+    allreduce_mean,
+    collective_latency_us,
+    pmean,
+    ppermute_ring,
+    psum,
+    reduce_scatter,
+)
+from distriflow_tpu.parallel.distributed import (
+    initialize,
+    is_coordinator,
+    process_count,
+    process_index,
+)
+from distriflow_tpu.parallel.mesh import (
+    AXES,
+    axis_size,
+    batch_sharding,
+    create_mesh,
+    data_parallel_mesh,
+    local_batch_size,
+    replicate,
+    replicated,
+    shard_batch,
+    shard_batch_padded,
+)
+from distriflow_tpu.parallel.sharding import (
+    REPLICATED_RULES,
+    TRANSFORMER_TP_RULES,
+    describe_shardings,
+    shard_params,
+    spec_for_path,
+    tree_shardings,
+)
+
+__all__ = [
+    "all_gather",
+    "allreduce_mean",
+    "collective_latency_us",
+    "pmean",
+    "ppermute_ring",
+    "psum",
+    "reduce_scatter",
+    "initialize",
+    "is_coordinator",
+    "process_count",
+    "process_index",
+    "AXES",
+    "axis_size",
+    "batch_sharding",
+    "create_mesh",
+    "data_parallel_mesh",
+    "local_batch_size",
+    "replicate",
+    "replicated",
+    "shard_batch",
+    "shard_batch_padded",
+    "REPLICATED_RULES",
+    "TRANSFORMER_TP_RULES",
+    "describe_shardings",
+    "shard_params",
+    "spec_for_path",
+    "tree_shardings",
+]

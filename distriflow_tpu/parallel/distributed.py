@@ -1,0 +1,59 @@
+"""Multi-host (multi-process) runtime initialization.
+
+The reference's cross-machine story is socket.io clients dialing a central
+server URL (``src/client/abstract_client.ts:166-173``). The TPU-native
+equivalent is the JAX distributed runtime: every host runs the same SPMD
+program, ``jax.distributed.initialize`` wires the hosts into one system over
+DCN, and the global mesh spans all hosts' devices; in-graph collectives then
+ride ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the multi-host runtime (idempotent; no-op single-host).
+
+    With no arguments, relies on TPU pod auto-detection (metadata-based), the
+    JAX analog of the reference client's connect-and-await-Download handshake.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and "COORDINATOR_ADDRESS" in os.environ:
+        coordinator_address = os.environ["COORDINATOR_ADDRESS"]
+    if coordinator_address is None and num_processes is None:
+        # single-process (or auto-detected pod) — nothing to wire up here
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """Process 0 plays the reference's 'server' role for host-side work
+    (checkpoint writes, logging, data dispatch)."""
+    return jax.process_index() == 0

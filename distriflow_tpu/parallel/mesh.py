@@ -1,0 +1,126 @@
+"""Device mesh construction and sharding helpers.
+
+The TPU-native replacement for the reference's hub-and-spoke socket topology
+(``src/test/package.json:24-25``; analysis in SURVEY.md §2.4): instead of a
+central server holding canonical weights and N websocket clients, a
+``jax.sharding.Mesh`` lays devices out on named axes and XLA collectives ride
+the ICI links between them.
+
+Canonical axis names (sizes of 1 are legal and common):
+
+- ``data``   — data parallelism (the reference's only strategy)
+- ``model``  — tensor/model parallelism (Megatron-style weight sharding)
+- ``seq``    — sequence/context parallelism (ring attention)
+- ``pipe``   — pipeline stages
+- ``expert`` — MoE expert parallelism
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distriflow_tpu.utils.config import MeshConfig
+
+AXES: Tuple[str, ...] = ("data", "model", "seq", "pipe", "expert")
+
+
+def create_mesh(
+    config: Union[MeshConfig, Mapping[str, int], None] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` with the configured axis sizes.
+
+    Axis sizes must multiply to the device count. Axes of size 1 are kept in
+    the mesh so PartitionSpecs referencing them are always valid — a model
+    written for a v4-32 layout runs unchanged on one chip.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig(data=len(devices))
+    if isinstance(config, Mapping):
+        config = MeshConfig(**dict(config))
+    if config.size != len(devices):
+        raise ValueError(
+            f"mesh axis sizes {config} multiply to {config.size}, "
+            f"but {len(devices)} devices were provided"
+        )
+    shape = (config.data, config.model, config.seq, config.pipe, config.expert)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """All devices on the ``data`` axis — the reference-parity layout."""
+    devices = list(devices if devices is not None else jax.devices())
+    return create_mesh(MeshConfig(data=len(devices)), devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (every device holds the full array)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``; replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(mesh: Mesh, batch: Any, axis: str = "data") -> Any:
+    """Place a host batch pytree onto the mesh, batch-dim sharded over ``axis``.
+
+    The device-resident replacement for the reference's serialize->wire->
+    deserialize data path (``src/server/dataset.ts:99-109``): one host->device
+    transfer, after which the batch lives distributed across the mesh.
+    """
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def shard_batch_padded(
+    mesh: Mesh, x: Any, y: Any, axis: str = "data"
+) -> Tuple[Any, Any, Any]:
+    """Shard a possibly-partial batch by zero-padding to the axis size.
+
+    Returns ``(x, y, weight)`` device-resident and sharded over ``axis``;
+    ``weight`` is 1.0 for real rows and 0.0 for padding, so weighted-mean
+    losses (``distriflow_tpu.models.losses``) stay exact. This is how the
+    ``small_last_batch`` path (fixed vs the reference, SURVEY.md §2 C13)
+    runs on a mesh whose data axis does not divide the final batch.
+    """
+    n = len(x)
+    m = axis_size(mesh, axis)
+    pad = (-n) % m
+    weight = np.ones((n,), dtype=np.float32)
+    if pad:
+        def pad0(v):
+            v = np.asarray(v)
+            widths = [(0, pad)] + [(0, 0)] * (v.ndim - 1)
+            return np.pad(v, widths)
+
+        x, y = pad0(x), pad0(y)
+        weight = np.concatenate([weight, np.zeros((pad,), np.float32)])
+    return shard_batch(mesh, (x, y, weight), axis)
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    """Replicate a pytree across the mesh (canonical-weights placement)."""
+    sharding = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def local_batch_size(global_batch_size: int, mesh: Mesh, axis: str = "data") -> int:
+    n = axis_size(mesh, axis)
+    if global_batch_size % n:
+        raise ValueError(
+            f"global batch size {global_batch_size} not divisible by {axis}-axis size {n}"
+        )
+    return global_batch_size // n

@@ -1,0 +1,105 @@
+"""Parameter sharding rules: path-pattern -> PartitionSpec.
+
+The reference has no model parallelism at all (explicitly out of scope,
+``README.md:4``); its weights are replicated by construction because every
+client downloads the full model (``src/server/abstract_server.ts:81-89``).
+Here sharding is a first-class layer: a rule table maps parameter pytree
+paths (regex over ``jax.tree_util.keystr`` paths) to PartitionSpecs, so the
+same model runs replicated (DP-only, reference parity) or Megatron-sharded
+(TP) by swapping rule sets — no model code changes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# A rule set is an ordered list of (path_regex, PartitionSpec); first match wins.
+Rules = Sequence[Tuple[str, P]]
+
+REPLICATED_RULES: Rules = ((".*", P()),)
+
+# Megatron-style TP for the transformer in distriflow_tpu/models/transformer.py:
+# attention qkv + mlp-in are column-sharded, attention-out + mlp-out row-sharded.
+TRANSFORMER_TP_RULES: Rules = (
+    (r".*(q_proj|k_proj|v_proj|wi|gate).*kernel", P(None, "model")),
+    (r".*(o_proj|wo).*kernel", P("model", None)),
+    (r".*embed.*", P(None, "model")),
+    (r".*(bias|scale)", P()),
+    (r".*", P()),
+)
+
+
+def spec_for_path(path: str, rules: Rules) -> P:
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return P()
+
+
+def _fit_spec_to_rank(spec: P, ndim: int) -> P:
+    """Clip/pad a PartitionSpec to an array's rank."""
+    entries = list(spec)
+    if len(entries) > ndim:
+        entries = entries[:ndim]
+    return P(*entries)
+
+
+def tree_shardings(params: Any, mesh: Mesh, rules: Rules = REPLICATED_RULES) -> Any:
+    """Pytree of NamedShardings matching ``params``, resolved through ``rules``."""
+
+    def resolve(path, leaf):
+        key = jax.tree_util.keystr(path)
+        spec = _fit_spec_to_rank(spec_for_path(key, rules), np.ndim(leaf))
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(resolve, params)
+
+
+def shard_params(params: Any, mesh: Mesh, rules: Rules = REPLICATED_RULES) -> Any:
+    """Place a params pytree onto the mesh per ``rules``."""
+    shardings = tree_shardings(params, mesh, rules)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def opt_state_shardings(opt_state_shape: Any, params: Any, param_shardings: Any, mesh: Mesh) -> Any:
+    """Shardings for an optax state, mirroring the param shardings.
+
+    Optax moment buffers (mu/nu/trace/...) embed copies of the param pytree;
+    a leaf whose path *ends with* a param's path gets that param's sharding,
+    everything else (counts, scalars) replicates. Needed because
+    ``optimizer.init`` is shape-only (``zeros_like``), so XLA will not
+    propagate input shardings into its outputs.
+    """
+    param_by_path = {
+        jax.tree_util.keystr(path): (sh, tuple(np.shape(leaf)))
+        for (path, leaf), sh in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_leaves(param_shardings),
+        )
+    }
+    replicated = NamedSharding(mesh, P())
+
+    def resolve(path, leaf):
+        key = jax.tree_util.keystr(path)
+        for p_key, (sh, p_shape) in param_by_path.items():
+            if key.endswith(p_key) and tuple(np.shape(leaf)) == p_shape:
+                return sh
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(resolve, opt_state_shape)
+
+
+def describe_shardings(params: Any, mesh: Mesh, rules: Rules) -> str:
+    """Human-readable sharding table (observability helper)."""
+    lines = []
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        spec = _fit_spec_to_rank(spec_for_path(key, rules), np.ndim(leaf))
+        lines.append(f"{key:60s} {str(np.shape(leaf)):20s} {spec}")
+    return "\n".join(lines)

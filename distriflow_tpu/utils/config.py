@@ -149,9 +149,11 @@ class CompileConfig:
     The reference hardcodes loss to softmax cross-entropy in ``fit`` regardless
     of this config (bug, ``src/common/models.ts:139``); here ``loss`` is honored
     everywhere via the loss registry (``distriflow_tpu/models/losses.py``).
+    ``loss=None`` means "use the model spec's loss" — so setting only the
+    optimizer never silently substitutes the objective.
     """
 
-    loss: str = "softmax_cross_entropy"
+    loss: Optional[str] = None
     metrics: Sequence[str] = field(default_factory=lambda: ("accuracy",))
     optimizer: str = "sgd"
 

@@ -97,15 +97,28 @@ def serialize_tree(tree: Any) -> Dict[str, SerializedArray]:
     return {jax.tree_util.keystr(path): serialize_array(leaf) for path, leaf in flat}
 
 
-def deserialize_tree(serialized: Dict[str, SerializedArray], like: Any) -> Any:
-    """{path: SerializedArray} -> pytree with the structure of ``like``."""
+def deserialize_tree(
+    serialized: Dict[str, SerializedArray], like: Any, strict_shapes: bool = True
+) -> Any:
+    """{path: SerializedArray} -> pytree with the structure of ``like``.
+
+    With ``strict_shapes`` (default), a template leaf with a known shape must
+    match the serialized shape — catching silent architecture mismatches
+    (e.g. restoring a checkpoint into a differently-sized model).
+    """
     flat, treedef = jax.tree_util.tree_flatten_with_path(like)
     leaves = []
-    for path, _ in flat:
+    for path, template in flat:
         key = jax.tree_util.keystr(path)
         if key not in serialized:
             raise KeyError(f"serialized tree missing leaf {key!r}")
-        leaves.append(deserialize_array(serialized[key]))
+        s = serialized[key]
+        t_shape = getattr(template, "shape", None)
+        if strict_shapes and t_shape is not None and tuple(t_shape) != s.shape:
+            raise ValueError(
+                f"shape mismatch at {key!r}: serialized {s.shape} vs template {tuple(t_shape)}"
+            )
+        leaves.append(deserialize_array(s))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 

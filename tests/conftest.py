@@ -10,7 +10,7 @@ Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the env's preset (e.g. axon/tpu)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,6 +18,11 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# The environment's sitecustomize may force-register a TPU backend and set
+# jax_platforms to e.g. "axon,cpu" after env vars are read; override the
+# config directly so tests always run on the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,149 @@
+"""Dataset dispatch/ack/redelivery tests (reference C13 semantics, fixed)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.data.dataset import Batch, DistributedDataset, batch_to_data_msg
+from distriflow_tpu.utils.serialization import deserialize_array
+
+
+def _ds(n=10, bs=3, epochs=1, **kw):
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    y = np.arange(n, dtype=np.float32).reshape(n, 1) * 10
+    return DistributedDataset(x, y, {"batch_size": bs, "epochs": epochs, **kw})
+
+
+def test_batch_count_drop_last():
+    assert _ds(10, 3).num_batches == 3  # remainder dropped by default
+
+
+def test_batch_count_small_last():
+    assert _ds(10, 3, small_last_batch=True).num_batches == 4
+
+
+def test_final_partial_batch_does_not_overrun():
+    # the reference always slices a full batchSize (dataset.ts:69-85 bug)
+    ds = _ds(10, 3, small_last_batch=True)
+    batches = {b.batch: b for b in iter(ds)}
+    assert len(batches[3].x) == 1  # 10 = 3*3 + 1
+    np.testing.assert_array_equal(batches[3].x.ravel(), [9.0])
+
+
+def test_fcfs_then_ack_advances_epoch():
+    ds = _ds(6, 3, epochs=2)
+    b0 = ds.next()
+    b1 = ds.next()
+    assert (b0.batch, b1.batch) == (0, 1)
+    assert b0.epoch == 0
+    ds.complete_batch(0)
+    ds.complete_batch(1)
+    b2 = ds.next()
+    assert b2.epoch == 1  # epoch advanced once all acked
+    ds.complete_batch(b2.batch)
+    b3 = ds.next()
+    ds.complete_batch(b3.batch)
+    assert ds.next() is None
+    assert ds.exhausted
+
+
+def test_requeue_redelivers_unacked():
+    # at-least-once via explicit requeue (worker-failure path)
+    ds = _ds(6, 3, epochs=1)
+    first = ds.next()
+    second = ds.next()
+    ds.complete_batch(second.batch)  # ack only one
+    assert ds.next(timeout=0.05) is None  # first is outstanding, not re-served
+    assert not ds.exhausted
+    ds.requeue(first.batch)  # server noticed the worker died
+    redelivered = ds.next()
+    assert redelivered.batch == first.batch
+    ds.complete_batch(first.batch)
+    assert ds.next() is None
+    assert ds.exhausted
+
+
+def test_requeue_after_ack_is_noop():
+    ds = _ds(6, 3, epochs=1)
+    b = ds.next()
+    ds.complete_batch(b.batch)
+    ds.requeue(b.batch)  # stale requeue must not resurrect acked work
+    nxt = ds.next()
+    assert nxt.batch != b.batch
+
+
+def test_acked_while_queued_not_redelivered():
+    ds = _ds(9, 3, epochs=1)
+    a, b, c = ds.next(), ds.next(), ds.next()
+    ds.requeue(a.batch)
+    ds.requeue(b.batch)
+    ds.complete_batch(a.batch)  # acked after requeue: must not be served again
+    nxt = ds.next()
+    assert nxt.batch == b.batch
+    ds.complete_batch(b.batch)
+    ds.complete_batch(c.batch)
+    assert ds.next() is None
+
+
+def test_preprocess_chain():
+    ds = _ds(6, 3)
+    ds.add_preprocess(lambda x, y: (x * 2, y))
+    ds.add_preprocess(lambda x, y: (x + 1, y))
+    b = ds.next()
+    np.testing.assert_array_equal(b.x.ravel(), [1.0, 3.0, 5.0])
+
+
+def test_shuffle_deterministic_per_epoch():
+    ds1 = _ds(12, 3, epochs=2, shuffle=True, seed=7)
+    ds2 = _ds(12, 3, epochs=2, shuffle=True, seed=7)
+    order1 = [b.batch for b in iter(ds1)]
+    order2 = [b.batch for b in iter(ds2)]
+    assert order1 == order2
+    assert order1[:4] != sorted(order1[:4]) or order1[4:] != sorted(order1[4:])
+
+
+def test_thread_safe_dispatch():
+    # each batch must go to exactly one worker (no broadcast race)
+    ds = _ds(90, 3, epochs=1)
+    seen = []
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            b = ds.next()
+            if b is None:
+                return
+            with lock:
+                seen.append(b.batch)
+            ds.complete_batch(b.batch)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(seen) == list(range(30))  # every batch exactly once
+
+
+def test_batch_to_data_msg_roundtrip():
+    ds = _ds(6, 3)
+    b = ds.next()
+    msg = batch_to_data_msg(b)
+    assert msg.batch == b.batch and msg.epoch == b.epoch
+    np.testing.assert_array_equal(deserialize_array(msg.x), b.x)
+    np.testing.assert_array_equal(deserialize_array(msg.y), b.y)
+
+
+def test_mismatched_xy_raises():
+    with pytest.raises(ValueError):
+        DistributedDataset(np.zeros((4, 1)), np.zeros((5, 1)), {"batch_size": 2})
+
+
+def test_next_sharded(devices):
+    from distriflow_tpu.parallel import data_parallel_mesh
+
+    mesh = data_parallel_mesh(devices)
+    ds = _ds(16, 8, epochs=1)
+    b = ds.next_sharded(mesh)
+    assert len(b.x.sharding.device_set) == 8
