@@ -1,0 +1,122 @@
+"""Benchmark: MNIST sync-SGD samples/sec/chip vs a reference-equivalent CPU baseline.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+- **value**: throughput of this framework's sync-SGD train step (BASELINE.md
+  config #1 model: the reference experiment's MLP, ``mnist_server.ts:16-22``)
+  on the available accelerator (one TPU chip under the driver; CPU otherwise).
+- **vs_baseline**: ratio against a measured stand-in for the reference's
+  single-host path. The reference is tfjs-node (CPU/WebGL kernels); nothing
+  is published (BASELINE.md), and node/tfjs is not installed here, so the
+  stand-in is the same model/loss/optimizer/batch implemented in torch on
+  CPU — the closest honest proxy for "reference single-host throughput"
+  available in this image. Both sides use identical global batch and dtype
+  float32.
+
+All diagnostics go to stderr; stdout carries exactly the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+GLOBAL_BATCH = 1024
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+HIDDEN = 10  # reference parity arch: flatten -> dense(10, relu) -> dense(10)
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def bench_distriflow() -> float:
+    import jax
+    import numpy as np
+
+    from distriflow_tpu.models import mnist_mlp
+    from distriflow_tpu.parallel import data_parallel_mesh, shard_batch
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    devices = jax.devices()
+    log(f"devices: {devices}")
+    mesh = data_parallel_mesh(devices)
+    trainer = SyncTrainer(mnist_mlp(hidden=HIDDEN), mesh=mesh, learning_rate=0.01)
+    trainer.init(jax.random.PRNGKey(0))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(GLOBAL_BATCH, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, GLOBAL_BATCH)]
+    batch = shard_batch(mesh, (x, y))
+
+    for _ in range(WARMUP_STEPS):
+        loss = trainer.step_async(batch)
+    jax.block_until_ready(loss)
+
+    start = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        loss = trainer.step_async(batch)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+    sps = GLOBAL_BATCH * MEASURE_STEPS / elapsed
+    per_chip = sps / len(devices)
+    log(f"distriflow_tpu: {sps:.0f} samples/sec total, {per_chip:.0f}/chip "
+        f"({elapsed*1e3/MEASURE_STEPS:.2f} ms/step, final loss {float(loss):.4f})")
+    return per_chip
+
+
+def bench_torch_cpu_baseline() -> float:
+    """Reference-equivalent single-host loop: same arch/loss/optimizer/batch."""
+    import torch
+
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(
+        torch.nn.Flatten(),
+        torch.nn.Linear(784, HIDDEN),
+        torch.nn.ReLU(),
+        torch.nn.Linear(HIDDEN, 10),
+    )
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    loss_fn = torch.nn.CrossEntropyLoss()
+    x = torch.randn(GLOBAL_BATCH, 28, 28, 1)
+    y = torch.randint(0, 10, (GLOBAL_BATCH,))
+
+    def step():
+        opt.zero_grad()
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+
+    for _ in range(WARMUP_STEPS):
+        step()
+    start = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        step()
+    elapsed = time.perf_counter() - start
+    sps = GLOBAL_BATCH * MEASURE_STEPS / elapsed
+    log(f"torch-cpu baseline: {sps:.0f} samples/sec "
+        f"({elapsed*1e3/MEASURE_STEPS:.2f} ms/step)")
+    return sps
+
+
+def main() -> None:
+    value = bench_distriflow()
+    try:
+        baseline = bench_torch_cpu_baseline()
+    except Exception as e:  # torch missing/broken must not kill the bench
+        log(f"baseline failed: {e!r}")
+        baseline = None
+    result = {
+        "metric": "MNIST MLP sync-SGD throughput (batch 1024, fp32)",
+        "value": round(value, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(value / baseline, 3) if baseline else None,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

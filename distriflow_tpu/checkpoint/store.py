@@ -41,9 +41,17 @@ DATA_BIN = "data.bin"
 META_JSON = "meta.json"
 
 
-def _timestamp_version() -> str:
-    """Millisecond timestamp version (reference ``Date.now()`` dirs)."""
+def timestamp_version() -> str:
+    """Millisecond timestamp version (reference ``Date.now()`` dirs).
+
+    The single source of the version-string format: it doubles as the wire
+    coherence token AND the checkpoint directory name, so there must be
+    exactly one producer.
+    """
     return str(int(time.time() * 1000))
+
+
+_timestamp_version = timestamp_version  # internal alias
 
 
 class CheckpointStore:

@@ -1,0 +1,20 @@
+"""Client layer: wire-connected workers.
+
+Re-exports mirror the reference ``src/client/index.ts:1-5``.
+"""
+
+from distriflow_tpu.client.abstract_client import (
+    AbstractClient,
+    DistributedClientConfig,
+    resolve_client_id,
+)
+from distriflow_tpu.client.async_client import AsynchronousSGDClient
+from distriflow_tpu.client.federated_client import FederatedClient
+
+__all__ = [
+    "AbstractClient",
+    "DistributedClientConfig",
+    "resolve_client_id",
+    "AsynchronousSGDClient",
+    "FederatedClient",
+]

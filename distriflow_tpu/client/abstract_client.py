@@ -1,0 +1,171 @@
+"""Abstract client: the DistriWorker role over the wire.
+
+Re-design of the reference ``AbstractClient`` (``src/client/abstract_client.ts``):
+connect to a server URL, await the first Download (10 s timeout), keep weights
+in sync on every Download broadcast, upload gradients with ack (5 s timeout),
+manage client identity, per-version update counts, and the three-level
+hyperparameter precedence (local config > server-pushed > defaults,
+reference ``federated_client.ts:138-140``).
+
+Client identity: explicit config > persisted identity file (the cookie
+equivalent — the reference stores a 1-year ``Distributed-learner-uuid``
+cookie, ``src/client/utils.ts:49-64``) > fresh uuid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import uuid as uuid_lib
+from typing import Any, Callable, Dict, List, Optional
+
+from distriflow_tpu.comm.transport import (
+    ACK_TIMEOUT_S,
+    CONNECT_TIMEOUT_S,
+    ClientTransport,
+)
+from distriflow_tpu.models.base import DistributedModel, ModelSource, fetch_model
+from distriflow_tpu.utils.config import DEFAULT_CLIENT_HYPERPARAMS, ClientHyperparams
+from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
+from distriflow_tpu.utils.messages import DownloadMsg, Events, UploadMsg
+from distriflow_tpu.utils.serialization import deserialize_tree
+
+IDENTITY_FILE = ".distriflow-learner-uuid"  # cookie-equivalent persistence
+
+
+@dataclasses.dataclass
+class DistributedClientConfig:
+    """Reference ``DistributedClientConfig`` (``abstract_client.ts:22-28``)."""
+
+    client_id: Optional[str] = None
+    hyperparams: Optional[Dict[str, Any]] = None
+    send_metrics: bool = False
+    verbose: Optional[bool] = None
+    identity_dir: Optional[str] = None  # where the uuid file lives; None = no persistence
+    # reference default is 5 s (abstract_client.ts:13); first-step jit
+    # compilation on the server easily exceeds that, so the knob is explicit
+    upload_timeout_s: float = 60.0
+
+
+def resolve_client_id(config: DistributedClientConfig) -> str:
+    """config > identity file > fresh uuid (reference ``abstract_client.ts:66-73``)."""
+    if config.client_id:
+        return config.client_id
+    if config.identity_dir is not None:
+        path = os.path.join(config.identity_dir, IDENTITY_FILE)
+        if os.path.exists(path):
+            with open(path) as f:
+                stored = f.read().strip()
+            if stored:
+                return stored
+        fresh = uuid_lib.uuid4().hex
+        os.makedirs(config.identity_dir, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(fresh)
+        return fresh
+    return uuid_lib.uuid4().hex
+
+
+class AbstractClient:
+    def __init__(
+        self,
+        server_address: str,
+        model: ModelSource,
+        config: Optional[DistributedClientConfig] = None,
+    ):
+        self.server_address = server_address
+        self.model: DistributedModel = fetch_model(model)
+        self.config = config or DistributedClientConfig()
+        self.client_id = resolve_client_id(self.config)
+        self.logger = VerboseLogger(f"{type(self).__name__}[{self.client_id[:8]}]",
+                                    self.config.verbose)
+        self.callbacks = CallbackRegistry("download", "new_version", "upload")
+        self.transport: Optional[ClientTransport] = None
+        self.msg: Optional[DownloadMsg] = None  # last Download
+        self.version_update_counts: Dict[str, int] = {}  # reference :36,112-122
+        self._first_download = threading.Event()
+        self._download_lock = threading.Lock()
+
+    # -- observability -----------------------------------------------------
+
+    def on_new_version(self, fn: Callable[..., Any]) -> None:
+        self.callbacks.register("new_version", fn)
+
+    def log(self, *args: Any) -> None:
+        self.logger.log(*args)
+
+    def time(self, msg: str):
+        return self.logger.time(msg)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def setup(self, timeout: float = CONNECT_TIMEOUT_S) -> None:
+        """Connect and await the first Download (reference ``:166-173``)."""
+        self.model.setup()
+        self.transport = ClientTransport(self.server_address)
+        self.transport.on(Events.Download.value, self._on_download)
+        self.transport.on("trainingComplete", self._on_training_complete)
+        self.transport.connect(timeout)
+        if not self._first_download.wait(timeout):
+            raise TimeoutError(f"no initial Download within {timeout}s")
+
+    def dispose(self) -> None:
+        if self.transport is not None:
+            self.transport.close()
+
+    # -- download handling --------------------------------------------------
+
+    def _on_download(self, payload: Any) -> None:
+        msg = DownloadMsg.from_wire(payload)
+        with self._download_lock:
+            self.msg = msg
+            self.set_params_from(msg)
+        first = not self._first_download.is_set()
+        self._first_download.set()
+        self.callbacks.fire("download", msg)
+        self.callbacks.fire("new_version", msg.model.version)
+        self.handle_download(msg, first=first)
+
+    def _on_training_complete(self, payload: Any) -> None:
+        self.handle_training_complete()
+
+    def set_params_from(self, msg: DownloadMsg) -> None:
+        """Deserialize and install weights (reference ``setVars`` in tidy, ``:160-164``)."""
+        template = self.model.get_params()
+        self.model.set_params(deserialize_tree(msg.model.vars, template))
+
+    # -- upload -------------------------------------------------------------
+
+    def upload(self, msg: UploadMsg, timeout: Optional[float] = None) -> Any:
+        """Emit with ack + timeout (reference ``uploadVars``, ``:148-158``)."""
+        if timeout is None:
+            timeout = self.config.upload_timeout_s
+        result = self.transport.request(Events.Upload.value, msg.to_wire(), timeout)
+        version = msg.gradients.version if msg.gradients is not None else None
+        if version is not None:
+            self.version_update_counts[version] = (
+                self.version_update_counts.get(version, 0) + 1
+            )
+        self.callbacks.fire("upload", msg, result)
+        return result
+
+    # -- hyperparameters -----------------------------------------------------
+
+    def hyperparam(self, name: str) -> Any:
+        """local > server-pushed > default (reference ``federated_client.ts:138-140``)."""
+        local = self.config.hyperparams or {}
+        if name in local and local[name] is not None:
+            return local[name]
+        pushed = (self.msg.hyperparams if self.msg is not None else {}) or {}
+        if name in pushed and pushed[name] is not None:
+            return pushed[name]
+        return getattr(DEFAULT_CLIENT_HYPERPARAMS, name)
+
+    # -- subclass hooks -------------------------------------------------------
+
+    def handle_download(self, msg: DownloadMsg, first: bool) -> None:
+        pass
+
+    def handle_training_complete(self) -> None:
+        pass

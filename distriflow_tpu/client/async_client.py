@@ -1,0 +1,67 @@
+"""Async-SGD client: server-fed worker.
+
+Re-design of the reference ``AsynchronousSGDClient``
+(``src/client/asynchronousSGD_client.ts``): training is a server-driven
+ping-pong — every Download carries fresh weights plus a batch; the client
+installs the weights, computes gradients on the batch, and uploads
+``{batch, gradients, client_id}`` echoing the batch id for the server's ack
+bookkeeping. The loop ends when the server signals ``trainingComplete``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+
+from distriflow_tpu.client.abstract_client import AbstractClient
+from distriflow_tpu.utils.messages import DownloadMsg, GradientMsg, UploadMsg
+from distriflow_tpu.utils.serialization import deserialize_array, serialize_tree
+
+
+class AsynchronousSGDClient(AbstractClient):
+    def __init__(self, *args: Any, **kw: Any):
+        super().__init__(*args, **kw)
+        self.batches_processed = 0
+        self.training_complete = threading.Event()
+
+    def handle_download(self, msg: DownloadMsg, first: bool) -> None:
+        """Weights are already installed by the base class; train on the
+        attached batch if any (reference ``:32-40``)."""
+        if msg.data is None:
+            return
+        self.distributed_update(msg)
+
+    def handle_training_complete(self) -> None:
+        self.log("training complete")
+        self.training_complete.set()
+
+    def distributed_update(self, msg: DownloadMsg) -> None:
+        """One fit+upload round (reference ``DistributedUpdate``, ``:44-83``)."""
+        x = jnp.asarray(deserialize_array(msg.data.x))
+        y = jnp.asarray(deserialize_array(msg.data.y))
+        metrics: Optional[List[float]] = None
+        if self.config.send_metrics:
+            metrics = self.model.evaluate(x, y)
+        with self.time("fit"):
+            grads = self.model.fit(x, y)
+        # count before the upload ack: the server may emit trainingComplete
+        # the instant it receives this upload, racing the ack back to us
+        self.batches_processed += 1
+        self.upload(
+            UploadMsg(
+                client_id=self.client_id,
+                batch=msg.data.batch,
+                gradients=GradientMsg(
+                    version=msg.model.version, vars=serialize_tree(grads)
+                ),
+                metrics=metrics,
+            )
+        )
+
+    def train_until_complete(self, timeout: float = 300.0) -> int:
+        """Block until the server signals completion; returns batches done."""
+        if not self.training_complete.wait(timeout):
+            raise TimeoutError(f"training did not complete within {timeout}s")
+        return self.batches_processed

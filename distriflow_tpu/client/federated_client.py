@@ -1,0 +1,79 @@
+"""Federated client: local-data worker.
+
+Re-design of the reference ``FederatedClient`` (``src/client/federated_client.ts``):
+training data never leaves the client. ``distributed_update(x, y)``
+accumulates examples in a local buffer; whenever at least
+``examples_per_update`` examples are queued, it slices a chunk, optionally
+evaluates (metrics piggyback on the upload when ``send_metrics``),
+computes gradients against the current server version, uploads with ack,
+and drops the consumed rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from distriflow_tpu.client.abstract_client import AbstractClient
+from distriflow_tpu.utils.messages import GradientMsg, UploadMsg
+from distriflow_tpu.utils.serialization import serialize_tree
+
+
+class FederatedClient(AbstractClient):
+    _x_buf: Optional[np.ndarray] = None
+    _y_buf: Optional[np.ndarray] = None
+
+    # -- introspection (reference :134-148) --------------------------------
+
+    @property
+    def num_examples(self) -> int:
+        return 0 if self._x_buf is None else len(self._x_buf)
+
+    @property
+    def num_examples_per_update(self) -> int:
+        return int(self.hyperparam("examples_per_update"))
+
+    @property
+    def num_examples_remaining(self) -> int:
+        return self.num_examples_per_update - self.num_examples
+
+    # -- training ------------------------------------------------------------
+
+    def distributed_update(self, x: Any, y: Any) -> int:
+        """Queue examples; train+upload for every full chunk. Returns the
+        number of uploads performed (reference ``DistributedUpdate``,
+        ``federated_client.ts:68-132``)."""
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if x.ndim == len(self.model.input_shape):  # single example -> batch of 1
+            x = x[None]
+            y = y[None]
+        # addRows (reference client/utils.ts:40-47)
+        self._x_buf = x if self._x_buf is None else np.concatenate([self._x_buf, x])
+        self._y_buf = y if self._y_buf is None else np.concatenate([self._y_buf, y])
+
+        uploads = 0
+        chunk = self.num_examples_per_update
+        while len(self._x_buf) >= chunk:
+            cx, cy = self._x_buf[:chunk], self._y_buf[:chunk]
+            metrics: Optional[List[float]] = None
+            if self.config.send_metrics:
+                metrics = self.model.evaluate(jnp.asarray(cx), jnp.asarray(cy))
+            with self.time("fit"):
+                grads = self.model.fit(jnp.asarray(cx), jnp.asarray(cy))
+            version = self.msg.model.version
+            with self.time("upload"):
+                self.upload(
+                    UploadMsg(
+                        client_id=self.client_id,
+                        gradients=GradientMsg(version=version, vars=serialize_tree(grads)),
+                        metrics=metrics,
+                    )
+                )
+            uploads += 1
+            # drop consumed rows (reference :125-131)
+            self._x_buf = self._x_buf[chunk:]
+            self._y_buf = self._y_buf[chunk:]
+        return uploads

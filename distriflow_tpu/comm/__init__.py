@@ -1,0 +1,19 @@
+"""Comm layer: binary codec + asyncio TCP transport (socket.io replacement)."""
+
+from distriflow_tpu.comm.codec import CodecError, decode, encode
+from distriflow_tpu.comm.transport import (
+    ACK_TIMEOUT_S,
+    CONNECT_TIMEOUT_S,
+    ClientTransport,
+    ServerTransport,
+)
+
+__all__ = [
+    "CodecError",
+    "decode",
+    "encode",
+    "ACK_TIMEOUT_S",
+    "CONNECT_TIMEOUT_S",
+    "ClientTransport",
+    "ServerTransport",
+]

@@ -1,0 +1,335 @@
+"""Asyncio TCP transport: the socket.io replacement.
+
+The reference's cross-process story is socket.io 2.x over WebSocket
+(hub-and-spoke, server-centric, binary payloads, emit-with-ack;
+SURVEY.md §2.4). This module provides the same primitives natively:
+
+- length-prefixed binary frames (codec.py payloads) over TCP;
+- ``emit(event, payload)`` fire-and-forget and ``request`` (emit + ack)
+  with timeouts — the reference's 5 s upload-ack and 10 s connect
+  timeouts are preserved as defaults (``src/client/abstract_client.ts:12-13``);
+- server-side broadcast to all connected clients
+  (``server.sockets.emit``, ``federated_server.ts:80``);
+- connection/disconnection callbacks.
+
+Both endpoints run their event loop in a background thread so the public
+API is synchronous (trainers and tests are synchronous; the reference's
+node event loop maps onto this thread).
+
+On TPU pods this transport only carries *host coordination* for the
+multi-process federated mode (client-held data). Device-to-device tensor
+movement never goes through here — that is ICI's job (see
+``distriflow_tpu/parallel``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+import threading
+import uuid
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from distriflow_tpu.comm.codec import decode, encode
+
+CONNECT_TIMEOUT_S = 10.0  # reference abstract_client.ts:12
+ACK_TIMEOUT_S = 5.0  # reference abstract_client.ts:13
+
+_LEN = struct.Struct("<Q")
+MAX_FRAME = 1 << 33  # 8 GiB safety bound
+
+
+async def _write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(_LEN.pack(len(payload)) + payload)
+    await writer.drain()
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> bytes:
+    header = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME")
+    return await reader.readexactly(n)
+
+
+class _Endpoint:
+    """Shared emit/ack machinery for one connection."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, writer: asyncio.StreamWriter):
+        self.loop = loop
+        self.writer = writer
+        self._acks: Dict[str, asyncio.Future] = {}
+        self._write_lock = asyncio.Lock()
+
+    async def _send(self, msg: Dict[str, Any]) -> None:
+        async with self._write_lock:
+            await _write_frame(self.writer, encode(msg))
+
+    async def emit_async(self, event: str, payload: Any) -> None:
+        await self._send({"event": event, "payload": payload})
+
+    async def request_async(self, event: str, payload: Any, timeout: float) -> Any:
+        msg_id = uuid.uuid4().hex
+        fut = self.loop.create_future()
+        self._acks[msg_id] = fut
+        try:
+            await self._send({"event": event, "payload": payload, "msg_id": msg_id})
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._acks.pop(msg_id, None)
+
+    def handle_ack(self, msg: Dict[str, Any]) -> None:
+        fut = self._acks.get(msg.get("ack_id", ""))
+        if fut is not None and not fut.done():
+            fut.set_result(msg.get("result"))
+
+
+class ServerTransport:
+    """Hub endpoint: accepts clients, dispatches events, broadcasts."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._clients: Dict[str, _Endpoint] = {}
+        self._handlers: Dict[str, Callable[[str, Any], Any]] = {}
+        self.on_connect: Optional[Callable[[str], Any]] = None
+        self.on_disconnect: Optional[Callable[[str], Any]] = None
+        self._started = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServerTransport":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("server transport failed to start")
+        return self
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def main():
+            self._server = await asyncio.start_server(
+                self._handle_client, self.host, self.port
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+            async with self._server:
+                await self._server.serve_forever()
+
+        try:
+            self._loop.run_until_complete(main())
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._loop.close()
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+        loop = self._loop
+
+        def _shutdown():
+            for task in asyncio.all_tasks(loop):
+                task.cancel()
+
+        loop.call_soon_threadsafe(_shutdown)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- events ------------------------------------------------------------
+
+    def on(self, event: str, handler: Callable[[str, Any], Any]) -> None:
+        """Register ``handler(client_id, payload) -> ack_result | None``."""
+        self._handlers[event] = handler
+
+    async def _handle_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        client_id = uuid.uuid4().hex
+        endpoint = _Endpoint(self._loop, writer)
+        self._clients[client_id] = endpoint
+        if self.on_connect:
+            # executor, not inline: callbacks call emit_to/broadcast, which
+            # block on this very loop — running them here would deadlock
+            def _safe_connect(cid=client_id):
+                try:
+                    self.on_connect(cid)
+                except Exception as e:
+                    print(f"[transport] on_connect error: {e!r}", flush=True)
+
+            await self._loop.run_in_executor(None, _safe_connect)
+        async def dispatch(msg: Dict[str, Any]) -> None:
+            handler = self._handlers.get(msg.get("event"))
+            result = None
+            if handler is not None:
+                # run in executor: handlers do jax work and take locks
+                try:
+                    result = await self._loop.run_in_executor(
+                        None, handler, client_id, msg.get("payload")
+                    )
+                except Exception as e:
+                    # a failing handler must not kill the connection
+                    print(f"[transport] handler {msg.get('event')!r} error: {e!r}",
+                          flush=True)
+                    result = None
+            if "msg_id" in msg:
+                await endpoint._send(
+                    {"event": "__ack__", "ack_id": msg["msg_id"], "result": result}
+                )
+
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                msg = decode(frame)
+                if msg.get("event") == "__ack__":
+                    endpoint.handle_ack(msg)
+                    continue
+                # fire-and-track: the read loop must stay responsive — a
+                # handler that blocks waiting for a peer ack would otherwise
+                # deadlock the connection (the ack frame would sit unread)
+                self._loop.create_task(dispatch(msg))
+        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+            pass
+        except ValueError as e:
+            # malformed frame (port scanner, protocol mismatch): drop quietly
+            print(f"[transport] closing client {client_id[:8]}: {e}", flush=True)
+        finally:
+            self._clients.pop(client_id, None)
+            writer.close()
+            if self.on_disconnect:
+                def _safe_disconnect(cid=client_id):
+                    try:
+                        self.on_disconnect(cid)
+                    except Exception as e:
+                        print(f"[transport] on_disconnect error: {e!r}", flush=True)
+
+                self._loop.run_in_executor(None, _safe_disconnect)
+
+    # -- sending -----------------------------------------------------------
+
+    def emit_to(self, client_id: str, event: str, payload: Any) -> None:
+        endpoint = self._clients.get(client_id)
+        if endpoint is None:
+            raise KeyError(f"no such client {client_id}")
+        asyncio.run_coroutine_threadsafe(
+            endpoint.emit_async(event, payload), self._loop
+        ).result(ACK_TIMEOUT_S)
+
+    def broadcast(self, event: str, payload: Any) -> None:
+        """Send to every connected client (reference ``sockets.emit``)."""
+        for client_id in list(self._clients):
+            try:
+                self.emit_to(client_id, event, payload)
+            except Exception:
+                pass  # client raced a disconnect; its work will be requeued
+
+    @property
+    def num_clients(self) -> int:
+        return len(self._clients)
+
+
+class ClientTransport:
+    """Spoke endpoint: dials the server, receives events, uploads with ack."""
+
+    def __init__(self, address: str):
+        host, _, port = address.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._endpoint: Optional[_Endpoint] = None
+        self._handlers: Dict[str, Callable[[Any], None]] = {}
+        self._connected = threading.Event()
+        self._stopped = False
+
+    def on(self, event: str, handler: Callable[[Any], None]) -> None:
+        self._handlers[event] = handler
+
+    def connect(self, timeout: float = CONNECT_TIMEOUT_S) -> "ClientTransport":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if not self._connected.wait(timeout):
+            raise TimeoutError(f"could not connect to {self.host}:{self.port}")
+        return self
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def main():
+            reader, writer = await asyncio.open_connection(self.host, self.port)
+            self._endpoint = _Endpoint(self._loop, writer)
+            self._connected.set()
+            async def dispatch(msg):
+                handler = self._handlers.get(msg.get("event"))
+                if handler is not None:
+                    try:
+                        await self._loop.run_in_executor(
+                            None, handler, msg.get("payload")
+                        )
+                    except Exception as e:
+                        print(f"[transport] client handler "
+                              f"{msg.get('event')!r} error: {e!r}", flush=True)
+
+            try:
+                while True:
+                    frame = await _read_frame(reader)
+                    msg = decode(frame)
+                    if msg.get("event") == "__ack__":
+                        self._endpoint.handle_ack(msg)
+                        continue
+                    # fire-and-track, same deadlock-avoidance as the server
+                    self._loop.create_task(dispatch(msg))
+            except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+                pass
+            except ValueError as e:
+                print(f"[transport] closing connection: {e}", flush=True)
+            finally:
+                writer.close()
+
+        try:
+            self._loop.run_until_complete(main())
+        finally:
+            self._loop.close()
+
+    def request(self, event: str, payload: Any, timeout: float = ACK_TIMEOUT_S) -> Any:
+        """Emit with ack (reference ``uploadVars``' 5 s reject timer)."""
+        if self._endpoint is None:
+            raise RuntimeError("not connected")
+        fut = asyncio.run_coroutine_threadsafe(
+            self._endpoint.request_async(event, payload, timeout), self._loop
+        )
+        return fut.result(timeout + 1.0)
+
+    def emit(self, event: str, payload: Any) -> None:
+        if self._endpoint is None:
+            raise RuntimeError("not connected")
+        asyncio.run_coroutine_threadsafe(
+            self._endpoint.emit_async(event, payload), self._loop
+        ).result(ACK_TIMEOUT_S)
+
+    def close(self) -> None:
+        if self._loop is None or self._loop.is_closed():
+            return
+        loop = self._loop
+
+        def _shutdown():
+            for task in asyncio.all_tasks(loop):
+                task.cancel()
+
+        try:
+            loop.call_soon_threadsafe(_shutdown)
+        except RuntimeError:
+            return  # loop closed between the check and the call
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -37,6 +37,9 @@ def _weighted_mean(per_example: jnp.ndarray, weight: Optional[jnp.ndarray]) -> j
     if weight is None:
         return jnp.mean(per_example)
     weight = weight.astype(per_example.dtype)
+    if weight.ndim < per_example.ndim:  # e.g. [B] weights over [B, S] token losses
+        weight = weight.reshape(weight.shape + (1,) * (per_example.ndim - weight.ndim))
+    weight = jnp.broadcast_to(weight, per_example.shape)
     return jnp.sum(per_example * weight) / jnp.maximum(jnp.sum(weight), 1e-9)
 
 

@@ -1,0 +1,192 @@
+"""Transformer LM: the long-context / multi-axis-parallel flagship.
+
+No reference equivalent (the reference stops at MLP/ConvNet classifiers,
+SURVEY.md §2.3) — this model exists because long-context and multi-axis
+parallelism are first-class in this framework. The parameter layout is
+designed for the sharding rule table (``distriflow_tpu/parallel/sharding.py``):
+
+- ``q_proj/k_proj/v_proj`` and ``wi`` kernels column-shard over ``model`` (TP);
+- ``o_proj`` and ``wo`` kernels row-shard over ``model``;
+- MoE expert kernels carry a leading experts dim sharded over ``expert`` (EP);
+- activations seq-shard over ``seq`` and attention runs as a ring
+  (``distriflow_tpu/parallel/ring_attention.py``) when a mesh is attached (SP);
+- the batch dim shards over ``data`` (DP) as everywhere else;
+- layers are grouped into ``pipe``-many stages for pipeline scheduling
+  (``distriflow_tpu/parallel/pipeline.py``).
+
+Compute dtype defaults to bfloat16 (MXU-native); accumulation and softmax
+stay float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from distriflow_tpu.models.base import ModelSpec
+from distriflow_tpu.parallel.ring_attention import blockwise_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 2048
+    n_experts: int = 0  # 0 = dense FFN; >0 = MoE with EP-shardable experts
+    dtype: Any = jnp.bfloat16
+    use_ring_attention: bool = False
+    causal: bool = True
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        b, s, _ = x.shape
+        head_dim = cfg.d_model // cfg.n_heads
+        dense = lambda name: nn.DenseGeneral(
+            (cfg.n_heads, head_dim), axis=-1, name=name, dtype=cfg.dtype,
+            use_bias=False,
+        )
+        q = dense("q_proj")(x)  # [B, S, H, D]
+        k = dense("k_proj")(x)
+        v = dense("v_proj")(x)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, S, D]
+        if cfg.use_ring_attention and self.mesh is not None and self.mesh.shape["seq"] > 1:
+            out = ring_attention(q, k, v, self.mesh, axis="seq", causal=cfg.causal)
+        else:
+            out = blockwise_attention(q, k, v, causal=cfg.causal)
+        out = out.transpose(0, 2, 1, 3)  # [B, S, H, D]
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype, use_bias=False
+        )(out)
+
+
+class DenseFFN(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        h = nn.Dense(cfg.d_ff, name="wi", dtype=cfg.dtype, use_bias=False)(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, name="wo", dtype=cfg.dtype, use_bias=False)(h)
+
+
+class MoEFFN(nn.Module):
+    """Soft top-1 MoE: every expert computes, gate weights select.
+
+    Round-1 implementation: dense dispatch (all tokens through all experts,
+    gated) — exact, simple, and the expert params carry a leading experts dim
+    shardable over the ``expert`` axis. A capacity-based all-to-all dispatch
+    is the planned optimization.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        e = cfg.n_experts
+        gates = nn.Dense(e, name="router", dtype=jnp.float32)(x.astype(jnp.float32))
+        probs = jax.nn.softmax(gates, axis=-1)  # [B, S, E]
+        top = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype)
+        # straight-through: hard routing forward, soft gradient
+        dispatch = top + probs - lax_stop(probs)  # [B, S, E]
+        wi = self.param(
+            "experts_wi",
+            nn.initializers.lecun_normal(),
+            (e, cfg.d_model, cfg.d_ff),
+            jnp.float32,
+        ).astype(cfg.dtype)
+        wo = self.param(
+            "experts_wo",
+            nn.initializers.lecun_normal(),
+            (e, cfg.d_ff, cfg.d_model),
+            jnp.float32,
+        ).astype(cfg.dtype)
+        h = jnp.einsum("bsd,edf->bsef", x, wi)
+        h = nn.gelu(h)
+        out = jnp.einsum("bsef,efd->bsed", h, wo)
+        return jnp.einsum("bsed,bse->bsd", out, dispatch.astype(cfg.dtype))
+
+
+def lax_stop(x):
+    return jax.lax.stop_gradient(x)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        h = nn.LayerNorm(name="ln_attn", dtype=jnp.float32)(x)
+        x = x + Attention(cfg, self.mesh, name="attn")(h)
+        h = nn.LayerNorm(name="ln_mlp", dtype=jnp.float32)(x)
+        ffn = MoEFFN(cfg, name="moe") if cfg.n_experts > 0 else DenseFFN(cfg, name="mlp")
+        return x + ffn(h)
+
+
+class TransformerLM(nn.Module):
+    config: TransformerConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed",
+                     dtype=cfg.dtype)(tokens)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, self.mesh, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
+        logits = nn.Dense(cfg.vocab_size, name="lm_head", dtype=cfg.dtype,
+                          use_bias=False)(x)
+        return logits.astype(jnp.float32)
+
+
+def transformer_lm(
+    config: Optional[TransformerConfig] = None,
+    mesh: Optional[Mesh] = None,
+    example_seq: int = 128,
+    example_batch: Optional[int] = None,
+    **overrides: Any,
+) -> ModelSpec:
+    """ModelSpec for the causal LM. ``x`` = int32 tokens ``[B, S]``; ``y`` =
+    one-hot next-token targets ``[B, S, V]`` (softmax CE loss).
+
+    ``example_batch`` sizes the init-trace dummy; with ring attention on a
+    mesh it must be divisible by the ``data`` axis (defaults to exactly that).
+    """
+    if config is None:
+        config = TransformerConfig(**overrides)
+    elif overrides:
+        config = dataclasses.replace(config, **overrides)
+    module = TransformerLM(config, mesh)
+    if example_batch is None:
+        example_batch = mesh.shape["data"] if mesh is not None else 1
+
+    def init(rng: jax.Array) -> Any:
+        dummy = jnp.zeros((example_batch, example_seq), jnp.int32)
+        return module.init(rng, dummy)
+
+    return ModelSpec(
+        init=init,
+        apply=module.apply,
+        loss="softmax_cross_entropy",
+        input_shape=(example_seq,),
+        output_shape=(config.vocab_size,),
+        name="transformer_lm",
+    )

@@ -25,6 +25,20 @@ from jax import shard_map
 AxisName = Union[str, Sequence[str]]
 
 
+def pvary(tree: Any, axis: AxisName) -> Any:
+    """Mark a replicated-typed pytree as axis-varying inside shard_map.
+
+    Critical for per-worker autodiff: differentiating a varying loss w.r.t.
+    unvarying params makes JAX insert an implicit psum over the axis — the
+    "local" gradient silently becomes the global sum. Cast params varying
+    first and each worker gets its own gradient.
+    """
+    cast = getattr(lax, "pcast", None)
+    if cast is not None:
+        return jax.tree.map(lambda x: cast(x, axis, to="varying"), tree)
+    return jax.tree.map(lambda x: lax.pvary(x, axis), tree)
+
+
 def psum(tree: Any, axis: AxisName) -> Any:
     """Sum-allreduce a pytree over a mesh axis (inside shard_map/pmap)."""
     return jax.tree.map(lambda x: lax.psum(x, axis), tree)

@@ -1,0 +1,108 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis (GPipe-style SPMD).
+
+No reference equivalent (model parallelism is explicitly out of scope there,
+``README.md:4``); provided as a first-class strategy here. The implementation
+is the SPMD collective-permute pipeline:
+
+- stage parameters carry a leading stages dim sharded over ``pipe`` — every
+  device holds one stage's weights;
+- the input batch is split into M microbatches; the schedule runs
+  ``M + P - 1`` ticks. Each tick, every device runs the (identical) stage
+  function on the activation it holds, then ``ppermute``s its output one hop
+  down the ring; stage 0 injects microbatch ``t`` and the last stage banks
+  its outputs. Bubbles (ticks where a stage has no real work) execute with
+  zeros — the standard SPMD trade for lockstep scheduling;
+- activations must keep one shape through stages (true for transformer
+  blocks), which is what lets a single jitted program express the schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distriflow_tpu.parallel.collectives import pvary
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pipe",
+) -> jnp.ndarray:
+    """Run ``x`` through P pipeline stages of ``stage_fn``.
+
+    ``stacked_params``: pytree whose leaves have leading dim P (stage i's
+    params at index i), sharded (or shardable) over ``axis``. ``x``:
+    ``[B, ...]`` with ``B`` divisible by ``num_microbatches``; output has
+    ``x``'s shape (activation shape is stage-invariant).
+    """
+    p = mesh.shape[axis]
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_stages != p:
+        raise ValueError(
+            f"stacked_params has {n_stages} stages but the {axis!r} axis has "
+            f"{p} devices — shard_map would silently drop stages"
+        )
+    mb = b // m
+    xs = x.reshape((m, mb) + x.shape[1:])
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def local(params, xs):
+        params = jax.tree.map(lambda v: v[0], params)  # my stage's slice
+        xs = xs  # replicated [M, mb, ...]
+        idx = lax.axis_index(axis)
+        ticks = m + p - 1
+        state = pvary(jnp.zeros_like(xs[0]), axis)  # activation in flight
+        outputs = pvary(jnp.zeros_like(xs), axis)  # banked on the last stage
+
+        def tick(t, carry):
+            state, outputs = carry
+            # stage 0 injects microbatch t (zeros once the batch is drained)
+            inject = jnp.where(t < m, 1, 0)
+            x_in = lax.dynamic_index_in_dim(xs, jnp.minimum(t, m - 1), 0,
+                                            keepdims=False)
+            state = jnp.where((idx == 0) & (inject == 1), x_in, state)
+            out = stage_fn(params, state)
+            # last stage banks microbatch t-(p-1) once the pipe is full
+            out_slot = t - (p - 1)
+            bank = (idx == p - 1) & (out_slot >= 0)
+            outputs = lax.cond(
+                bank,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, out, jnp.maximum(out_slot, 0), 0
+                ),
+                lambda o: o,
+                outputs,
+            )
+            # rotate activations one hop down the ring
+            state = lax.ppermute(out, axis, perm)
+            return state, outputs
+
+        _, outputs = lax.fori_loop(0, ticks, tick, (state, outputs))
+        # replicate the last stage's bank to every pipe member
+        outputs = lax.psum(
+            jnp.where(idx == p - 1, outputs, jnp.zeros_like(outputs)), axis
+        )
+        return outputs
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,  # outputs are made uniform by the final psum
+    )
+    out = fn(stacked_params, xs)
+    return out.reshape((b,) + x.shape[1:])

@@ -24,11 +24,17 @@ Rules = Sequence[Tuple[str, P]]
 REPLICATED_RULES: Rules = ((".*", P()),)
 
 # Megatron-style TP for the transformer in distriflow_tpu/models/transformer.py:
-# attention qkv + mlp-in are column-sharded, attention-out + mlp-out row-sharded.
+# attention qkv + mlp-in are column-sharded, attention-out + mlp-out row-sharded;
+# MoE experts additionally shard their leading experts dim over `expert` (EP).
+# qkv kernels are [d_model, heads, head_dim] (heads shard over `model`);
+# o_proj is [heads, head_dim, d_model] (heads shard -> row-parallel).
 TRANSFORMER_TP_RULES: Rules = (
+    (r".*experts_wi", P("expert", None, "model")),
+    (r".*experts_wo", P("expert", "model", None)),
+    (r".*router.*", P()),
     (r".*(q_proj|k_proj|v_proj|wi|gate).*kernel", P(None, "model")),
     (r".*(o_proj|wo).*kernel", P("model", None)),
-    (r".*embed.*", P(None, "model")),
+    (r".*(embed|lm_head).*", P(None, "model")),
     (r".*(bias|scale)", P()),
     (r".*", P()),
 )

@@ -1,0 +1,25 @@
+"""Server layer: orchestrators for the wire-served training modes.
+
+Re-exports mirror the reference ``src/server/index.ts:1-5``.
+"""
+
+from distriflow_tpu.server.abstract_server import AbstractServer, DistributedServerConfig
+from distriflow_tpu.server.async_server import AsynchronousSGDServer
+from distriflow_tpu.server.federated_server import FederatedServer
+from distriflow_tpu.server.models import (
+    DistributedServerCheckpointedModel,
+    DistributedServerInMemoryModel,
+    DistributedServerModel,
+    is_server_model,
+)
+
+__all__ = [
+    "AbstractServer",
+    "DistributedServerConfig",
+    "AsynchronousSGDServer",
+    "FederatedServer",
+    "DistributedServerCheckpointedModel",
+    "DistributedServerInMemoryModel",
+    "DistributedServerModel",
+    "is_server_model",
+]

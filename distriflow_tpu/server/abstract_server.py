@@ -1,0 +1,161 @@
+"""Abstract server: shared orchestration for the two wire-serving modes.
+
+Re-design of the reference ``AbstractServer`` (``src/server/abstract_server.ts``):
+holds the server model, the transport, client/update counters, the update
+buffer, the ``updating`` re-entrancy flag, ``compute_download_msg`` (weights +
+version + server-pushed client hyperparams), ``on_new_version``/``on_upload``
+callback registries, and log/time utilities.
+
+On TPU, these wire-serving servers exist for the *multi-process* deployments
+(federated clients holding their own data; cross-host async coordination).
+Single-process pod training should use the engines in ``distriflow_tpu.train``
+directly — weights never leave the devices there.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+from distriflow_tpu.models.base import DistributedModel
+from distriflow_tpu.comm.transport import ServerTransport
+from distriflow_tpu.server.models import (
+    DistributedServerCheckpointedModel,
+    DistributedServerModel,
+    is_server_model,
+)
+from distriflow_tpu.utils.config import (
+    ClientHyperparams,
+    ServerHyperparams,
+    asdict,
+    client_hyperparams,
+    server_hyperparams,
+)
+from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
+from distriflow_tpu.utils.messages import DownloadMsg, Events, ModelMsg, UploadMsg
+from distriflow_tpu.utils.serialization import SerializedArray, serialize_tree
+
+DEFAULT_SAVE_DIR = "./saved-models"  # reference federated_server.ts:37-43
+
+
+@dataclasses.dataclass
+class DistributedServerConfig:
+    """Reference ``DistributedServerConfig`` (``abstract_server.ts:24-31``)."""
+
+    client_hyperparams: Optional[Dict[str, Any]] = None
+    server_hyperparams: Optional[Dict[str, Any]] = None
+    save_dir: str = DEFAULT_SAVE_DIR
+    verbose: Optional[bool] = None
+    host: str = "127.0.0.1"
+    port: int = 0
+
+
+class AbstractServer:
+    """Shared mechanics of FederatedServer/AsynchronousSGDServer."""
+
+    def __init__(
+        self,
+        model: DistributedModel | DistributedServerModel,
+        config: Optional[DistributedServerConfig] = None,
+        transport: Optional[ServerTransport] = None,
+    ):
+        self.config = config or DistributedServerConfig()
+        # wrap bare models into a checkpointed server model under save_dir
+        # (reference federated_server.ts:31-43 auto-wrap)
+        if is_server_model(model):
+            self.model = model
+        else:
+            self.model = DistributedServerCheckpointedModel(model, self.config.save_dir)
+        self.client_hyperparams: ClientHyperparams = client_hyperparams(
+            self.config.client_hyperparams
+        )
+        self.hyperparams: ServerHyperparams = server_hyperparams(
+            self.config.server_hyperparams
+        )
+        self.transport = transport or ServerTransport(self.config.host, self.config.port)
+        self.logger = VerboseLogger(type(self).__name__, self.config.verbose)
+        self.callbacks = CallbackRegistry("new_version", "upload", "connect", "disconnect")
+
+        self.num_clients = 0
+        self.num_updates = 0
+        self.updates: List[Dict[str, SerializedArray]] = []  # reference :41
+        self.updating = False  # re-entrancy flag, reference :42
+        self._lock = threading.Lock()
+        self.download_msg: Optional[DownloadMsg] = None
+
+    # -- observability (reference abstract_server.ts:67-103) ---------------
+
+    def on_new_version(self, fn) -> None:
+        self.callbacks.register("new_version", fn)
+
+    def on_upload(self, fn) -> None:
+        self.callbacks.register("upload", fn)
+
+    def log(self, *args: Any) -> None:
+        self.logger.log(*args)
+
+    def time(self, msg: str):
+        return self.logger.time(msg)
+
+    # -- download message ---------------------------------------------------
+
+    def compute_download_msg(self) -> DownloadMsg:
+        """Serialize current weights + version + pushed hyperparams
+        (reference ``abstract_server.ts:81-89``)."""
+        return DownloadMsg(
+            model=ModelMsg(
+                version=self.model.version,
+                vars=serialize_tree(self.model.get_params()),
+            ),
+            hyperparams=asdict(self.client_hyperparams),
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def setup(self) -> None:
+        with self.time("model setup"):
+            self.model.setup()
+        self.download_msg = self.compute_download_msg()
+        self.transport.on_connect = self._on_connect
+        self.transport.on_disconnect = self._on_disconnect
+        self.transport.on(Events.Upload.value, self._on_upload_wire)
+        self.transport.start()
+        self.log(f"serving on {self.transport.address}")
+
+    def stop(self) -> None:
+        self.transport.stop()
+
+    @property
+    def address(self) -> str:
+        return self.transport.address
+
+    # -- hooks for subclasses ------------------------------------------------
+
+    def _on_connect(self, client_id: str) -> None:
+        self.num_clients += 1
+        self.log(f"connection: {self.num_clients} clients")
+        self.callbacks.fire("connect", client_id)
+        self.handle_connection(client_id)
+
+    def _on_disconnect(self, client_id: str) -> None:
+        self.num_clients -= 1
+        self.log(f"disconnection: {self.num_clients} clients")
+        self.callbacks.fire("disconnect", client_id)
+        self.handle_disconnection(client_id)
+
+    def _on_upload_wire(self, client_id: str, payload: Any) -> Any:
+        msg = UploadMsg.from_wire(payload)
+        if msg.metrics is not None:
+            self.log(f"client {msg.client_id} metrics: {msg.metrics}")
+        self.callbacks.fire("upload", msg)
+        return self.handle_upload(client_id, msg)
+
+    def handle_connection(self, client_id: str) -> None:
+        raise NotImplementedError
+
+    def handle_disconnection(self, client_id: str) -> None:
+        pass
+
+    def handle_upload(self, client_id: str, msg: UploadMsg) -> Any:
+        raise NotImplementedError

@@ -1,0 +1,160 @@
+"""Asynchronous-SGD server (data-dispatching).
+
+Re-design of the reference ``AsynchronousSGDServer``
+(``src/server/asynchronousSGD_server.ts``): owns a ``DistributedDataset``;
+on connection sends weights + the client's first batch; on upload acks,
+completes the batch, applies the gradient, and sends the NEXT batch.
+
+Two deliberate fixes over the reference:
+
+- **per-worker dispatch**: the next batch goes only to the uploading client
+  (the reference broadcasts it to ALL sockets so every worker races on the
+  same batch, ``:75-79``);
+- **bounded staleness**: gradients older than ``maximum_staleness`` versions
+  are rejected instead of applied blindly (the reference applies immediately
+  with no check, ``:95-108``; its README promises ``maximumStaleness``).
+
+A disconnecting client's outstanding batch is requeued (failure recovery the
+reference lacks — lost batches there are only re-served on epoch wrap).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from distriflow_tpu.data.dataset import DistributedDataset, batch_to_data_msg
+from distriflow_tpu.models.base import DistributedModel
+from distriflow_tpu.server.abstract_server import AbstractServer, DistributedServerConfig
+from distriflow_tpu.server.models import DistributedServerModel
+from distriflow_tpu.comm.transport import ServerTransport
+from distriflow_tpu.utils.messages import DownloadMsg, Events, UploadMsg
+from distriflow_tpu.utils.serialization import deserialize_tree
+
+
+class AsynchronousSGDServer(AbstractServer):
+    def __init__(
+        self,
+        model: DistributedModel | DistributedServerModel,
+        dataset: DistributedDataset,
+        config: Optional[DistributedServerConfig] = None,
+        transport: Optional[ServerTransport] = None,
+    ):
+        super().__init__(model, config, transport)
+        self.dataset = dataset
+        self.version_counter = 0  # integer staleness clock
+        self._client_versions: Dict[str, int] = {}
+        self._client_batches: Dict[str, int] = {}  # outstanding batch per client
+        self._waiting: set = set()  # starved clients awaiting redispatch
+        self._completion_sent = False
+        self.applied_updates = 0
+        self.rejected_updates = 0
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _send_next_batch(self, client_id: str) -> bool:
+        """Pop the next batch and send weights+data to ONE client.
+
+        A starved client (all remaining work outstanding elsewhere) is parked
+        in ``_waiting`` and re-dispatched as soon as an ack/requeue frees
+        work; on exhaustion, completion is broadcast to every parked client —
+        without this, any multi-client run would hang its stragglers."""
+        batch = self.dataset.next(timeout=0.0)
+        if batch is None:
+            if self.dataset.exhausted:
+                try:  # tell this client directly (covers late joiners), then all
+                    self.transport.emit_to(client_id, "trainingComplete", {})
+                except KeyError:
+                    pass
+                self._broadcast_complete()
+                return False
+            with self._lock:
+                self._waiting.add(client_id)
+            return False
+        with self._lock:
+            self._client_batches[client_id] = batch.batch
+            self._client_versions[client_id] = self.version_counter
+            self._waiting.discard(client_id)
+        msg = DownloadMsg(
+            model=self.download_msg.model,
+            hyperparams=self.download_msg.hyperparams,
+            data=batch_to_data_msg(batch),
+        )
+        self.transport.emit_to(client_id, Events.Download.value, msg.to_wire())
+        return True
+
+    def _dispatch_waiting(self) -> None:
+        """Give parked clients another shot at the queue."""
+        with self._lock:
+            waiting = list(self._waiting)
+        for client_id in waiting:
+            try:
+                self._send_next_batch(client_id)
+            except KeyError:
+                with self._lock:  # client disconnected while parked
+                    self._waiting.discard(client_id)
+
+    def _broadcast_complete(self) -> None:
+        with self._lock:
+            if self._completion_sent:
+                return
+            self._completion_sent = True
+        self.transport.broadcast("trainingComplete", {})
+
+    def handle_connection(self, client_id: str) -> None:
+        # weights + first batch to the new client (reference :59-63)
+        self._send_next_batch(client_id)
+
+    def handle_disconnection(self, client_id: str) -> None:
+        # failure recovery: requeue the batch the client died holding
+        with self._lock:
+            outstanding = self._client_batches.pop(client_id, None)
+            self._client_versions.pop(client_id, None)
+            self._waiting.discard(client_id)
+        if outstanding is not None:
+            self.dataset.requeue(outstanding)
+            self.log(f"requeued batch {outstanding} from dead client")
+            self._dispatch_waiting()
+
+    # -- upload ------------------------------------------------------------
+
+    def handle_upload(self, client_id: str, msg: UploadMsg) -> bool:
+        if msg.batch is not None:
+            self.dataset.complete_batch(msg.batch)  # ack first (reference :72)
+            with self._lock:
+                if self._client_batches.get(client_id) == msg.batch:
+                    self._client_batches.pop(client_id, None)
+        accepted = False
+        if msg.gradients is not None:
+            accepted = self._apply(client_id, msg)
+        # hand the next batch to THIS client only (fixed dispatch), then give
+        # parked clients a chance at whatever the ack freed up
+        self._send_next_batch(client_id)
+        self._dispatch_waiting()
+        return accepted
+
+    def _apply(self, client_id: str, msg: UploadMsg) -> bool:
+        with self._lock:
+            sent_version = self._client_versions.get(client_id, self.version_counter)
+            staleness = self.version_counter - sent_version
+            if staleness > self.hyperparams.maximum_staleness:
+                self.rejected_updates += 1
+                self.log(
+                    f"rejected update from {msg.client_id}: staleness {staleness} > "
+                    f"{self.hyperparams.maximum_staleness}"
+                )
+                return False
+            decay = self.hyperparams.staleness_decay**staleness
+            template = self.model.get_params()
+            grads = deserialize_tree(msg.gradients.vars, template)
+            if decay != 1.0:
+                grads = jax.tree.map(lambda g: g * decay, grads)
+            with self.time("updating model"):
+                self.model.update(grads)
+                self.model.save()  # reference saves every step (:105)
+                self.version_counter += 1
+                self.applied_updates += 1
+                self.download_msg = self.compute_download_msg()
+        self.callbacks.fire("new_version", self.model.version)
+        return True

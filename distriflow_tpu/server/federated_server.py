@@ -1,0 +1,115 @@
+"""Federated (gradient-mean) server.
+
+Re-design of the reference ``FederatedServer`` (``src/server/federated_server.ts``):
+on connection, send current weights; on upload, drop stale gradients, buffer
+the rest; once ``min_updates_per_version`` arrive, aggregate (mean), apply,
+checkpoint, and broadcast the new version to all clients.
+
+Staleness: the reference's rule is exact-version-match-or-drop (staleness 0,
+``federated_server.ts:73``). Here the rule generalizes to
+``maximum_staleness`` versions with optional ``staleness_decay`` weighting —
+staleness-0 drop is the default config, preserving reference behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from distriflow_tpu.server.abstract_server import AbstractServer
+from distriflow_tpu.utils.messages import Events, UploadMsg
+from distriflow_tpu.utils.serialization import (
+    SerializedArray,
+    deserialize_tree,
+    stack_serialized,
+)
+
+
+def _scale_serialized(
+    vars_: Dict[str, SerializedArray], scale: float
+) -> Dict[str, SerializedArray]:
+    """Scale serialized gradients (staleness decay) without changing dtype."""
+    from distriflow_tpu.utils.serialization import deserialize_array, serialize_array
+
+    out = {}
+    for k, s in vars_.items():
+        arr = deserialize_array(s)
+        out[k] = serialize_array((arr * scale).astype(arr.dtype))
+    return out
+
+
+class FederatedServer(AbstractServer):
+    def handle_connection(self, client_id: str) -> None:
+        # send current weights (reference :69)
+        self.transport.emit_to(client_id, Events.Download.value, self.download_msg.to_wire())
+
+    def handle_upload(self, client_id: str, msg: UploadMsg) -> bool:
+        """Buffer or drop one gradient upload; maybe aggregate.
+
+        Returns the ack value (the reference acks ``true`` unconditionally at
+        ``:72``; we ack whether the gradient was accepted)."""
+        if msg.gradients is None:
+            return False
+        with self._lock:
+            try:
+                staleness = self._staleness(msg.gradients.version)
+            except ValueError:
+                self.log(f"dropping upload with unknown version {msg.gradients.version!r}")
+                return False
+            if staleness > self.hyperparams.maximum_staleness or self.updating:
+                # reference drop rule :73 (exact-version + !updating), generalized
+                return False
+            decay = self.hyperparams.staleness_decay**staleness
+            vars_ = msg.gradients.vars
+            if decay != 1.0:
+                vars_ = _scale_serialized(vars_, decay)
+            self.updates.append(vars_)
+            self.num_updates += 1
+            should_aggregate = len(self.updates) >= self.hyperparams.min_updates_per_version
+            if should_aggregate:
+                self.updating = True
+        if should_aggregate:
+            try:
+                self.update_model()
+            finally:
+                self.updating = False
+        return True
+
+    def _staleness(self, version: str) -> int:
+        """Versions are the server model's save tokens; the distance is
+        tracked via the version history ring."""
+        history = getattr(self, "_version_history", None)
+        if history is None:
+            history = self._version_history = []
+        current = self.model.version
+        if not history or history[-1] != current:
+            history.append(current)
+        if version == current:
+            return 0
+        try:
+            idx = history.index(version)
+        except ValueError:
+            raise ValueError(f"unknown version {version!r}")
+        return len(history) - 1 - idx
+
+    def update_model(self) -> None:
+        """Aggregate buffered updates and publish a new version
+        (reference ``updateModel``, ``federated_server.ts:92-117``)."""
+        with self.time("computing new weights"):
+            with self._lock:
+                updates, self.updates = self.updates, []
+            stacked = stack_serialized(updates)
+            template = self.model.get_params()
+            stacked_tree = deserialize_tree(
+                stacked, template, strict_shapes=False
+            )
+            import jax
+
+            mean_grads = jax.tree.map(lambda s: s.mean(axis=0), stacked_tree)
+            self.model.update(mean_grads)
+            self.model.save()
+            self.download_msg = self.compute_download_msg()
+        self.callbacks.fire("new_version", self.model.version)
+        # broadcast new weights to everyone (reference :80)
+        self.transport.broadcast(Events.Download.value, self.download_msg.to_wire())

@@ -1,0 +1,7 @@
+"""Training engines: sync SPMD, async with bounded staleness, federated averaging."""
+
+from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
+from distriflow_tpu.train.federated import FederatedAveragingTrainer
+from distriflow_tpu.train.sync import SyncTrainer, TrainState
+
+__all__ = ["AsyncSGDTrainer", "FederatedAveragingTrainer", "SyncTrainer", "TrainState"]

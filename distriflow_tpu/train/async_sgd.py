@@ -1,0 +1,203 @@
+"""Asynchronous SGD with real bounded staleness.
+
+Re-design of the reference's async mode (``src/server/asynchronousSGD_server.ts``
++ ``asynchronousSGD_client.ts``): the server hands out batches
+first-come-first-serve, every worker computes gradients against the weights
+it last saw, and the server applies each incoming gradient immediately and
+broadcasts new weights. The reference applies with **no staleness check at
+all** (``asynchronousSGD_server.ts:95-108``) despite its README promising a
+``maximumStaleness`` knob (``README.md:27``) — here bounded staleness is
+implemented for real:
+
+- every gradient is tagged with the model version it was computed against;
+- staleness = current_version - gradient_version;
+- staleness > ``maximum_staleness``  ->  the gradient is REJECTED (dropped);
+- otherwise it is applied scaled by ``staleness_decay ** staleness``
+  (decay 1.0 = reference-style raw apply).
+
+TPU mapping (SURVEY.md §7 hard part (a)): XLA wants lockstep SPMD, so the
+asynchrony lives at the host layer. Parameters are device-resident; each
+worker owns a device (or device subset), pulls the current weights
+device-to-device, computes grads with a jit-compiled step on its own device,
+and pushes grads back; the server thread serializes apply-side updates under
+a lock. Nothing crosses a wire — "upload" is an ICI/D2D transfer, and the
+per-step serialize+broadcast of the reference disappears.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.models.base import ModelSpec, _optimizer
+from distriflow_tpu.utils.config import ServerHyperparams, server_hyperparams
+from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
+
+Params = Any
+
+
+class AsyncSGDTrainer:
+    """Host-coordinated async SGD over N single-device workers."""
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        dataset: DistributedDataset,
+        devices: Optional[Sequence[jax.Device]] = None,
+        learning_rate: float = 0.001,
+        optimizer: str = "sgd",
+        hyperparams: Optional[Dict[str, Any] | ServerHyperparams] = None,
+        verbose: Optional[bool] = None,
+    ):
+        self.spec = spec
+        self.dataset = dataset
+        self.devices = list(devices if devices is not None else jax.devices())
+        if isinstance(hyperparams, ServerHyperparams):
+            self.hyperparams = hyperparams.validate()
+        else:
+            self.hyperparams = server_hyperparams(hyperparams)
+        self.optimizer = _optimizer(optimizer, learning_rate)
+        self.logger = VerboseLogger(f"AsyncSGD[{spec.name}]", verbose)
+        self.callbacks = CallbackRegistry("new_version", "upload")
+
+        self.params: Optional[Params] = None
+        self._opt_state = None
+        self.version = 0
+        self.applied_updates = 0
+        self.rejected_updates = 0
+        self._lock = threading.Lock()
+
+        # per-device jitted grad fns (one compilation, placed per device)
+        self._grad_fn = jax.value_and_grad(spec.loss_fn)
+
+        def _apply(params, opt_state, grads, scale):
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            updates, new_opt = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        # NOTE: no donation — workers hold references to the params from
+        # snapshot() while the server applies updates; donating would
+        # invalidate their buffers mid-flight.
+        self._apply_fn = jax.jit(_apply)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init(self, rng: Optional[jax.Array] = None) -> Params:
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        params = self.spec.init(rng)
+        self.params = jax.device_put(params, self.devices[0])
+        self._opt_state = self.optimizer.init(self.params)
+        return self.params
+
+    # -- server side -------------------------------------------------------
+
+    def snapshot(self) -> Tuple[Params, int]:
+        """Current (params, version) — what a worker 'downloads'."""
+        with self._lock:
+            return self.params, self.version
+
+    def submit(self, grads: Params, grad_version: int, client_id: str = "?") -> bool:
+        """Apply one gradient update; returns False if rejected as too stale.
+
+        The reference applies unconditionally (``asynchronousSGD_server.ts:73``);
+        this is the README-promised bounded-staleness version.
+        """
+        with self._lock:
+            staleness = self.version - grad_version
+            if staleness < 0:
+                raise ValueError(f"gradient from the future: v{grad_version} > v{self.version}")
+            if staleness > self.hyperparams.maximum_staleness:
+                self.rejected_updates += 1
+                self.logger.log(
+                    f"rejected update from {client_id}: staleness {staleness} > "
+                    f"{self.hyperparams.maximum_staleness}"
+                )
+                return False
+            scale = self.hyperparams.staleness_decay**staleness
+            # the 'upload': move grads worker-device -> server device (ICI/D2D
+            # on TPU; replaces the reference's serialize-over-websocket)
+            grads = jax.device_put(grads, self.devices[0])
+            self.params, self._opt_state = self._apply_fn(
+                self.params, self._opt_state, grads, jnp.float32(scale)
+            )
+            self.version += 1
+            self.applied_updates += 1
+        self.callbacks.fire("upload", client_id, grad_version)
+        self.callbacks.fire("new_version", str(self.version))
+        return True
+
+    # -- worker side -------------------------------------------------------
+
+    def worker_loop(self, worker_index: int, max_steps: Optional[int] = None) -> int:
+        """One worker: pull weights, pull batch, compute grads on its own
+        device, push grads. Returns the number of batches processed.
+
+        This is the DistriWorker role (reference ``asynchronousSGD_client.ts``
+        ping-pong loop) without the wire: ``snapshot`` is the Download,
+        ``submit`` is the Upload.
+        """
+        device = self.devices[worker_index % len(self.devices)]
+        steps = 0
+        while max_steps is None or steps < max_steps:
+            batch = self.dataset.next(timeout=5.0)
+            if batch is None:
+                if self.dataset.exhausted:
+                    break
+                continue  # starved; re-check
+            try:
+                params, version = self.snapshot()
+                local_params = jax.device_put(params, device)
+                x = jax.device_put(jnp.asarray(batch.x), device)
+                y = jax.device_put(jnp.asarray(batch.y), device)
+                loss, grads = self._grad_fn(local_params, x, y)
+                self.submit(grads, version, client_id=f"worker-{worker_index}")
+            except BaseException:
+                # failure recovery: return the batch to the queue so another
+                # worker picks it up (the redelivery role of reference
+                # dataset.ts:56-60, triggered by actual failure here)
+                self.dataset.requeue(batch.batch)
+                raise
+            # ack regardless of staleness-acceptance: the batch was consumed
+            # (reference acks before applying, asynchronousSGD_server.ts:66-72)
+            self.dataset.complete_batch(batch.batch)
+            steps += 1
+        return steps
+
+    def train(self, num_workers: Optional[int] = None) -> Dict[str, int]:
+        """Run workers over the dataset until exhausted; returns counters."""
+        if self.params is None:
+            self.init()
+        n = num_workers if num_workers is not None else len(self.devices)
+        errors: List[BaseException] = []
+
+        def run(i: int) -> None:
+            try:
+                self.worker_loop(i)
+            except BaseException as e:  # surface worker crashes to the caller
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True) for i in range(n)]
+        with self.logger.time(f"async training with {n} workers"):
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if errors:
+            raise errors[0]
+        return {
+            "applied": self.applied_updates,
+            "rejected": self.rejected_updates,
+            "version": self.version,
+        }
+
+    # -- introspection -----------------------------------------------------
+
+    def evaluate(self, x, y, metrics=("loss", "accuracy")) -> List[float]:
+        fn = jax.jit(self.spec.metrics_fn(list(metrics)))
+        params, _ = self.snapshot()
+        return [float(v) for v in fn(params, jnp.asarray(x), jnp.asarray(y))]

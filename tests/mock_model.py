@@ -1,0 +1,57 @@
+"""MockModel: protocol-level fake (reference ``src/test/mock_model.ts``).
+
+Implements the DistributedModel surface with deterministic tensors and zero
+ML: ``fit`` returns the current params as "gradients" (``mock_model.ts:23-25``),
+``update`` subtracts them scaled by lr (so versions visibly change), and
+``evaluate`` returns ``[0.0]`` (``:43-45``). Exercises protocol/aggregation
+machinery without model compute.
+"""
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from distriflow_tpu.models.base import DistributedModel
+
+
+class MockModel(DistributedModel):
+    def __init__(self, dim: int = 4, lr: float = 0.1):
+        self._params = {"w": np.ones((dim,), np.float32), "b": np.zeros((2,), np.float32)}
+        self.lr = lr
+        self.fit_calls = 0
+        self.update_calls = 0
+
+    def setup(self) -> None:
+        pass
+
+    def fit(self, x, y):
+        self.fit_calls += 1
+        return {k: np.asarray(v).copy() for k, v in self._params.items()}
+
+    def update(self, grads) -> None:
+        self.update_calls += 1
+        self._params = {
+            k: np.asarray(self._params[k] - self.lr * np.asarray(grads[k]), np.float32)
+            for k in self._params
+        }
+
+    def predict(self, x):
+        return jnp.zeros((len(x), 2))
+
+    def evaluate(self, x, y) -> List[float]:
+        return [0.0]
+
+    def get_params(self):
+        return self._params
+
+    def set_params(self, params) -> None:
+        self._params = {k: np.asarray(v, np.float32) for k, v in params.items()}
+
+    @property
+    def input_shape(self):
+        return (4,)
+
+    @property
+    def output_shape(self):
+        return (2,)

@@ -1,0 +1,98 @@
+"""Async-SGD engine tests: staleness bounds, decay, concurrent workers."""
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.models import mnist_mlp
+from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
+
+
+def _data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, n)
+    x[np.arange(n), 0, labels, 0] += 4.0
+    y = np.eye(10, dtype=np.float32)[labels]
+    return x, y
+
+
+def _trainer(n=256, bs=32, epochs=1, seed=0, **kw):
+    x, y = _data(n, seed)
+    ds = DistributedDataset(x, y, {"batch_size": bs, "epochs": epochs})
+    t = AsyncSGDTrainer(mnist_mlp(hidden=16), ds, learning_rate=0.05, **kw)
+    t.init()
+    return t, (x, y)
+
+
+def test_single_worker_processes_all_batches(devices):
+    t, _ = _trainer(n=128, bs=32, epochs=2)
+    counters = t.train(num_workers=1)
+    assert counters["applied"] == 8  # 4 batches x 2 epochs
+    assert counters["rejected"] == 0
+    assert t.version == 8
+
+
+def test_multi_worker_all_batches_consumed(devices):
+    t, (x, y) = _trainer(n=256, bs=16, epochs=2, hyperparams={"maximum_staleness": 100})
+    counters = t.train(num_workers=8)
+    # with a generous staleness bound nothing is rejected, every batch applies
+    assert counters["applied"] == 32
+    assert counters["rejected"] == 0
+
+
+def test_staleness_zero_rejects_concurrent_updates(devices):
+    # strict staleness-0 (the reference federated path's drop rule) with
+    # 8 racing workers must reject most overlapping updates
+    t, _ = _trainer(n=256, bs=16, epochs=2, hyperparams={"maximum_staleness": 0})
+    counters = t.train(num_workers=8)
+    assert counters["applied"] + counters["rejected"] == 32
+    assert counters["applied"] == t.version
+
+
+def test_stale_submit_rejected_manually(devices):
+    t, (x, y) = _trainer(n=64, bs=32, hyperparams={"maximum_staleness": 1})
+    params, v0 = t.snapshot()
+    import jax
+
+    grads = jax.tree.map(lambda p: np.ones_like(p) * 0.01, params)
+    assert t.submit(grads, v0)          # staleness 0: ok
+    assert t.submit(grads, v0)          # staleness 1: ok (bound is 1)
+    assert not t.submit(grads, v0)      # staleness 2: rejected
+    assert t.applied_updates == 2 and t.rejected_updates == 1
+
+
+def test_future_version_raises(devices):
+    t, _ = _trainer()
+    params, v = t.snapshot()
+    import jax
+
+    grads = jax.tree.map(np.zeros_like, params)
+    with pytest.raises(ValueError, match="future"):
+        t.submit(grads, v + 5)
+
+
+def test_staleness_decay_scales_update(devices):
+    import jax
+
+    t, _ = _trainer(hyperparams={"maximum_staleness": 4, "staleness_decay": 0.5})
+    params0, v0 = t.snapshot()
+    p0 = jax.tree.map(np.asarray, params0)
+    ones = jax.tree.map(lambda p: np.ones_like(p), params0)
+    t.submit(ones, v0)  # staleness 0: full lr (0.05)
+    p1 = jax.tree.map(np.asarray, t.snapshot()[0])
+    t.submit(ones, v0)  # staleness 1: decayed by 0.5
+    p2 = jax.tree.map(np.asarray, t.snapshot()[0])
+    d1 = jax.tree.leaves(jax.tree.map(lambda a, b: (a - b).ravel()[0], p0, p1))[0]
+    d2 = jax.tree.leaves(jax.tree.map(lambda a, b: (a - b).ravel()[0], p1, p2))[0]
+    assert d1 == pytest.approx(0.05, rel=1e-4)
+    assert d2 == pytest.approx(0.025, rel=1e-4)
+
+
+def test_async_training_learns(devices):
+    t, (x, y) = _trainer(n=512, bs=32, epochs=6, hyperparams={"maximum_staleness": 8})
+    before = t.evaluate(x, y)
+    t.train(num_workers=4)
+    after = t.evaluate(x, y)
+    assert after[0] < before[0]
+    assert after[1] > 0.8, after

@@ -1,0 +1,123 @@
+"""Transformer flagship: forward/loss sanity, DP+TP+SP sharded training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+from distriflow_tpu.parallel import create_mesh
+from distriflow_tpu.parallel.sharding import TRANSFORMER_TP_RULES
+from distriflow_tpu.train.sync import SyncTrainer
+from distriflow_tpu.utils.config import MeshConfig
+
+TINY = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=32,
+    dtype=jnp.float32,
+)
+
+
+def _lm_batch(b=8, s=32, vocab=64, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, vocab, (b, s + 1))
+    x = jnp.asarray(tokens[:, :-1], jnp.int32)
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[tokens[:, 1:]])
+    return x, y
+
+
+def test_forward_shapes():
+    spec = transformer_lm(TINY, example_seq=32)
+    params = spec.init(jax.random.PRNGKey(0))
+    x, y = _lm_batch()
+    logits = spec.apply(params, x)
+    assert logits.shape == (8, 32, 64)
+    assert logits.dtype == jnp.float32
+    loss = spec.loss_fn(params, x, y)
+    assert np.isfinite(float(loss))
+    # random init => loss near ln(vocab)
+    assert abs(float(loss) - np.log(64)) < 1.0
+
+
+def test_trains_on_fixed_sequence(devices):
+    mesh = create_mesh(MeshConfig(data=8), devices)
+    spec = transformer_lm(TINY, example_seq=32)
+    trainer = SyncTrainer(spec, mesh=mesh, learning_rate=3e-3, optimizer="adam")
+    trainer.init(jax.random.PRNGKey(0))
+    x, y = _lm_batch(b=16)
+    losses = [trainer.step((x, y)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_tp_sharded_matches_replicated(devices):
+    """DP2 x TP2 x SP2 sharded loss == single-device loss (math is mesh-invariant)."""
+    x, y = _lm_batch(b=8)
+    spec = transformer_lm(TINY, example_seq=32)
+
+    mesh_tp = create_mesh(MeshConfig(data=2, model=2, seq=2), devices)
+    t_tp = SyncTrainer(spec, mesh=mesh_tp, learning_rate=0.01,
+                       param_rules=TRANSFORMER_TP_RULES)
+    t_tp.init(jax.random.PRNGKey(1))
+
+    mesh_1 = create_mesh(MeshConfig(), devices[:1])
+    t_1 = SyncTrainer(spec, mesh=mesh_1, learning_rate=0.01)
+    t_1.init(jax.random.PRNGKey(1))
+
+    for step in range(3):
+        l_tp = t_tp.step((x, y))
+        l_1 = t_1.step((x, y))
+        assert l_tp == pytest.approx(l_1, rel=1e-3), (step, l_tp, l_1)
+
+
+def test_param_shardings_applied(devices):
+    mesh = create_mesh(MeshConfig(data=2, model=2, seq=2), devices)
+    spec = transformer_lm(TINY, example_seq=32)
+    t = SyncTrainer(spec, mesh=mesh, param_rules=TRANSFORMER_TP_RULES)
+    t.init()
+    p = t.get_params()["params"]
+    qk = p["layers_0"]["attn"]["q_proj"]["kernel"]
+    # heads dim (axis 1, size 4) sharded over model axis (size 2)
+    assert qk.addressable_shards[0].data.shape[1] == 2
+    wo = p["layers_0"]["mlp"]["wo"]["kernel"]
+    assert wo.addressable_shards[0].data.shape[0] == TINY.d_ff // 2
+
+
+def test_moe_forward_and_ep_sharding(devices):
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        n_experts=4, dtype=jnp.float32,
+    )
+    spec = transformer_lm(cfg, example_seq=16)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 16), jnp.int32)
+    logits = spec.apply(params, x)
+    assert logits.shape == (2, 16, 64)
+
+    mesh = create_mesh(MeshConfig(data=2, model=2, expert=2), devices)
+    t = SyncTrainer(spec, mesh=mesh, param_rules=TRANSFORMER_TP_RULES, learning_rate=1e-3)
+    t.init()
+    wi = t.get_params()["params"]["layers_0"]["moe"]["experts_wi"]
+    assert wi.addressable_shards[0].data.shape[0] == 2  # 4 experts / EP 2
+    # and it trains
+    xb, yb = _lm_batch(b=4, s=16)
+    l0 = t.step((xb, yb))
+    l1 = t.step((xb, yb))
+    assert np.isfinite(l0) and np.isfinite(l1)
+
+
+def test_ring_attention_model_matches_dense_model(devices):
+    """use_ring_attention=True on a seq-sharded mesh == plain blockwise model."""
+    mesh = create_mesh(MeshConfig(seq=8), devices)
+    x, y = _lm_batch(b=2)
+
+    spec_dense = transformer_lm(TINY, example_seq=32)
+    params = spec_dense.init(jax.random.PRNGKey(2))
+    logits_dense = spec_dense.apply(params, x)
+
+    import dataclasses
+
+    cfg_ring = dataclasses.replace(TINY, use_ring_attention=True)
+    spec_ring = transformer_lm(cfg_ring, mesh=mesh, example_seq=32)
+    logits_ring = jax.jit(spec_ring.apply)(params, x)
+    np.testing.assert_allclose(
+        np.asarray(logits_dense), np.asarray(logits_ring), rtol=2e-4, atol=2e-4
+    )

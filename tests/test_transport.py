@@ -1,0 +1,159 @@
+"""Codec + transport tests over loopback (the reference's integration style:
+real transport on localhost, ``src/test/federated_api_test.ts:10-35``)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.comm import ClientTransport, CodecError, ServerTransport, decode, encode
+
+
+# -- codec ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        None,
+        True,
+        False,
+        0,
+        -(2**62),
+        3.14159,
+        "hello ünïcode",
+        b"\x00\x01\xff" * 100,
+        [1, "two", None, [3.0, b"four"]],
+        {"a": 1, "b": {"c": [True, b"bytes", "str"]}, "d": None},
+        {},
+        [],
+    ],
+)
+def test_codec_roundtrip(value):
+    assert decode(encode(value)) == value
+
+
+def test_codec_rejects_bad_input():
+    with pytest.raises(CodecError):
+        encode(object())
+    with pytest.raises(CodecError):
+        decode(b"\xfejunk")
+    with pytest.raises(CodecError):
+        decode(encode({"a": 1}) + b"extra")
+    with pytest.raises(CodecError):
+        decode(encode("hello")[:-2])  # truncated
+
+
+def test_codec_large_binary():
+    blob = np.random.RandomState(0).bytes(1 << 20)
+    msg = {"event": "uploadVars", "payload": {"vars": blob}}
+    out = decode(encode(msg))
+    assert out["payload"]["vars"] == blob
+
+
+# -- transport ------------------------------------------------------------
+
+
+@pytest.fixture
+def server():
+    s = ServerTransport(port=0).start()
+    yield s
+    s.stop()
+
+
+def test_connect_and_download(server):
+    """Server pushes an event on connect; client receives it (the Download
+    handshake, reference abstract_client.ts:166-173)."""
+    received = threading.Event()
+    got = {}
+
+    def on_connect(client_id):
+        server.emit_to(client_id, "downloadVars", {"version": "v1", "blob": b"\x01\x02"})
+
+    server.on_connect = on_connect
+    client = ClientTransport(server.address)
+
+    def on_download(payload):
+        got.update(payload)
+        received.set()
+
+    client.on("downloadVars", on_download)
+    client.connect()
+    assert received.wait(5), "no download within 5s"
+    assert got["version"] == "v1" and got["blob"] == b"\x01\x02"
+    client.close()
+
+
+def test_request_ack_roundtrip(server):
+    served = []
+
+    def on_upload(client_id, payload):
+        served.append(payload["n"])
+        return {"accepted": payload["n"] % 2 == 0}
+
+    server.on("uploadVars", on_upload)
+    client = ClientTransport(server.address).connect()
+    assert client.request("uploadVars", {"n": 2}) == {"accepted": True}
+    assert client.request("uploadVars", {"n": 3}) == {"accepted": False}
+    assert served == [2, 3]
+    client.close()
+
+
+def test_broadcast_reaches_all_clients(server):
+    n = 4
+    events = [threading.Event() for _ in range(n)]
+    clients = []
+    for i in range(n):
+        c = ClientTransport(server.address)
+        c.on("downloadVars", lambda payload, i=i: events[i].set())
+        c.connect()
+        clients.append(c)
+    deadline = time.time() + 5
+    while server.num_clients < n and time.time() < deadline:
+        time.sleep(0.01)
+    server.broadcast("downloadVars", {"version": "v2"})
+    for i, e in enumerate(events):
+        assert e.wait(5), f"client {i} missed broadcast"
+    for c in clients:
+        c.close()
+
+
+def test_disconnect_callback(server):
+    disconnected = threading.Event()
+    server.on_disconnect = lambda cid: disconnected.set()
+    client = ClientTransport(server.address).connect()
+    client.close()
+    assert disconnected.wait(5)
+
+
+def test_connect_timeout():
+    client = ClientTransport("127.0.0.1:1")  # nothing listens on port 1
+    with pytest.raises((TimeoutError, OSError)):
+        client.connect(timeout=1.0)
+
+
+def test_concurrent_uploads(server):
+    lock = threading.Lock()
+    seen = []
+
+    def on_upload(client_id, payload):
+        with lock:
+            seen.append(payload["i"])
+        return True
+
+    server.on("uploadVars", on_upload)
+    clients = [ClientTransport(server.address).connect() for _ in range(4)]
+
+    def hammer(c, base):
+        for k in range(10):
+            assert c.request("uploadVars", {"i": base * 100 + k}) is True
+
+    threads = [threading.Thread(target=hammer, args=(c, i)) for i, c in enumerate(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(seen) == 40
+    for c in clients:
+        c.close()
