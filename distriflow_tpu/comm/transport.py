@@ -183,9 +183,12 @@ class ServerTransport:
                           flush=True)
                     result = None
             if "msg_id" in msg:
-                await endpoint._send(
-                    {"event": "__ack__", "ack_id": msg["msg_id"], "result": result}
-                )
+                try:
+                    await endpoint._send(
+                        {"event": "__ack__", "ack_id": msg["msg_id"], "result": result}
+                    )
+                except (ConnectionError, TimeoutError):
+                    pass  # client closed before the ack; its state is requeued
 
         try:
             while True:

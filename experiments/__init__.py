@@ -1,0 +1,7 @@
+"""Application layer: runnable experiment entrypoints.
+
+Mirror of the reference's ``experiment/`` tree (``experiment/mnist/``,
+SURVEY.md C19) — the thin scripts an end user runs, sitting above the
+``distriflow_tpu`` API the same way the reference's ts-node entrypoints sit
+above ``src/``.
+"""

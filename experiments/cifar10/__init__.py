@@ -1,0 +1,5 @@
+from experiments.cifar10.cifar_data import (  # noqa: F401
+    load_cifar10,
+    synthetic_cifar10,
+    to_xy,
+)

@@ -1,0 +1,122 @@
+"""CIFAR-10 training entrypoint (BASELINE configs #2/#3/#4).
+
+No reference counterpart exists (the reference ships only the MNIST
+experiment); this is the v4-8-targeting workload from BASELINE.md:
+
+- ``--mode sync``      sync-SGD: batch sharded over the mesh's data axis,
+  gradient mean as an in-graph psum (config #2);
+- ``--mode async``     host-coordinated async SGD with bounded staleness
+  (``--max-staleness``, config #3);
+- ``--mode federated`` federated averaging: K local steps per worker +
+  periodic weight pmean (config #4).
+
+Run:  python -m experiments.cifar10.train --mode sync --steps 100
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import numpy as np
+
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.models import cifar_convnet
+from distriflow_tpu.parallel import data_parallel_mesh, shard_batch
+from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
+from distriflow_tpu.train.federated import FederatedAveragingTrainer
+from distriflow_tpu.train.sync import SyncTrainer
+
+from experiments.cifar10.cifar_data import load_splits, to_xy
+
+
+def run_sync(args, spec, train, val) -> float:
+    mesh = data_parallel_mesh()
+    trainer = SyncTrainer(spec, mesh=mesh, learning_rate=args.learning_rate,
+                          optimizer=args.optimizer, verbose=True)
+    trainer.init(jax.random.PRNGKey(args.seed))
+    x, y = to_xy(train)
+    n = len(x)
+    rng = np.random.RandomState(args.seed)
+    start = time.perf_counter()
+    for step in range(args.steps):
+        idx = rng.randint(0, n, args.batch_size)
+        batch = shard_batch(mesh, (x[idx], y[idx]))
+        loss = trainer.step(batch)
+        if step % 20 == 0:
+            print(f"step {step} loss {loss:.4f}", file=sys.stderr)
+    elapsed = time.perf_counter() - start
+    sps = args.steps * args.batch_size / elapsed
+    vx, vy = to_xy(val)
+    val_loss, val_acc = trainer.evaluate(vx[:512], vy[:512])
+    print(f"sync: {sps:.0f} samples/sec, val loss {val_loss:.4f} acc {val_acc:.4f}",
+          file=sys.stderr)
+    return val_acc
+
+
+def run_async(args, spec, train, val) -> float:
+    x, y = to_xy(train)
+    n_batches = args.steps  # one gradient per dispatched batch
+    dataset = DistributedDataset(
+        x[: n_batches * args.batch_size], y[: n_batches * args.batch_size],
+        {"batch_size": args.batch_size, "epochs": 1},
+    )
+    trainer = AsyncSGDTrainer(
+        spec, dataset, learning_rate=args.learning_rate, optimizer=args.optimizer,
+        hyperparams={"maximum_staleness": args.max_staleness}, verbose=True,
+    )
+    trainer.init(jax.random.PRNGKey(args.seed))
+    stats = trainer.train(num_workers=args.workers)
+    vx, vy = to_xy(val)
+    val_loss, val_acc = trainer.evaluate(vx[:512], vy[:512])
+    print(f"async: {stats}, val loss {val_loss:.4f} acc {val_acc:.4f}",
+          file=sys.stderr)
+    return val_acc
+
+
+def run_federated(args, spec, train, val) -> float:
+    trainer = FederatedAveragingTrainer(
+        spec, local_steps=args.local_steps,
+        local_batch_size=args.batch_size, learning_rate=args.learning_rate,
+        optimizer=args.optimizer, verbose=True,
+    )
+    trainer.init(jax.random.PRNGKey(args.seed))
+    x, y = to_xy(train)
+    rng = np.random.RandomState(args.seed)
+    for r in range(args.rounds):
+        xs, ys = trainer.pack_round_data(x, y, rng)
+        loss = trainer.round(xs, ys)
+        if r % 5 == 0:
+            print(f"round {r} loss {loss:.4f}", file=sys.stderr)
+    vx, vy = to_xy(val)
+    val_loss, val_acc = trainer.evaluate(vx[:512], vy[:512])
+    print(f"federated: val loss {val_loss:.4f} acc {val_acc:.4f}", file=sys.stderr)
+    return val_acc
+
+
+def main(argv=None) -> float:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", choices=("sync", "async", "federated"), default="sync")
+    p.add_argument("--data-dir", default=None,
+                   help="CIFAR-10 python-version pickle dir; synthetic if absent")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--rounds", type=int, default=20, help="federated rounds")
+    p.add_argument("--local-steps", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--learning-rate", type=float, default=0.05)
+    p.add_argument("--optimizer", default="momentum")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--max-staleness", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    splits = load_splits(args.data_dir, seed=args.seed)
+    spec = cifar_convnet()
+    runner = {"sync": run_sync, "async": run_async, "federated": run_federated}
+    return runner[args.mode](args, spec, splits["train"], splits["val"])
+
+
+if __name__ == "__main__":
+    main()

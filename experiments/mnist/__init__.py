@@ -1,0 +1,9 @@
+from experiments.mnist.mnist_data import (  # noqa: F401
+    load_dataset,
+    load_mnist,
+    read_idx_images,
+    read_idx_labels,
+    synthetic_mnist,
+    write_idx_images,
+    write_idx_labels,
+)
