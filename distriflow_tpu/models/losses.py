@@ -115,6 +115,10 @@ softmax_cross_entropy = LOSSES["softmax_cross_entropy"]
 
 
 def get_loss(name: str) -> LossFn:
+    if name not in LOSSES and name.startswith("fused_"):
+        # fused losses live in the Pallas op layer; importing it registers them
+        import distriflow_tpu.ops  # noqa: F401
+
     if name not in LOSSES:
         raise KeyError(f"unknown loss {name!r}; registered: {sorted(LOSSES)}")
     return LOSSES[name]

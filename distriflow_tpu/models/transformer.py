@@ -43,6 +43,7 @@ class TransformerConfig:
     n_experts: int = 0  # 0 = dense FFN; >0 = MoE with EP-shardable experts
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
+    use_flash_attention: bool = False  # Pallas kernel (distriflow_tpu/ops)
     causal: bool = True
 
 
@@ -65,6 +66,10 @@ class Attention(nn.Module):
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, S, D]
         if cfg.use_ring_attention and self.mesh is not None and self.mesh.shape["seq"] > 1:
             out = ring_attention(q, k, v, self.mesh, axis="seq", causal=cfg.causal)
+        elif cfg.use_flash_attention:
+            from distriflow_tpu.ops import flash_attention  # lazy: pallas import
+
+            out = flash_attention(q, k, v, cfg.causal)
         else:
             out = blockwise_attention(q, k, v, causal=cfg.causal)
         out = out.transpose(0, 2, 1, 3)  # [B, S, H, D]

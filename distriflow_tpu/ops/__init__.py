@@ -1,0 +1,27 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference delegates all numerics to tfjs WebGL kernels (SURVEY.md §2.1);
+the equivalent "native op layer" here is Pallas — hand-scheduled TPU kernels
+for the ops XLA's default fusion leaves on the table:
+
+- :func:`flash_attention` — fused online-softmax attention (never
+  materializes the [S, S] score matrix in HBM);
+- :func:`fused_softmax_cross_entropy` — per-row logsumexp CE over the vocab
+  dim without materializing softmax probabilities.
+
+Kernels compile on TPU and fall back to interpret mode on CPU (tests), via
+:func:`default_interpret`.
+"""
+
+from distriflow_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from distriflow_tpu.ops.fused_ce import (  # noqa: F401
+    fused_softmax_cross_entropy,
+    fused_softmax_cross_entropy_per_example,
+)
+
+
+def default_interpret() -> bool:
+    """Pallas TPU kernels need a real TPU; interpret everywhere else."""
+    import jax
+
+    return jax.default_backend() != "tpu"

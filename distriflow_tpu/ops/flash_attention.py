@@ -1,0 +1,175 @@
+"""Flash attention: fused online-softmax attention as a Pallas TPU kernel.
+
+The [S, S] score matrix never hits HBM: each grid step holds one Q block and
+one K/V block in VMEM and advances the flash recurrence (running max ``m``,
+running normalizer ``l``, unnormalized accumulator ``acc``) — the same
+recurrence as the pure-JAX ``blockwise_attention``
+(``distriflow_tpu/parallel/ring_attention.py``), which is this kernel's
+correctness oracle and its gradient path.
+
+Grid: ``(B*H, S/block_q, S/block_k)`` with the K dimension innermost; the
+accumulators live in VMEM scratch, which persists across the sequential
+innermost iterations on TPU, so VMEM usage is O(block·D) regardless of
+sequence length — long-context safe. Causal masking predicates away K blocks
+past the Q block's diagonal (~half the compute). Matmuls hit the MXU with
+float32 accumulation (``preferred_element_type``); masking/softmax run on
+the VPU. ``m``/``l`` scratch is lane-replicated to (block_q, 128) to stay on
+the natural f32 tile.
+
+Backward: ``jax.custom_vjp`` recomputes via ``blockwise_attention``'s VJP —
+flash-style recompute-in-backward (no residuals besides q/k/v), numerically
+exact since both compute identical softmax attention.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distriflow_tpu.parallel.ring_attention import _auto_block, blockwise_attention
+
+NEG_INF = -1e30
+_LANES = 128  # f32 tile width; m/l scratch is replicated across lanes
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+            *, block_q, block_k, n_kv, causal, scale):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+        k_blk = k_ref[0].astype(jnp.float32)  # [block_k, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m = m_ref[:, :1]  # [block_q, 1] (lane-replicated store)
+        l = l_ref[:, :1]
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        safe_m = jnp.where(new_m <= NEG_INF, 0.0, new_m)
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(s <= NEG_INF, 0.0, p)
+        corr = jnp.where(
+            m <= NEG_INF, 0.0, jnp.exp(jnp.where(m <= NEG_INF, 0.0, m) - safe_m)
+        )
+        new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(new_m, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(new_l, l_ref.shape)
+
+    if causal:
+        # K blocks fully past this Q block's last row are fully masked — skip
+        # the compute (their DMA is pipelined regardless)
+        @pl.when(kb * block_k < (qi + 1) * block_q)
+        def _():
+            _accumulate()
+    else:
+        _accumulate()
+
+    @pl.when(kb == n_kv - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    causal: bool, block_q: int, block_k: int, interpret: bool,
+) -> jnp.ndarray:
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq = _auto_block(s, block_q)
+    bk = _auto_block(s, block_k)
+    n_q, n_kv = s // bq, s // bk
+
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, s, d)
+    vf = v.reshape(b * h, s, d)
+
+    kernel = functools.partial(
+        _kernel, block_q=bq, block_k=bk, n_kv=n_kv, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # m (lane-replicated)
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # l
+            pltpu.VMEM((bq, d), jnp.float32),  # acc
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * s * s * d // (2 if causal else 1),
+            bytes_accessed=4 * b * h * s * d * q.dtype.itemsize,
+            transcendentals=b * h * s * s,
+        ),
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused attention over ``[B, H, S, D]`` tensors.
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere.
+    """
+    if interpret is None:
+        from distriflow_tpu.ops import default_interpret
+
+        interpret = default_interpret()
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    return flash_attention(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # recompute-in-backward via the pure-JAX oracle (identical math)
+    _, vjp = jax.vjp(lambda q, k, v: blockwise_attention(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,144 @@
+"""Fused softmax cross-entropy as a Pallas TPU kernel.
+
+The baseline path (``optax.softmax_cross_entropy``) materializes
+``log_softmax(logits)`` — a full [N, V] intermediate — before contracting
+with the one-hot targets. For LM-sized vocabularies that is a second
+HBM-resident [N, V] array and a wasted round trip. This kernel computes the
+per-row loss ``logsumexp(logits) - <logits, targets>`` in one VMEM pass per
+row block: the row max, the exp-sum, and the label contraction all happen
+on-chip and only [N] scalars leave.
+
+Backward (``softmax(logits) - targets``, weighted) runs as a second Pallas
+kernel — the probabilities still never hit HBM in forward, and backward
+writes them fused with the subtraction.
+
+Registered in the loss registry as ``"fused_softmax_cross_entropy"``
+(drop-in for ``"softmax_cross_entropy"``; both resolve through
+``distriflow_tpu.models.losses.get_loss`` — the registry the reference
+declared but never used, ``src/common/models.ts:139``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLOCK_N = 256
+
+
+def _fwd_kernel(logits_ref, targets_ref, loss_ref):
+    x = logits_ref[:].astype(jnp.float32)  # [block_n, V]
+    t = targets_ref[:].astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True))
+    label = jnp.sum(x * t, axis=-1, keepdims=True)
+    loss_ref[:] = lse - label
+
+
+def _bwd_kernel(logits_ref, targets_ref, g_ref, grad_ref):
+    x = logits_ref[:].astype(jnp.float32)
+    t = targets_ref[:].astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    grad_ref[:] = ((p - t) * g_ref[:].astype(jnp.float32)).astype(grad_ref.dtype)
+
+
+def _pad_rows(x: jnp.ndarray, block: int) -> jnp.ndarray:
+    pad = (-x.shape[0]) % block
+    if pad:
+        x = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+    return x
+
+
+def _rows_call(kernel, outs, block_n, interpret, *arrays):
+    n, v = arrays[0].shape
+    padded = [_pad_rows(a, block_n) for a in arrays]
+    np_ = padded[0].shape[0]
+    grid = (np_ // block_n,)
+    specs = [
+        pl.BlockSpec((block_n, a.shape[1]), lambda i: (i, 0)) for a in padded
+    ]
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=specs,
+        out_specs=pl.BlockSpec((block_n, outs[1]), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((np_, outs[1]), outs[0]),
+        interpret=interpret,
+    )(*padded)
+    return out[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _per_row_loss(
+    logits: jnp.ndarray, targets: jnp.ndarray,
+    block_n: int = BLOCK_N, interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """[N, V] logits + one-hot targets -> [N] per-row CE."""
+    if interpret is None:
+        from distriflow_tpu.ops import default_interpret
+
+        interpret = default_interpret()
+    out = _rows_call(
+        _fwd_kernel, (jnp.float32, 1), block_n, interpret, logits, targets
+    )
+    return out[:, 0]
+
+
+def _per_row_fwd(logits, targets, block_n, interpret):
+    return _per_row_loss(logits, targets, block_n, interpret), (logits, targets)
+
+
+def _per_row_bwd(block_n, interpret, res, g):
+    logits, targets = res
+    if interpret is None:
+        from distriflow_tpu.ops import default_interpret
+
+        interpret = default_interpret()
+    grad = _rows_call(
+        _bwd_kernel, (logits.dtype, logits.shape[1]), block_n, interpret,
+        logits, targets, g.astype(jnp.float32)[:, None],
+    )
+    return grad, None  # one-hot targets get no gradient
+
+
+_per_row_loss.defvjp(_per_row_fwd, _per_row_bwd)
+
+
+def fused_softmax_cross_entropy_per_example(
+    logits: jnp.ndarray, targets: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-example CE with the same shape contract as the registry losses:
+    arbitrary leading dims, vocab last — returns leading-dims-shaped losses."""
+    lead = logits.shape[:-1]
+    v = logits.shape[-1]
+    flat = _per_row_loss(logits.reshape(-1, v), targets.reshape(-1, v))
+    return flat.reshape(lead)
+
+
+def fused_softmax_cross_entropy(
+    logits: jnp.ndarray, targets: jnp.ndarray, weight: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """Weighted-mean fused CE (drop-in for ``losses.softmax_cross_entropy``)."""
+    from distriflow_tpu.models.losses import _weighted_mean
+
+    return _weighted_mean(
+        fused_softmax_cross_entropy_per_example(logits, targets), weight
+    )
+
+
+def register() -> None:
+    from distriflow_tpu.models import losses
+
+    if "fused_softmax_cross_entropy" not in losses.LOSSES:
+        losses.register_loss(
+            "fused_softmax_cross_entropy", fused_softmax_cross_entropy_per_example
+        )
+
+
+register()

@@ -58,7 +58,10 @@ def run_sync(args, spec, train, val) -> float:
 
 def run_async(args, spec, train, val) -> float:
     x, y = to_xy(train)
-    n_batches = args.steps  # one gradient per dispatched batch
+    n_batches = min(args.steps, len(x) // args.batch_size)  # 1 gradient per batch
+    if n_batches < args.steps:
+        print(f"warning: only {len(x)} examples available — running {n_batches} "
+              f"steps instead of the requested {args.steps}", file=sys.stderr)
     dataset = DistributedDataset(
         x[: n_batches * args.batch_size], y[: n_batches * args.batch_size],
         {"batch_size": args.batch_size, "epochs": 1},

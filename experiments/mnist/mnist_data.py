@@ -47,7 +47,7 @@ def read_idx_images(path: str) -> np.ndarray:
     magic, n, rows, cols = struct.unpack(">iiii", raw[:16])
     if magic != IMAGES_MAGIC:
         raise ValueError(
-            f"images file has invalid magic number {IMAGES_MAGIC:#010x} != {magic:#x}"
+            f"images file has invalid magic number {magic:#x} (expected {IMAGES_MAGIC:#010x})"
         )
     data = np.frombuffer(raw, np.uint8, count=n * rows * cols, offset=16)
     return data.reshape(n, rows, cols)
@@ -60,7 +60,7 @@ def read_idx_labels(path: str) -> np.ndarray:
     magic, n = struct.unpack(">ii", raw[:8])
     if magic != LABELS_MAGIC:
         raise ValueError(
-            f"labels file has invalid magic number {LABELS_MAGIC:#010x} != {magic:#x}"
+            f"labels file has invalid magic number {magic:#x} (expected {LABELS_MAGIC:#010x})"
         )
     return np.frombuffer(raw, np.uint8, count=n, offset=8)
 

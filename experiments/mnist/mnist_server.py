@@ -70,8 +70,9 @@ def main(argv=None) -> None:
     p.add_argument("--learning-rate", type=float, default=0.001)
     p.add_argument("--min-updates", type=int, default=20,
                    help="federated mode: gradients buffered per version")
-    p.add_argument("--verbose", action="store_true", default=True)
+    p.add_argument("--quiet", action="store_true", help="suppress progress logs")
     args = p.parse_args(argv)
+    args.verbose = not args.quiet
 
     server = build_server(args)
     server.setup()
