@@ -17,6 +17,7 @@ from distriflow_tpu.models.losses import (
     register_loss,
     softmax_cross_entropy,
 )
+from distriflow_tpu.models.mobilenet import MobileNetV2, mobilenet_v2
 from distriflow_tpu.models.zoo import MLP, ConvNet, cifar_convnet, mnist_convnet, mnist_mlp
 
 __all__ = [
@@ -34,6 +35,8 @@ __all__ = [
     "get_metric",
     "register_loss",
     "softmax_cross_entropy",
+    "MobileNetV2",
+    "mobilenet_v2",
     "MLP",
     "ConvNet",
     "cifar_convnet",

@@ -1,0 +1,150 @@
+"""MobileNetV2 (BASELINE config #5, ImageNet-subset stretch workload).
+
+No reference counterpart exists (the reference ships only the MNIST MLP and a
+Keras ConvNet export, ``experiment/mnist/mnist_server.ts:16-22`` /
+``model.json``); BASELINE.md adds MobileNetV2 as the v4-32 stretch target.
+
+TPU-first design decisions:
+
+- **GroupNorm instead of BatchNorm.** Canonical MobileNetV2 uses BatchNorm,
+  whose running statistics are mutable state and, under data parallelism,
+  require a cross-replica stats sync every step. GroupNorm is stateless —
+  the model stays a pure ``(params, x) -> logits`` function, so every
+  trainer (sync psum, async host-coordinated, federated) consumes it
+  unchanged, and no norm-state divergence exists between workers. Channel
+  counts are multiples of 8 by construction (``_make_divisible``), so a
+  fixed group size of 8 always divides evenly.
+- **ReLU6 kept** (it is elementwise — XLA fuses it into the preceding
+  conv's epilogue; clipping aids low-precision activations).
+- **NHWC layout + explicit dtype policy**: pass ``jnp.bfloat16`` to run the
+  depthwise/pointwise convs on the MXU at its native precision; params stay
+  float32 (flax default ``param_dtype``) so the optimizer math is exact.
+- Depthwise convs are expressed with ``feature_group_count`` so XLA lowers
+  them to true depthwise convolutions rather than grouped matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distriflow_tpu.models.base import ModelSpec
+from distriflow_tpu.models.flax_model import spec_from_flax
+
+# (expansion t, out channels c, repeats n, first-block stride s) — the
+# standard MobileNetV2 inverted-residual schedule.
+V2_SCHEDULE: Tuple[Tuple[int, int, int, int], ...] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    """Round channel counts to a multiple of ``divisor``, never dropping
+    below 90% of the requested width (standard MobileNet rule)."""
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _ConvNorm(nn.Module):
+    """conv -> GroupNorm -> optional relu6."""
+
+    features: int
+    kernel: Tuple[int, int] = (1, 1)
+    stride: int = 1
+    groups: int = 1  # feature_group_count (== in-channels for depthwise)
+    act: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Conv(
+            self.features,
+            kernel_size=self.kernel,
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=self.groups,
+            use_bias=False,
+            dtype=self.dtype,
+        )(x)
+        x = nn.GroupNorm(num_groups=None, group_size=8, dtype=self.dtype)(x)
+        return nn.relu6(x) if self.act else x
+
+
+class InvertedResidual(nn.Module):
+    """expand 1x1 -> depthwise 3x3 -> project 1x1, residual when shapes match."""
+
+    out_ch: int
+    stride: int = 1
+    expand: int = 6
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        h = x
+        if self.expand != 1:
+            h = _ConvNorm(in_ch * self.expand, dtype=self.dtype)(h)
+        h = _ConvNorm(
+            h.shape[-1],
+            kernel=(3, 3),
+            stride=self.stride,
+            groups=h.shape[-1],
+            dtype=self.dtype,
+        )(h)
+        h = _ConvNorm(self.out_ch, act=False, dtype=self.dtype)(h)
+        if self.stride == 1 and in_ch == self.out_ch:
+            h = h + x
+        return h
+
+
+class MobileNetV2(nn.Module):
+    classes: int = 1000
+    width: float = 1.0
+    schedule: Sequence[Tuple[int, int, int, int]] = V2_SCHEDULE
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = _ConvNorm(
+            _make_divisible(32 * self.width), kernel=(3, 3), stride=2, dtype=self.dtype
+        )(x)
+        for t, c, n, s in self.schedule:
+            out_ch = _make_divisible(c * self.width)
+            for i in range(n):
+                x = InvertedResidual(
+                    out_ch,
+                    stride=s if i == 0 else 1,
+                    expand=t,
+                    dtype=self.dtype,
+                )(x)
+        head = _make_divisible(1280 * max(1.0, self.width))
+        x = _ConvNorm(head, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.classes, dtype=self.dtype)(x)
+        return x
+
+
+def mobilenet_v2(
+    image_size: int = 224,
+    classes: int = 1000,
+    width: float = 1.0,
+    dtype: Any = jnp.float32,
+) -> ModelSpec:
+    """BASELINE config #5 model (ImageNet-subset, sync-SGD, v4-32 stretch)."""
+    return spec_from_flax(
+        MobileNetV2(classes=classes, width=width, dtype=dtype),
+        input_shape=(image_size, image_size, 3),
+        output_shape=(classes,),
+        name="mobilenet_v2",
+    )

@@ -1,0 +1,80 @@
+"""ImageNet-subset MobileNetV2 training entrypoint (BASELINE config #5).
+
+The v4-32 stretch workload: MobileNetV2, sync-SGD, batch sharded over the
+mesh's data axis with the gradient mean as an in-graph psum. No reference
+counterpart (the reference ships only MNIST).
+
+Run:  python -m experiments.imagenet_subset.train --steps 50 --image-size 96
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distriflow_tpu.models.mobilenet import mobilenet_v2
+from distriflow_tpu.parallel import data_parallel_mesh, shard_batch
+from distriflow_tpu.train.sync import SyncTrainer
+
+from experiments.imagenet_subset.data import load_splits, to_xy
+
+
+def main(argv=None) -> float:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-dir", default=None,
+                   help="class-per-directory .npy tree; synthetic if absent")
+    p.add_argument("--image-size", type=int, default=96)
+    p.add_argument("--width", type=float, default=1.0)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--learning-rate", type=float, default=0.05)
+    p.add_argument("--optimizer", default="momentum")
+    p.add_argument("--bf16", action="store_true",
+                   help="compute in bfloat16 (MXU-native)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    splits = load_splits(args.data_dir, image_size=args.image_size, seed=args.seed)
+    num_classes = splits["num_classes"]
+    spec = mobilenet_v2(
+        image_size=args.image_size,
+        classes=num_classes,
+        width=args.width,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+    )
+
+    mesh = data_parallel_mesh()
+    trainer = SyncTrainer(spec, mesh=mesh, learning_rate=args.learning_rate,
+                          optimizer=args.optimizer, verbose=True)
+    trainer.init(jax.random.PRNGKey(args.seed))
+
+    x, y = to_xy(splits["train"], num_classes)
+    n = len(x)
+    rng = np.random.RandomState(args.seed)
+    start = time.perf_counter()
+    for step in range(args.steps):
+        idx = rng.randint(0, n, args.batch_size)
+        batch = shard_batch(mesh, (x[idx], y[idx]))
+        loss = trainer.step(batch)
+        if step % 10 == 0:
+            print(f"step {step} loss {loss:.4f}", file=sys.stderr)
+    elapsed = time.perf_counter() - start
+    sps = args.steps * args.batch_size / elapsed
+
+    vx, vy = to_xy(splits["val"], num_classes)
+    val_loss, val_acc = trainer.evaluate(vx[:256], vy[:256])
+    print(
+        f"mobilenet_v2/{args.image_size}px: {sps:.0f} samples/sec, "
+        f"val loss {val_loss:.4f} acc {val_acc:.4f}",
+        file=sys.stderr,
+    )
+    return val_acc
+
+
+if __name__ == "__main__":
+    main()

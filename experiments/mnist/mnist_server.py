@@ -71,6 +71,8 @@ def main(argv=None) -> None:
     p.add_argument("--min-updates", type=int, default=20,
                    help="federated mode: gradients buffered per version")
     p.add_argument("--quiet", action="store_true", help="suppress progress logs")
+    p.add_argument("--verbose", action="store_true",
+                   help="accepted for compatibility (progress logs are on by default)")
     args = p.parse_args(argv)
     args.verbose = not args.quiet
 
