@@ -23,6 +23,8 @@ from typing import Any, Callable, Dict, List, Optional
 from distriflow_tpu.comm.transport import (
     ACK_TIMEOUT_S,
     CONNECT_TIMEOUT_S,
+    HEARTBEAT_INTERVAL_S,
+    HEARTBEAT_TIMEOUT_S,
     ClientTransport,
 )
 from distriflow_tpu.models.base import DistributedModel, ModelSource, fetch_model
@@ -46,6 +48,8 @@ class DistributedClientConfig:
     # reference default is 5 s (abstract_client.ts:13); first-step jit
     # compilation on the server easily exceeds that, so the knob is explicit
     upload_timeout_s: float = 60.0
+    heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S  # 0 disables
+    heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S  # server-loss detection
 
 
 def resolve_client_id(config: DistributedClientConfig) -> str:
@@ -103,7 +107,11 @@ class AbstractClient:
     def setup(self, timeout: float = CONNECT_TIMEOUT_S) -> None:
         """Connect and await the first Download (reference ``:166-173``)."""
         self.model.setup()
-        self.transport = ClientTransport(self.server_address)
+        self.transport = ClientTransport(
+            self.server_address,
+            heartbeat_interval=self.config.heartbeat_interval_s,
+            heartbeat_timeout=self.config.heartbeat_timeout_s,
+        )
         self.transport.on(Events.Download.value, self._on_download)
         self.transport.on("trainingComplete", self._on_training_complete)
         self.transport.connect(timeout)

@@ -10,7 +10,12 @@ SURVEY.md §2.4). This module provides the same primitives natively:
   timeouts are preserved as defaults (``src/client/abstract_client.ts:12-13``);
 - server-side broadcast to all connected clients
   (``server.sockets.emit``, ``federated_server.ts:80``);
-- connection/disconnection callbacks.
+- connection/disconnection callbacks;
+- heartbeat-based failure detection (beyond the reference, which has no
+  liveness checks at all): clients ping every ``heartbeat_interval``, the
+  server echoes and evicts clients silent past ``heartbeat_timeout`` —
+  eviction runs the normal disconnect path, so outstanding batches are
+  requeued; clients detect a vanished server via ``on_server_lost``.
 
 Both endpoints run their event loop in a background thread so the public
 API is synchronous (trainers and tests are synchronous; the reference's
@@ -28,6 +33,7 @@ import asyncio
 import itertools
 import struct
 import threading
+import time
 import uuid
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -35,6 +41,13 @@ from distriflow_tpu.comm.codec import decode, encode
 
 CONNECT_TIMEOUT_S = 10.0  # reference abstract_client.ts:12
 ACK_TIMEOUT_S = 5.0  # reference abstract_client.ts:13
+# Failure detection (no reference counterpart — the reference has no
+# heartbeats, retries, or liveness checks at all; SURVEY.md §5 "failure
+# detection": only connect/ack timeouts surface hangs there). A worker that
+# dies silently mid-batch would otherwise hold its batch until epoch wrap.
+HEARTBEAT_INTERVAL_S = 2.0
+HEARTBEAT_TIMEOUT_S = 10.0
+_HB_EVENT = "__hb__"
 
 _LEN = struct.Struct("<Q")
 MAX_FRAME = 1 << 33  # 8 GiB safety bound
@@ -88,13 +101,22 @@ class _Endpoint:
 class ServerTransport:
     """Hub endpoint: accepts clients, dispatches events, broadcasts."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_interval: float = HEARTBEAT_INTERVAL_S,
+        heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S,
+    ):
         self.host = host
         self.port = port
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_timeout = heartbeat_timeout  # 0 disables reaping
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._clients: Dict[str, _Endpoint] = {}
+        self._last_seen: Dict[str, float] = {}
         self._handlers: Dict[str, Callable[[str, Any], Any]] = {}
         self.on_connect: Optional[Callable[[str], Any]] = None
         self.on_disconnect: Optional[Callable[[str], Any]] = None
@@ -119,6 +141,8 @@ class ServerTransport:
             )
             self.port = self._server.sockets[0].getsockname()[1]
             self._started.set()
+            if self.heartbeat_timeout > 0:
+                self._loop.create_task(self._reap_dead_clients())
             async with self._server:
                 await self._server.serve_forever()
 
@@ -152,12 +176,29 @@ class ServerTransport:
         """Register ``handler(client_id, payload) -> ack_result | None``."""
         self._handlers[event] = handler
 
+    async def _reap_dead_clients(self) -> None:
+        """Evict clients with no traffic inside the heartbeat timeout.
+
+        Closing the transport makes the client's read loop exit, which runs
+        the normal disconnect path — so a silently-dead worker's outstanding
+        state is requeued exactly like a clean disconnect's."""
+        while True:
+            await asyncio.sleep(self.heartbeat_interval)
+            cutoff = time.monotonic() - self.heartbeat_timeout
+            for client_id, seen in list(self._last_seen.items()):
+                endpoint = self._clients.get(client_id)
+                if endpoint is not None and seen < cutoff:
+                    print(f"[transport] reaping silent client {client_id[:8]} "
+                          f"(no traffic for {self.heartbeat_timeout:.0f}s)", flush=True)
+                    endpoint.writer.close()
+
     async def _handle_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         client_id = uuid.uuid4().hex
         endpoint = _Endpoint(self._loop, writer)
         self._clients[client_id] = endpoint
+        self._last_seen[client_id] = time.monotonic()
         if self.on_connect:
             # executor, not inline: callbacks call emit_to/broadcast, which
             # block on this very loop — running them here would deadlock
@@ -194,8 +235,12 @@ class ServerTransport:
             while True:
                 frame = await _read_frame(reader)
                 msg = decode(frame)
+                self._last_seen[client_id] = time.monotonic()
                 if msg.get("event") == "__ack__":
                     endpoint.handle_ack(msg)
+                    continue
+                if msg.get("event") == _HB_EVENT:
+                    await endpoint._send({"event": _HB_EVENT})  # echo: server liveness
                     continue
                 # fire-and-track: the read loop must stay responsive — a
                 # handler that blocks waiting for a peer ack would otherwise
@@ -208,6 +253,7 @@ class ServerTransport:
             print(f"[transport] closing client {client_id[:8]}: {e}", flush=True)
         finally:
             self._clients.pop(client_id, None)
+            self._last_seen.pop(client_id, None)
             writer.close()
             if self.on_disconnect:
                 def _safe_disconnect(cid=client_id):
@@ -244,10 +290,19 @@ class ServerTransport:
 class ClientTransport:
     """Spoke endpoint: dials the server, receives events, uploads with ack."""
 
-    def __init__(self, address: str):
+    def __init__(
+        self,
+        address: str,
+        heartbeat_interval: float = HEARTBEAT_INTERVAL_S,
+        heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S,
+    ):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
+        self.heartbeat_interval = heartbeat_interval  # 0 disables heartbeats
+        self.heartbeat_timeout = heartbeat_timeout  # 0 disables loss detection
+        self.on_server_lost: Optional[Callable[[], None]] = None
+        self._last_server_frame = time.monotonic()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._endpoint: Optional[_Endpoint] = None
@@ -272,7 +327,31 @@ class ClientTransport:
         async def main():
             reader, writer = await asyncio.open_connection(self.host, self.port)
             self._endpoint = _Endpoint(self._loop, writer)
+            self._last_server_frame = time.monotonic()
             self._connected.set()
+
+            async def heartbeat():
+                while True:
+                    await asyncio.sleep(self.heartbeat_interval)
+                    try:
+                        await self._endpoint.emit_async(_HB_EVENT, None)
+                    except (ConnectionError, RuntimeError):
+                        return
+                    if (
+                        self.heartbeat_timeout > 0
+                        and time.monotonic() - self._last_server_frame
+                        > self.heartbeat_timeout
+                    ):
+                        print("[transport] server lost (no frames for "
+                              f"{self.heartbeat_timeout:.0f}s)", flush=True)
+                        if self.on_server_lost is not None:
+                            await self._loop.run_in_executor(None, self.on_server_lost)
+                        writer.close()
+                        return
+
+            if self.heartbeat_interval > 0:
+                self._loop.create_task(heartbeat())
+
             async def dispatch(msg):
                 handler = self._handlers.get(msg.get("event"))
                 if handler is not None:
@@ -288,12 +367,19 @@ class ClientTransport:
                 while True:
                     frame = await _read_frame(reader)
                     msg = decode(frame)
+                    self._last_server_frame = time.monotonic()
                     if msg.get("event") == "__ack__":
                         self._endpoint.handle_ack(msg)
                         continue
-                    # fire-and-track, same deadlock-avoidance as the server
+                    if msg.get("event") == _HB_EVENT:
+                        continue  # server's heartbeat echo; timestamp is enough
                     self._loop.create_task(dispatch(msg))
-            except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                # server went away (EOF/reset) without us calling close()
+                if not self._stopped and self.on_server_lost is not None:
+                    print("[transport] server connection lost", flush=True)
+                    await self._loop.run_in_executor(None, self.on_server_lost)
+            except asyncio.CancelledError:
                 pass
             except ValueError as e:
                 print(f"[transport] closing connection: {e}", flush=True)
@@ -322,6 +408,7 @@ class ClientTransport:
         ).result(ACK_TIMEOUT_S)
 
     def close(self) -> None:
+        self._stopped = True  # deliberate close: suppress on_server_lost
         if self._loop is None or self._loop.is_closed():
             return
         loop = self._loop

@@ -19,7 +19,11 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from distriflow_tpu.models.base import DistributedModel
-from distriflow_tpu.comm.transport import ServerTransport
+from distriflow_tpu.comm.transport import (
+    HEARTBEAT_INTERVAL_S,
+    HEARTBEAT_TIMEOUT_S,
+    ServerTransport,
+)
 from distriflow_tpu.server.models import (
     DistributedServerCheckpointedModel,
     DistributedServerModel,
@@ -49,6 +53,10 @@ class DistributedServerConfig:
     verbose: Optional[bool] = None
     host: str = "127.0.0.1"
     port: int = 0
+    # failure detection (beyond the reference; SURVEY.md §5): evict clients
+    # silent for heartbeat_timeout_s, requeueing their outstanding work
+    heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S
+    heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S  # 0 disables
 
 
 class AbstractServer:
@@ -73,7 +81,12 @@ class AbstractServer:
         self.hyperparams: ServerHyperparams = server_hyperparams(
             self.config.server_hyperparams
         )
-        self.transport = transport or ServerTransport(self.config.host, self.config.port)
+        self.transport = transport or ServerTransport(
+            self.config.host,
+            self.config.port,
+            heartbeat_interval=self.config.heartbeat_interval_s,
+            heartbeat_timeout=self.config.heartbeat_timeout_s,
+        )
         self.logger = VerboseLogger(type(self).__name__, self.config.verbose)
         self.callbacks = CallbackRegistry("new_version", "upload", "connect", "disconnect")
 

@@ -1,0 +1,111 @@
+"""Heartbeat failure-detection tests.
+
+No reference counterpart — the reference's only liveness signals are the
+connect/ack timeouts (``abstract_client.ts:12-13``); a silently-dead worker
+holds its batch until epoch wrap. Here: the server reaps clients that stop
+sending frames (running the normal disconnect/requeue path) and clients
+detect a vanished server via ``on_server_lost``.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from distriflow_tpu.comm.codec import encode
+from distriflow_tpu.comm.transport import ClientTransport, ServerTransport
+
+
+def _wait_for(cond, timeout=10.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_silent_client_is_reaped():
+    server = ServerTransport(heartbeat_interval=0.1, heartbeat_timeout=0.5).start()
+    gone = []
+    server.on_disconnect = gone.append
+    try:
+        # raw socket that connects, says hello, then goes silent (a hung
+        # worker: TCP stays open, no frames flow)
+        sock = socket.create_connection(("127.0.0.1", server.port))
+        payload = encode({"event": "hello", "payload": None})
+        sock.sendall(struct.pack("<Q", len(payload)) + payload)
+        assert _wait_for(lambda: server.num_clients == 1)
+        assert _wait_for(lambda: server.num_clients == 0), "silent client not reaped"
+        assert _wait_for(lambda: len(gone) == 1)
+        sock.close()
+    finally:
+        server.stop()
+
+
+def test_heartbeating_client_survives():
+    server = ServerTransport(heartbeat_interval=0.1, heartbeat_timeout=0.5).start()
+    try:
+        client = ClientTransport(
+            server.address, heartbeat_interval=0.1, heartbeat_timeout=0.5
+        ).connect()
+        assert _wait_for(lambda: server.num_clients == 1)
+        time.sleep(1.5)  # many timeout windows; heartbeats must keep it alive
+        assert server.num_clients == 1
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_client_detects_lost_server():
+    server = ServerTransport(heartbeat_interval=0.1, heartbeat_timeout=0.5).start()
+    lost = threading.Event()
+    client = ClientTransport(
+        server.address, heartbeat_interval=0.1, heartbeat_timeout=0.5
+    )
+    client.on_server_lost = lost.set
+    client.connect()
+    assert _wait_for(lambda: server.num_clients == 1)
+    server.stop()  # server vanishes mid-session
+    assert lost.wait(10.0), "client did not detect server loss"
+    client.close()
+
+
+def test_reaped_client_batch_requeued(tmp_path):
+    """End-to-end: async-SGD server requeues the batch a dead worker held."""
+    from distriflow_tpu.data.dataset import DistributedDataset
+    from distriflow_tpu.server.async_server import AsynchronousSGDServer
+    from distriflow_tpu.server.abstract_server import DistributedServerConfig
+    from distriflow_tpu.server.models import DistributedServerInMemoryModel
+    from tests.mock_model import MockModel
+
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+    dataset = DistributedDataset(x, y, {"batch_size": 4, "epochs": 1})
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        dataset,
+        DistributedServerConfig(
+            save_dir=str(tmp_path / "models"),
+            heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=0.5,
+        ),
+    )
+    server.setup()
+    try:
+        # a worker connects (gets batch 0 pushed), then goes silent
+        sock = socket.create_connection(("127.0.0.1", server.transport.port))
+        assert _wait_for(lambda: len(server._client_batches) == 1)
+        held = next(iter(server._client_batches.values()))
+        assert held in dataset.incomplete_batches
+        assert _wait_for(lambda: server.transport.num_clients == 0), "not reaped"
+        assert _wait_for(lambda: len(server._client_batches) == 0)
+        # the batch the dead worker held must be servable again
+        assert held in dataset.incomplete_batches
+        batch = dataset.next(timeout=0.0)
+        assert batch is not None and batch.batch == held
+        sock.close()
+    finally:
+        server.stop()
