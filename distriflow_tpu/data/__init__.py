@@ -1,5 +1,10 @@
 """Data layer: batch dispatch with ack/requeue, dataset loaders."""
 
-from distriflow_tpu.data.dataset import Batch, DistributedDataset, batch_to_data_msg
+from distriflow_tpu.data.dataset import (
+    Batch,
+    DistributedDataset,
+    batch_to_data_msg,
+    sample_batch,
+)
 
-__all__ = ["Batch", "DistributedDataset", "batch_to_data_msg"]
+__all__ = ["Batch", "DistributedDataset", "batch_to_data_msg", "sample_batch"]

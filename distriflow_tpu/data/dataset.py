@@ -239,3 +239,18 @@ def batch_to_data_msg(batch: Batch) -> DataMsg:
         x=serialize_array(batch.x),
         y=serialize_array(batch.y),
     )
+
+
+def sample_batch(x, y, idx):
+    """Gather a training batch by row indices.
+
+    The host-side batch-assembly hot path for the sampling-style training
+    loops (experiments, bench): multi-threaded C++ gather when
+    ``distriflow_tpu.native`` is built, numpy fancy indexing otherwise.
+    """
+    from distriflow_tpu import native
+
+    return (
+        native.gather_rows(np.asarray(x), idx),
+        native.gather_rows(np.asarray(y), idx),
+    )

@@ -1,0 +1,145 @@
+"""Native host-kernel loader: C++ fast paths with numpy fallbacks.
+
+The reference has no native layer (SURVEY.md §2.1); this framework's
+host-side hot paths — batch assembly (gather) and federated gradient
+aggregation (mean over client buffers) — get multi-threaded C++ kernels
+(``src/distriflow_native.cpp``) compiled on first use with g++ and loaded
+via ctypes. Everything degrades gracefully: if no compiler or load failure,
+the numpy implementations (themselves C-backed, just single-threaded and
+copy-heavier) are used and ``AVAILABLE`` is False.
+
+Public surface:
+- :func:`gather_rows(src, idx)` — ``src[idx]`` into a fresh contiguous array;
+- :func:`mean_buffers(bufs)` — elementwise float32 mean over equal-shape arrays;
+- ``AVAILABLE`` / :func:`ensure_built` — introspection and explicit build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "distriflow_native.cpp")
+_LIB_PATH = os.path.join(_DIR, "libdistriflow_native.so")
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+AVAILABLE = False
+
+_N_THREADS = min(8, os.cpu_count() or 1)
+
+
+def _build() -> bool:
+    """Compile the shared library; returns success. Quiet on failure.
+
+    Compiles to a per-process temp path then ``os.rename``s into place
+    (atomic on POSIX) so concurrent first-use builds across processes never
+    expose a partially written .so or truncate one another process has
+    already mapped."""
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-fPIC", "-shared", "-pthread", "-std=c++17",
+        _SRC, "-o", tmp_path,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        print(f"[native] build failed:\n{proc.stderr.decode()}", file=sys.stderr)
+        return False
+    try:
+        os.rename(tmp_path, _LIB_PATH)
+    except OSError:
+        os.unlink(tmp_path)
+        return os.path.exists(_LIB_PATH)  # another process won the race
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global AVAILABLE
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.df_abi_version.restype = ctypes.c_int
+    if lib.df_abi_version() != _ABI_VERSION:
+        # stale build from an older source revision: rebuild
+        return None
+    lib.df_gather_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.df_mean_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_int,
+    ]
+    AVAILABLE = True
+    return lib
+
+
+def ensure_built(force: bool = False) -> bool:
+    """Build (if needed) and load the native library; returns availability."""
+    global _lib, _tried, AVAILABLE
+    with _lock:
+        if _lib is not None and not force:
+            return True
+        if _tried and not force:
+            return False
+        _tried = True
+        if force or not os.path.exists(_LIB_PATH):
+            if not _build():
+                return False
+        _lib = _load()
+        if _lib is None and os.path.exists(_LIB_PATH):
+            # stale or corrupt .so: one rebuild attempt
+            if _build():
+                _lib = _load()
+        return _lib is not None
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """``src[idx]`` (leading-axis gather) into a fresh contiguous array."""
+    src = np.asarray(src)
+    idx = np.ascontiguousarray(idx, np.int64)
+    if idx.ndim != 1:
+        raise ValueError(f"idx must be 1-D, got shape {idx.shape}")
+    if len(idx) and (idx.min() < 0 or idx.max() >= len(src)):
+        raise IndexError(f"index out of range for {len(src)} rows")
+    # a strided view would need a full contiguous copy of the source to use
+    # the C kernel — numpy fancy indexing copies only the batch rows instead
+    if not ensure_built() or not src.flags["C_CONTIGUOUS"]:
+        return np.ascontiguousarray(src[idx])
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    _lib.df_gather_rows(
+        src.ctypes.data, row_bytes, idx.ctypes.data, len(idx),
+        out.ctypes.data, _N_THREADS,
+    )
+    return out
+
+
+def mean_buffers(bufs: Sequence[np.ndarray]) -> np.ndarray:
+    """Elementwise float32 mean over equal-shape arrays (aggregation path)."""
+    if not bufs:
+        raise ValueError("mean_buffers needs at least one buffer")
+    arrs: List[np.ndarray] = [np.ascontiguousarray(b, np.float32) for b in bufs]
+    shape = arrs[0].shape
+    if any(a.shape != shape for a in arrs):
+        raise ValueError("mean_buffers requires equal shapes")
+    if not ensure_built():
+        return np.mean(np.stack(arrs), axis=0, dtype=np.float32)
+    out = np.empty(shape, np.float32)
+    ptrs = (ctypes.c_void_p * len(arrs))(*[a.ctypes.data for a in arrs])
+    _lib.df_mean_f32(ptrs, len(arrs), arrs[0].size, out.ctypes.data, _N_THREADS)
+    return out

@@ -15,15 +15,10 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-import numpy as np
 
 from distriflow_tpu.server.abstract_server import AbstractServer
 from distriflow_tpu.utils.messages import Events, UploadMsg
-from distriflow_tpu.utils.serialization import (
-    SerializedArray,
-    deserialize_tree,
-    stack_serialized,
-)
+from distriflow_tpu.utils.serialization import SerializedArray, mean_serialized
 
 
 def _scale_serialized(
@@ -99,14 +94,9 @@ class FederatedServer(AbstractServer):
         with self.time("computing new weights"):
             with self._lock:
                 updates, self.updates = self.updates, []
-            stacked = stack_serialized(updates)
-            template = self.model.get_params()
-            stacked_tree = deserialize_tree(
-                stacked, template, strict_shapes=False
-            )
-            import jax
-
-            mean_grads = jax.tree.map(lambda s: s.mean(axis=0), stacked_tree)
+            # host-side mean over zero-copy buffer views (C++ kernel when
+            # built) — replaces the reference's byte-stack + device mean(0)
+            mean_grads = mean_serialized(updates, self.model.get_params())
             self.model.update(mean_grads)
             self.model.save()
             self.download_msg = self.compute_download_msg()

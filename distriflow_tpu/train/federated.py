@@ -137,8 +137,11 @@ class FederatedAveragingTrainer:
         if len(x) < need:
             raise ValueError(f"need at least {need} examples per round, got {len(x)}")
         idx = (rng or np.random.RandomState(self.round_index)).permutation(len(x))[:need]
-        xs = np.asarray(x)[idx].reshape((w, k, b) + tuple(np.asarray(x).shape[1:]))
-        ys = np.asarray(y)[idx].reshape((w, k, b) + tuple(np.asarray(y).shape[1:]))
+        from distriflow_tpu.data.dataset import sample_batch
+
+        xs, ys = sample_batch(x, y, idx)
+        xs = xs.reshape((w, k, b) + xs.shape[1:])
+        ys = ys.reshape((w, k, b) + ys.shape[1:])
         return xs, ys
 
     def evaluate(self, x, y, metrics=("loss", "accuracy")) -> List[float]:

@@ -29,6 +29,7 @@ from distriflow_tpu.utils.serialization import (
     deserialize_tree,
     flat_deserialize,
     flat_serialize,
+    mean_serialized,
     pack_bytes,
     serialize_array,
     serialize_tree,
@@ -68,6 +69,7 @@ __all__ = [
     "deserialize_tree",
     "flat_deserialize",
     "flat_serialize",
+    "mean_serialized",
     "pack_bytes",
     "serialize_array",
     "serialize_tree",

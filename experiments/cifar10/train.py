@@ -22,7 +22,7 @@ import time
 import jax
 import numpy as np
 
-from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.data.dataset import DistributedDataset, sample_batch
 from distriflow_tpu.models import cifar_convnet
 from distriflow_tpu.parallel import data_parallel_mesh, shard_batch
 from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
@@ -43,7 +43,7 @@ def run_sync(args, spec, train, val) -> float:
     start = time.perf_counter()
     for step in range(args.steps):
         idx = rng.randint(0, n, args.batch_size)
-        batch = shard_batch(mesh, (x[idx], y[idx]))
+        batch = shard_batch(mesh, sample_batch(x, y, idx))
         loss = trainer.step(batch)
         if step % 20 == 0:
             print(f"step {step} loss {loss:.4f}", file=sys.stderr)

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distriflow_tpu.data.dataset import sample_batch
 from distriflow_tpu.models.mobilenet import mobilenet_v2
 from distriflow_tpu.parallel import data_parallel_mesh, shard_batch
 from distriflow_tpu.train.sync import SyncTrainer
@@ -59,7 +60,7 @@ def main(argv=None) -> float:
     start = time.perf_counter()
     for step in range(args.steps):
         idx = rng.randint(0, n, args.batch_size)
-        batch = shard_batch(mesh, (x[idx], y[idx]))
+        batch = shard_batch(mesh, sample_batch(x, y, idx))
         loss = trainer.step(batch)
         if step % 10 == 0:
             print(f"step {step} loss {loss:.4f}", file=sys.stderr)

@@ -1,0 +1,121 @@
+"""Native C++ host-kernel tests.
+
+The reference has no native layer (SURVEY.md §2.1); these cover the C++
+gather/mean kernels against their numpy ground truth, the graceful fallback
+when the library is unavailable, and the integration points (mean_serialized
+aggregation, sample_batch).
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from distriflow_tpu import native
+from distriflow_tpu.data.dataset import sample_batch
+from distriflow_tpu.utils.serialization import mean_serialized, serialize_tree
+
+HAVE_GXX = shutil.which("g++") is not None
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    native.ensure_built()
+    yield
+
+
+def test_build_succeeds_with_compiler():
+    if not HAVE_GXX:
+        pytest.skip("no g++ in this image")
+    assert native.ensure_built(), "native build failed despite g++ present"
+    assert native.AVAILABLE
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.RandomState(0)
+    for shape, dtype in [((100, 17), np.float32), ((64, 8, 8, 3), np.uint8),
+                         ((50,), np.int64)]:
+        src = (rng.rand(*shape) * 100).astype(dtype)
+        idx = rng.randint(0, shape[0], 37)
+        np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_gather_rows_validates_indices():
+    src = np.zeros((4, 2), np.float32)
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([0, 4]))
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([-1]))
+    with pytest.raises(ValueError):
+        native.gather_rows(src, np.array([[0, 1]]))
+
+
+def test_gather_rows_non_contiguous_source():
+    src = np.arange(200, dtype=np.float32).reshape(20, 10)[:, ::2]  # strided view
+    idx = np.array([3, 0, 7])
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_mean_buffers_matches_numpy():
+    rng = np.random.RandomState(1)
+    bufs = [rng.randn(33, 7).astype(np.float32) for _ in range(5)]
+    got = native.mean_buffers(bufs)
+    np.testing.assert_allclose(got, np.mean(np.stack(bufs), 0), rtol=1e-6)
+    assert got.dtype == np.float32
+
+
+def test_mean_buffers_validates():
+    with pytest.raises(ValueError):
+        native.mean_buffers([])
+    with pytest.raises(ValueError):
+        native.mean_buffers([np.zeros((2,), np.float32), np.zeros((3,), np.float32)])
+
+
+def test_numpy_fallback_when_unavailable(monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    monkeypatch.setattr(native, "AVAILABLE", False)
+    src = np.arange(12, dtype=np.float32).reshape(4, 3)
+    np.testing.assert_array_equal(native.gather_rows(src, np.array([2, 0])), src[[2, 0]])
+    bufs = [np.full((3,), float(i), np.float32) for i in range(3)]
+    np.testing.assert_allclose(native.mean_buffers(bufs), [1.0, 1.0, 1.0])
+
+
+# -- integration points ------------------------------------------------------
+
+
+def test_mean_serialized_aggregation():
+    """The federated hot loop: mean of N serialized gradient trees."""
+    rng = np.random.RandomState(2)
+    template = {"w": np.zeros((5, 3), np.float32), "b": np.zeros((3,), np.float32)}
+    trees = [
+        {"w": rng.randn(5, 3).astype(np.float32), "b": rng.randn(3).astype(np.float32)}
+        for _ in range(4)
+    ]
+    updates = [serialize_tree(t) for t in trees]
+    got = mean_serialized(updates, template)
+    np.testing.assert_allclose(
+        got["w"], np.mean([t["w"] for t in trees], 0), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        got["b"], np.mean([t["b"] for t in trees], 0), rtol=1e-6
+    )
+
+
+def test_mean_serialized_rejects_mismatch():
+    a = serialize_tree({"w": np.zeros((2,), np.float32)})
+    b = serialize_tree({"w": np.zeros((3,), np.float32)})
+    with pytest.raises(ValueError):
+        mean_serialized([a, b], {"w": np.zeros((2,), np.float32)})
+    c = serialize_tree({"v": np.zeros((2,), np.float32)})
+    with pytest.raises(ValueError):
+        mean_serialized([a, c], {"w": np.zeros((2,), np.float32)})
+
+
+def test_sample_batch():
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.eye(10, dtype=np.float32)
+    idx = np.array([9, 1, 1, 4])
+    bx, by = sample_batch(x, y, idx)
+    np.testing.assert_array_equal(bx, x[idx])
+    np.testing.assert_array_equal(by, y[idx])
