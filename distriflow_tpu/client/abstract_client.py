@@ -20,6 +20,8 @@ import threading
 import uuid as uuid_lib
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as np
+
 from distriflow_tpu.comm.transport import (
     ACK_TIMEOUT_S,
     CONNECT_TIMEOUT_S,
@@ -28,7 +30,12 @@ from distriflow_tpu.comm.transport import (
     ClientTransport,
 )
 from distriflow_tpu.models.base import DistributedModel, ModelSource, fetch_model
-from distriflow_tpu.utils.config import DEFAULT_CLIENT_HYPERPARAMS, ClientHyperparams
+from distriflow_tpu.utils.config import (
+    COMPRESSION_DTYPES,
+    DEFAULT_CLIENT_HYPERPARAMS,
+    ClientHyperparams,
+    client_hyperparams,
+)
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 from distriflow_tpu.utils.messages import DownloadMsg, Events, UploadMsg
 from distriflow_tpu.utils.serialization import deserialize_tree
@@ -81,6 +88,11 @@ class AbstractClient:
         self.server_address = server_address
         self.model: DistributedModel = fetch_model(model)
         self.config = config or DistributedClientConfig()
+        if self.config.hyperparams:
+            # fail fast on typo'd keys/values (strict-key override + validate,
+            # reference utils.ts:206-234) instead of erroring mid-upload on a
+            # transport handler thread where the exception is only printed
+            client_hyperparams(self.config.hyperparams)
         self.client_id = resolve_client_id(self.config)
         self.logger = VerboseLogger(f"{type(self).__name__}[{self.client_id[:8]}]",
                                     self.config.verbose)
@@ -169,6 +181,24 @@ class AbstractClient:
         if name in pushed and pushed[name] is not None:
             return pushed[name]
         return getattr(DEFAULT_CLIENT_HYPERPARAMS, name)
+
+    def compress_grads(self, grads: Any) -> Any:
+        """Cast gradients per the ``gradient_compression`` hyperparameter
+        before serialization (halves upload bytes at 16-bit; the server's
+        aggregation accumulates in float32 regardless)."""
+        name = str(self.hyperparam("gradient_compression"))
+        if name == "none":
+            return grads
+        if name not in COMPRESSION_DTYPES:
+            raise ValueError(
+                f"gradient_compression must be one of {COMPRESSION_DTYPES}, got {name!r}"
+            )
+        import jax
+
+        from distriflow_tpu.utils.serialization import _np_dtype
+
+        dt = _np_dtype(name)
+        return jax.tree.map(lambda g: np.asarray(g).astype(dt), grads)
 
     # -- subclass hooks -------------------------------------------------------
 

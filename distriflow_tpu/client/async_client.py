@@ -54,7 +54,8 @@ class AsynchronousSGDClient(AbstractClient):
                 client_id=self.client_id,
                 batch=msg.data.batch,
                 gradients=GradientMsg(
-                    version=msg.model.version, vars=serialize_tree(grads)
+                    version=msg.model.version,
+                    vars=serialize_tree(self.compress_grads(grads)),
                 ),
                 metrics=metrics,
             )

@@ -68,7 +68,10 @@ class FederatedClient(AbstractClient):
                 self.upload(
                     UploadMsg(
                         client_id=self.client_id,
-                        gradients=GradientMsg(version=version, vars=serialize_tree(grads)),
+                        gradients=GradientMsg(
+                            version=version,
+                            vars=serialize_tree(self.compress_grads(grads)),
+                        ),
                         metrics=metrics,
                     )
                 )

@@ -147,7 +147,15 @@ class AsynchronousSGDServer(AbstractServer):
                 return False
             decay = self.hyperparams.staleness_decay**staleness
             template = self.model.get_params()
-            grads = deserialize_tree(msg.gradients.vars, template)
+            grads = deserialize_tree(msg.gradients.vars, template, strict_shapes=True)
+            # compressed (16-bit) uploads: optimizer math runs at param dtype
+            grads = jax.tree.map(
+                lambda g, t: g.astype(t.dtype)
+                if getattr(t, "dtype", None) is not None and g.dtype != t.dtype
+                else g,
+                grads,
+                template,
+            )
             if decay != 1.0:
                 grads = jax.tree.map(lambda g: g * decay, grads)
             with self.time("updating model"):

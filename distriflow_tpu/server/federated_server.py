@@ -57,6 +57,16 @@ class FederatedServer(AbstractServer):
                 return False
             decay = self.hyperparams.staleness_decay**staleness
             vars_ = msg.gradients.vars
+            # validate against the published weights at receipt: a malformed
+            # upload is rejected alone instead of poisoning the whole
+            # buffered round at aggregation time (dtype may differ — clients
+            # choose gradient_compression independently)
+            expected = self.download_msg.model.vars
+            if set(vars_) != set(expected) or any(
+                vars_[k].shape != expected[k].shape for k in vars_
+            ):
+                self.log(f"dropping malformed upload from {msg.client_id}")
+                return False
             if decay != 1.0:
                 vars_ = _scale_serialized(vars_, decay)
             self.updates.append(vars_)

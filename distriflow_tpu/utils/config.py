@@ -60,6 +60,10 @@ def asdict(cfg: Any) -> Dict[str, Any]:
     return dataclasses.asdict(cfg)
 
 
+# allowed gradient_compression values (shared with AbstractClient.compress_grads)
+COMPRESSION_DTYPES = ("none", "float16", "bfloat16")
+
+
 @dataclass
 class ClientHyperparams:
     """Client-side training hyperparameters.
@@ -72,6 +76,11 @@ class ClientHyperparams:
     learning_rate: float = 0.001
     epochs: int = 5
     examples_per_update: int = 5
+    # wire-bandwidth knob (no reference counterpart — gradients there always
+    # travel at full precision): cast uploaded gradients to a 16-bit float
+    # before serialization, halving upload bytes; the server accumulates the
+    # mean in float32 either way. One of COMPRESSION_DTYPES.
+    gradient_compression: str = "none"
 
     def validate(self) -> "ClientHyperparams":
         if self.batch_size <= 0:
@@ -83,6 +92,11 @@ class ClientHyperparams:
         if self.examples_per_update <= 0:
             raise ValueError(
                 f"examples_per_update must be positive, got {self.examples_per_update}"
+            )
+        if self.gradient_compression not in COMPRESSION_DTYPES:
+            raise ValueError(
+                f"gradient_compression must be one of {COMPRESSION_DTYPES}, "
+                f"got {self.gradient_compression!r}"
             )
         return self
 

@@ -127,13 +127,18 @@ def mean_serialized(updates: Sequence[Dict[str, SerializedArray]], like: Any) ->
 
     The federated aggregation hot loop (reference stacks bytes then
     ``mean(0)`` on device, ``federated_server.ts:96-109``). Here the mean
-    runs host-side over zero-copy buffer views — multi-threaded C++ when
-    ``distriflow_tpu.native`` is built, numpy otherwise — so N client
-    buffers never get concatenated into an N-times-larger staging tensor.
+    runs host-side per leaf over buffer views — the multi-threaded C++
+    kernel when ``distriflow_tpu.native`` is built, numpy otherwise — with
+    no N-times-larger staging concat on the float paths.
+
+    Updates may mix dtypes per leaf (clients choose ``gradient_compression``
+    independently): each update is decoded with its own dtype. Float leaves
+    at <=32-bit accumulate in float32; float64/integer leaves accumulate in
+    float64. The result always lands on the template leaf's dtype.
     """
     if not updates:
         raise ValueError("mean_serialized needs at least one update")
-    _validate_matching_leaves(updates)
+    _validate_matching_leaves(updates, check_dtype=False)
     from distriflow_tpu import native  # lazy: optional build at import
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(like)
@@ -148,26 +153,40 @@ def mean_serialized(updates: Sequence[Dict[str, SerializedArray]], like: Any) ->
             raise ValueError(
                 f"shape mismatch at {key!r}: update {first.shape} vs template {tuple(t_shape)}"
             )
-        dt = _np_dtype(first.dtype)
         views = [
-            np.frombuffer(u[key].data, dtype=dt).reshape(first.shape)
+            np.frombuffer(u[key].data, dtype=_np_dtype(u[key].dtype)).reshape(first.shape)
             for u in updates
         ]
-        if dt == np.float32:
-            leaves.append(native.mean_buffers(views))
-        else:  # non-float leaves (rare): exact numpy path
-            leaves.append(np.mean(np.stack(views), axis=0).astype(dt))
+        t_dtype = np.dtype(getattr(template, "dtype", views[0].dtype))
+        if all(v.dtype.kind == "f" and v.dtype.itemsize <= 4 for v in views):
+            # fp32/16-bit floats: the C kernel casts each view to fp32
+            # individually (leaf-sized copies, no stacked staging tensor)
+            mean = native.mean_buffers(views)
+        else:
+            # float64 / integer leaves: float64 accumulation keeps the full
+            # mantissa (int means are exact below 2^53)
+            acc = np.zeros(first.shape, np.float64)
+            for v in views:
+                acc += v.astype(np.float64)
+            mean = acc / len(views)
+        if t_dtype.kind in "iu":
+            mean = np.rint(mean)
+        leaves.append(mean.astype(t_dtype) if mean.dtype != t_dtype else mean)
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def _validate_matching_leaves(updates: Sequence[Dict[str, SerializedArray]]) -> None:
+def _validate_matching_leaves(
+    updates: Sequence[Dict[str, SerializedArray]], check_dtype: bool = True
+) -> None:
+    """Cross-update invariants: key sets and shapes always; dtypes only where
+    the consumer needs homogeneous buffers (byte-level stacking)."""
     keys = set(updates[0].keys())
     for i, u in enumerate(updates[1:], start=1):
         if set(u.keys()) != keys:
             raise ValueError(f"update {i} has mismatched leaves vs update 0")
         for key in keys:
             s, first = u[key], updates[0][key]
-            if s.dtype != first.dtype or s.shape != first.shape:
+            if s.shape != first.shape or (check_dtype and s.dtype != first.dtype):
                 raise ValueError(
                     f"leaf {key!r} mismatch: {s.dtype}{s.shape} vs "
                     f"{first.dtype}{first.shape}"

@@ -1,0 +1,47 @@
+"""Subprocess entry for the multi-process federated integration test.
+
+Run: python tests/federated_worker.py <server_address> <seed>
+Connects a real FederatedClient from a separate OS process, pushes local
+data through distributed_update, prints the upload count, exits 0.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distriflow_tpu.client import FederatedClient
+    from distriflow_tpu.client.abstract_client import DistributedClientConfig
+    from distriflow_tpu.models import SpecModel, mnist_mlp
+
+    address, seed = sys.argv[1], int(sys.argv[2])
+    model = SpecModel(mnist_mlp(hidden=4))
+    client = FederatedClient(
+        address,
+        model,
+        DistributedClientConfig(
+            client_id=f"worker-{seed}",
+            hyperparams={"examples_per_update": 8, "batch_size": 8},
+        ),
+    )
+    client.setup(timeout=60.0)
+    rng = np.random.RandomState(seed)
+    x = rng.rand(16, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+    n = client.distributed_update(x, y)
+    print(f"worker {seed} uploaded {n} updates", flush=True)
+    client.dispose()
+    if n < 2:
+        sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

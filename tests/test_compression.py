@@ -1,0 +1,190 @@
+"""Gradient wire-compression tests.
+
+No reference counterpart (gradients there always travel at full precision);
+``gradient_compression`` casts uploads to 16-bit floats, halving wire bytes,
+while the server accumulates the mean in float32 and lands on the template
+(param) dtype.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.models import SpecModel, mnist_mlp
+from distriflow_tpu.utils.config import client_hyperparams
+from distriflow_tpu.utils.serialization import mean_serialized, serialize_tree
+
+
+def test_config_validation():
+    assert client_hyperparams({"gradient_compression": "float16"})
+    with pytest.raises(ValueError, match="gradient_compression"):
+        client_hyperparams({"gradient_compression": "int4"})
+
+
+def test_compress_grads_dtypes_and_bytes():
+    from distriflow_tpu.client.abstract_client import (
+        AbstractClient,
+        DistributedClientConfig,
+    )
+
+    class _Probe(AbstractClient):
+        """hyperparam() without a live connection."""
+
+        def __init__(self, compression):
+            self.config = DistributedClientConfig(
+                hyperparams={"gradient_compression": compression}
+            )
+            self.msg = None
+
+    grads = {"w": np.ones((64, 64), np.float32)}
+    full = serialize_tree(_Probe("none").compress_grads(grads))
+    half = serialize_tree(_Probe("float16").compress_grads(grads))
+    bf = serialize_tree(_Probe("bfloat16").compress_grads(grads))
+    key = next(iter(full))
+    assert half[key].nbytes == full[key].nbytes // 2
+    assert bf[key].nbytes == full[key].nbytes // 2
+    assert half[key].dtype == "float16"
+    assert bf[key].dtype == "bfloat16"
+
+
+@pytest.mark.parametrize("compression", ["float16", "bfloat16"])
+def test_mean_serialized_compressed_updates(compression):
+    import ml_dtypes
+
+    dt = np.float16 if compression == "float16" else np.dtype(ml_dtypes.bfloat16)
+    rng = np.random.RandomState(0)
+    template = {"w": np.zeros((32, 8), np.float32)}
+    exact = [rng.randn(32, 8).astype(np.float32) for _ in range(4)]
+    updates = [serialize_tree({"w": e.astype(dt)}) for e in exact]
+    got = mean_serialized(updates, template)
+    assert got["w"].dtype == np.float32  # landed on template dtype
+    # fp32 accumulation: error bounded by the 16-bit representation, not N
+    np.testing.assert_allclose(got["w"], np.mean(exact, 0), atol=2e-2)
+
+
+def test_end_to_end_compressed_federated(tmp_path):
+    """Compressed uploads over the real wire still train the server model."""
+    from distriflow_tpu.client import FederatedClient
+    from distriflow_tpu.client.abstract_client import DistributedClientConfig
+    from distriflow_tpu.server import FederatedServer
+    from distriflow_tpu.server.abstract_server import DistributedServerConfig
+    from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+    import jax
+
+    server = FederatedServer(
+        DistributedServerInMemoryModel(SpecModel(mnist_mlp(hidden=4))),
+        DistributedServerConfig(
+            save_dir=str(tmp_path),
+            server_hyperparams={"min_updates_per_version": 1},
+            # server-pushed hyperparams reach the client on download
+            client_hyperparams={"gradient_compression": "float16"},
+        ),
+    )
+    server.setup()
+    versions = []
+    server.on_new_version(versions.append)
+    uploaded_dtypes = []
+    server.on_upload(
+        lambda msg: uploaded_dtypes.extend(
+            s.dtype for s in msg.gradients.vars.values()
+        )
+    )
+    before = [np.asarray(l) for l in jax.tree.leaves(server.model.get_params())]
+
+    client = FederatedClient(
+        server.address,
+        SpecModel(mnist_mlp(hidden=4)),
+        DistributedClientConfig(hyperparams={"examples_per_update": 4}),
+    )
+    client.setup()
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4)]
+    assert client.distributed_update(x, y) == 1
+
+    deadline = time.time() + 20
+    while not versions and time.time() < deadline:
+        time.sleep(0.05)
+    assert versions, "no aggregation"
+    assert uploaded_dtypes and all(d == "float16" for d in uploaded_dtypes)
+    after = [np.asarray(l) for l in jax.tree.leaves(server.model.get_params())]
+    assert any(not np.allclose(a, b) for a, b in zip(before, after))
+    assert all(a.dtype == np.float32 for a in after)  # params stayed fp32
+    client.dispose()
+    server.stop()
+
+
+def test_mean_serialized_mixed_dtypes():
+    """Clients choose compression independently; aggregation decodes each."""
+    rng = np.random.RandomState(3)
+    template = {"w": np.zeros((16, 4), np.float32)}
+    exact = [rng.randn(16, 4).astype(np.float32) for _ in range(3)]
+    updates = [
+        serialize_tree({"w": exact[0]}),                      # fp32 client
+        serialize_tree({"w": exact[1].astype(np.float16)}),   # fp16 client
+        serialize_tree({"w": exact[2]}),                      # fp32 client
+    ]
+    got = mean_serialized(updates, template)
+    assert got["w"].dtype == np.float32
+    np.testing.assert_allclose(got["w"], np.mean(exact, 0), atol=2e-2)
+
+
+def test_mean_serialized_float64_precision():
+    """float64 leaves accumulate in float64 (no fp32 truncation)."""
+    template = {"w": np.zeros((2,), np.float64)}
+    vals = [np.array([1e-9, 1.0 + 1e-12], np.float64),
+            np.array([3e-9, 1.0 - 1e-12], np.float64)]
+    got = mean_serialized([serialize_tree({"w": v}) for v in vals], template)
+    assert got["w"].dtype == np.float64
+    np.testing.assert_allclose(got["w"], np.mean(vals, 0), rtol=0, atol=1e-15)
+
+
+def test_local_hyperparams_fail_fast():
+    """Typo'd local hyperparams raise at construction, not mid-upload."""
+    from distriflow_tpu.client.federated_client import FederatedClient
+    from distriflow_tpu.client.abstract_client import DistributedClientConfig
+
+    with pytest.raises(ValueError, match="gradient_compression"):
+        FederatedClient(
+            "127.0.0.1:1", SpecModel(mnist_mlp(hidden=4)),
+            DistributedClientConfig(hyperparams={"gradient_compression": "fp16"}),
+        )
+    with pytest.raises(KeyError):  # unknown key (strict-key override)
+        FederatedClient(
+            "127.0.0.1:1", SpecModel(mnist_mlp(hidden=4)),
+            DistributedClientConfig(hyperparams={"gradientCompression": "float16"}),
+        )
+
+
+def test_malformed_upload_rejected_alone(tmp_path):
+    """A wrong-shape upload is dropped at receipt; the round survives."""
+    from distriflow_tpu.server import FederatedServer
+    from distriflow_tpu.server.abstract_server import DistributedServerConfig
+    from distriflow_tpu.server.models import DistributedServerInMemoryModel
+    from distriflow_tpu.utils.messages import GradientMsg, UploadMsg
+    from tests.mock_model import MockModel
+
+    server = FederatedServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(
+            save_dir=str(tmp_path),
+            server_hyperparams={"min_updates_per_version": 2},
+        ),
+    )
+    server.setup()
+    try:
+        version = server.model.version
+        good = serialize_tree(server.model.get_params())
+        bad = serialize_tree({"w": np.zeros((99,), np.float32),
+                              "b": np.zeros((2,), np.float32)})
+        assert not server.handle_upload(
+            "c1", UploadMsg(client_id="c1", gradients=GradientMsg(version, bad))
+        )
+        assert server.handle_upload(
+            "c2", UploadMsg(client_id="c2", gradients=GradientMsg(version, good))
+        )
+        assert len(server.updates) == 1  # only the well-formed upload buffered
+    finally:
+        server.stop()

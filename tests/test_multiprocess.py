@@ -1,0 +1,88 @@
+"""True multi-process federated training test.
+
+The reference only ever tested client+server inside one process over
+loopback sockets (``src/test/federated_api_test.ts``; SURVEY.md §4: "no
+multi-process tests"). Here real OS processes — the deployment shape the
+federated mode exists for — connect over TCP, upload gradients, and the
+server aggregates across them.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.models import SpecModel, mnist_mlp
+from distriflow_tpu.server import FederatedServer
+from distriflow_tpu.server.abstract_server import DistributedServerConfig
+from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "federated_worker.py")
+
+
+def test_two_process_federated_round(tmp_path):
+    server = FederatedServer(
+        DistributedServerInMemoryModel(SpecModel(mnist_mlp(hidden=4))),
+        DistributedServerConfig(
+            save_dir=str(tmp_path / "models"),
+            # threshold = total uploads: aggregation fires exactly once, after
+            # every worker's every chunk is buffered — deterministic under the
+            # updating-flag drop rule (uploads racing an in-flight aggregation
+            # are rejected, reference federated_server.ts:73)
+            server_hyperparams={"min_updates_per_version": 4},
+        ),
+    )
+    server.setup()
+    versions = []
+    server.on_new_version(versions.append)
+    uploads = []
+    server.on_upload(uploads.append)
+    initial_params = server.model.get_params()
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # workers don't need the 8-device mesh
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, server.address, str(seed)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for seed in (1, 2)
+    ]
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            assert p.returncode == 0, f"worker failed:\n{out}"
+            assert "uploaded 2 updates" in out
+        deadline = time.time() + 30
+        while not versions and time.time() < deadline:
+            time.sleep(0.1)
+        # 2 workers x 2 uploads, threshold 4 -> exactly one aggregation
+        assert len(versions) == 1, f"aggregations: {versions}"
+        assert len(uploads) == 4
+        assert {u.client_id for u in uploads} == {"worker-1", "worker-2"}
+        # aggregated gradients actually moved the canonical params
+        moved = any(
+            not np.allclose(a, b)
+            for a, b in zip(
+                _leaves(initial_params), _leaves(server.model.get_params())
+            )
+        )
+        assert moved, "server params unchanged after aggregation"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+def _leaves(tree):
+    import jax
+
+    return [np.asarray(l) for l in jax.tree.leaves(tree)]
