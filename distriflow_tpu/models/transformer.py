@@ -162,6 +162,114 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+class StageBlocks(nn.Module):
+    """One pipeline stage: ``per`` consecutive transformer blocks."""
+
+    config: TransformerConfig
+    per: int = 1
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i in range(self.per):
+            x = Block(self.config, self.mesh, name=f"block_{i}")(x)
+        return x
+
+
+class _EmbedIn(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        return nn.Embed(cfg.vocab_size, cfg.d_model, name="embed",
+                        dtype=cfg.dtype)(tokens)
+
+
+class _HeadOut(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
+        logits = nn.Dense(cfg.vocab_size, name="lm_head", dtype=cfg.dtype,
+                          use_bias=False)(x)
+        return logits.astype(jnp.float32)
+
+
+def pipelined_transformer_lm(
+    config: Optional[TransformerConfig] = None,
+    mesh: Optional[Mesh] = None,
+    num_microbatches: Optional[int] = None,
+    example_seq: int = 128,
+    example_batch: Optional[int] = None,
+    **overrides: Any,
+) -> ModelSpec:
+    """Pipeline-parallel causal LM over the mesh's ``pipe`` axis (DP x PP).
+
+    The layer stack splits into P = ``mesh.shape['pipe']`` stages of
+    ``n_layers / P`` blocks; stage params carry a leading stages dim sharded
+    over ``pipe`` and the batch runs through the GPipe schedule
+    (``distriflow_tpu.parallel.pipeline.gpipe``) in ``num_microbatches``
+    microbatches (default P), each microbatch's rows sharded over ``data``.
+    Embedding and head live outside the pipeline (standard practice: they
+    are not shape-preserving). Attention inside stages is dense/flash — ring
+    (seq) attention composes with the non-pipelined ``transformer_lm`` path.
+
+    Shard params with ``PIPELINED_TRANSFORMER_RULES``
+    (``distriflow_tpu/parallel/sharding.py``).
+    """
+    from distriflow_tpu.parallel.pipeline import gpipe  # lazy: layer order
+
+    if config is None:
+        config = TransformerConfig(**overrides)
+    elif overrides:
+        config = dataclasses.replace(config, **overrides)
+    if mesh is None or "pipe" not in mesh.shape or mesh.shape["pipe"] < 2:
+        raise ValueError("pipelined_transformer_lm needs a mesh with pipe >= 2")
+    n_stages = mesh.shape["pipe"]
+    if config.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {config.n_layers} not divisible by pipe axis {n_stages}"
+        )
+    per = config.n_layers // n_stages
+    m = num_microbatches or n_stages
+
+    embed_mod = _EmbedIn(config)
+    head_mod = _HeadOut(config)
+    stage_mod = StageBlocks(config, per=per)  # mesh=None: dense attn in-stage
+    if example_batch is None:
+        example_batch = mesh.shape["data"] * m
+
+    def init(rng: jax.Array) -> Any:
+        r_embed, r_head, *r_stages = jax.random.split(rng, 2 + n_stages)
+        tokens = jnp.zeros((example_batch, example_seq), jnp.int32)
+        embed_params = embed_mod.init(r_embed, tokens)
+        h = jnp.zeros((example_batch, example_seq, config.d_model), config.dtype)
+        stages = [stage_mod.init(r, h) for r in r_stages]
+        stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *stages)
+        return {
+            "embed": embed_params,
+            "stages": stacked,
+            "head": head_mod.init(r_head, h),
+        }
+
+    def apply(params: Any, tokens: jnp.ndarray) -> jnp.ndarray:
+        h = embed_mod.apply(params["embed"], tokens)
+        h = gpipe(stage_mod.apply, params["stages"], h, mesh, m)
+        return head_mod.apply(params["head"], h)
+
+    return ModelSpec(
+        init=init,
+        apply=apply,
+        loss="softmax_cross_entropy",
+        input_shape=(example_seq,),
+        output_shape=(config.vocab_size,),
+        name="pipelined_transformer_lm",
+    )
+
+
 def transformer_lm(
     config: Optional[TransformerConfig] = None,
     mesh: Optional[Mesh] = None,

@@ -35,6 +35,7 @@ def gpipe(
     mesh: Mesh,
     num_microbatches: int,
     axis: str = "pipe",
+    data_axis: str = "data",
 ) -> jnp.ndarray:
     """Run ``x`` through P pipeline stages of ``stage_fn``.
 
@@ -42,6 +43,11 @@ def gpipe(
     params at index i), sharded (or shardable) over ``axis``. ``x``:
     ``[B, ...]`` with ``B`` divisible by ``num_microbatches``; output has
     ``x``'s shape (activation shape is stage-invariant).
+
+    Composes with data parallelism: when the mesh has a ``data_axis``, each
+    microbatch's rows shard over it (DP x PP — the ring permute moves
+    activations within each data slice), so the per-device activation is
+    ``[mb / data, ...]``, not the full microbatch.
     """
     p = mesh.shape[axis]
     m = num_microbatches
@@ -55,7 +61,13 @@ def gpipe(
             f"{p} devices — shard_map would silently drop stages"
         )
     mb = b // m
+    d = mesh.shape.get(data_axis, 1) if data_axis else 1
+    if mb % max(d, 1):
+        raise ValueError(
+            f"microbatch size {mb} not divisible by the {data_axis!r} axis ({d})"
+        )
     xs = x.reshape((m, mb) + x.shape[1:])
+    batch_spec = P(None, data_axis) if d > 1 else P()
 
     perm = [(i, (i + 1) % p) for i in range(p)]
 
@@ -97,11 +109,18 @@ def gpipe(
         )
         return outputs
 
+    # Hybrid manual/auto: only the pipe (and data) axes are manual in the
+    # body. Every other mesh axis stays automatic, so e.g. Megatron TP
+    # sharding on stage weights is preserved through the pipeline — XLA
+    # partitions the in-stage einsums and inserts the TP collectives itself
+    # instead of all-gathering the weights at the shard_map boundary.
+    manual = {axis} | ({data_axis} if d > 1 else set())
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), batch_spec),
+        out_specs=batch_spec,
+        axis_names=manual,
         check_vma=False,  # outputs are made uniform by the final psum
     )
     out = fn(stacked_params, xs)

@@ -40,6 +40,22 @@ TRANSFORMER_TP_RULES: Rules = (
 )
 
 
+# For models/transformer.py's pipelined_transformer_lm: stage params carry a
+# leading stages dim sharded over `pipe`; TP specs shift right by one dim.
+# Embed/head live outside the pipeline and keep plain TP sharding.
+PIPELINED_TRANSFORMER_RULES: Rules = (
+    (r".*stages.*experts_wi", P("pipe", "expert", None, "model")),
+    (r".*stages.*experts_wo", P("pipe", "expert", "model", None)),
+    (r".*stages.*router.*", P("pipe")),
+    (r".*stages.*(q_proj|k_proj|v_proj|wi|gate).*kernel", P("pipe", None, "model")),
+    (r".*stages.*(o_proj|wo).*kernel", P("pipe", "model", None)),
+    (r".*stages.*", P("pipe")),
+    (r".*(embed|lm_head).*", P(None, "model")),
+    (r".*(bias|scale)", P()),
+    (r".*", P()),
+)
+
+
 def spec_for_path(path: str, rules: Rules) -> P:
     for pattern, spec in rules:
         if re.search(pattern, path):

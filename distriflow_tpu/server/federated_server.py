@@ -61,10 +61,7 @@ class FederatedServer(AbstractServer):
             # upload is rejected alone instead of poisoning the whole
             # buffered round at aggregation time (dtype may differ — clients
             # choose gradient_compression independently)
-            expected = self.download_msg.model.vars
-            if set(vars_) != set(expected) or any(
-                vars_[k].shape != expected[k].shape for k in vars_
-            ):
+            if not self._well_formed(vars_):
                 self.log(f"dropping malformed upload from {msg.client_id}")
                 return False
             if decay != 1.0:
@@ -79,6 +76,28 @@ class FederatedServer(AbstractServer):
                 self.update_model()
             finally:
                 self.updating = False
+        return True
+
+    def _well_formed(self, vars_: Dict[str, SerializedArray]) -> bool:
+        """Keys and shapes match the published weights, the dtype parses,
+        and the payload length is consistent with shape x itemsize (a
+        truncated buffer would otherwise only explode at aggregation)."""
+        import numpy as np
+
+        from distriflow_tpu.utils.serialization import _np_dtype
+
+        expected = self.download_msg.model.vars
+        if set(vars_) != set(expected):
+            return False
+        for k, s in vars_.items():
+            if s.shape != expected[k].shape:
+                return False
+            try:
+                itemsize = _np_dtype(s.dtype).itemsize
+            except Exception:
+                return False
+            if len(s.data) != itemsize * int(np.prod(s.shape, dtype=np.int64)):
+                return False
         return True
 
     def _staleness(self, version: str) -> int:

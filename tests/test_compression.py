@@ -188,3 +188,44 @@ def test_malformed_upload_rejected_alone(tmp_path):
         assert len(server.updates) == 1  # only the well-formed upload buffered
     finally:
         server.stop()
+
+
+def test_truncated_upload_rejected(tmp_path):
+    """Right keys/shapes but truncated payload bytes: dropped at receipt."""
+    from distriflow_tpu.server import FederatedServer
+    from distriflow_tpu.server.abstract_server import DistributedServerConfig
+    from distriflow_tpu.server.models import DistributedServerInMemoryModel
+    from distriflow_tpu.utils.messages import GradientMsg, UploadMsg
+    from distriflow_tpu.utils.serialization import SerializedArray
+    from tests.mock_model import MockModel
+
+    server = FederatedServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(
+            save_dir=str(tmp_path),
+            server_hyperparams={"min_updates_per_version": 1},
+        ),
+    )
+    server.setup()
+    try:
+        version = server.model.version
+        good = serialize_tree(server.model.get_params())
+        truncated = {
+            k: SerializedArray(dtype=s.dtype, shape=s.shape, data=s.data[:8])
+            for k, s in good.items()
+        }
+        bad_dtype = {
+            k: SerializedArray(dtype="float7", shape=s.shape, data=s.data)
+            for k, s in good.items()
+        }
+        for bad in (truncated, bad_dtype):
+            assert not server.handle_upload(
+                "c1", UploadMsg(client_id="c1", gradients=GradientMsg(version, bad))
+            )
+            assert not server.updates
+        # well-formed still aggregates (threshold 1)
+        assert server.handle_upload(
+            "c2", UploadMsg(client_id="c2", gradients=GradientMsg(version, good))
+        )
+    finally:
+        server.stop()
