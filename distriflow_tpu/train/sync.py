@@ -23,6 +23,9 @@ writes versioned directories with a ``current`` pointer.
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
+import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -66,6 +69,18 @@ jax.tree_util.register_pytree_node(
 )
 
 
+class _SaveItem:
+    """One queued checkpoint write: carries its own completion + error."""
+
+    __slots__ = ("version", "host_state", "done", "error")
+
+    def __init__(self, version: str, host_state: Any):
+        self.version = version
+        self.host_state = host_state
+        self.done = threading.Event()
+        self.error: Optional[Exception] = None
+
+
 class SyncTrainer:
     """One-jit-step synchronous trainer over a device mesh.
 
@@ -86,6 +101,8 @@ class SyncTrainer:
         grad_accum: int = 1,
         donate: bool = True,
         verbose: Optional[bool] = None,
+        checkpoint_dir: Optional[str] = None,
+        save_every: int = 0,
     ):
         self.spec = spec
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
@@ -97,6 +114,20 @@ class SyncTrainer:
         self.state: Optional[TrainState] = None
         self._step_fn = self._build_step(donate)
         self._eval_fn = None
+        # observability (reference time()/log wrappers, abstract_server.ts:92-103)
+        self.last_step_ms: Optional[float] = None
+        self._step_times: List[float] = []  # rolling window
+        # checkpointing (reference saves on every update, server/models.ts:132-138;
+        # here save_every is explicit and the write happens off-thread)
+        self.store = None
+        self.save_every = save_every
+        if checkpoint_dir is not None:
+            from distriflow_tpu.checkpoint.store import CheckpointStore
+
+            self.store = CheckpointStore(checkpoint_dir)
+        self._save_queue: Optional[queue.Queue] = None
+        self._save_thread: Optional[threading.Thread] = None
+        self._save_errors: List[Exception] = []
 
     # -- state ------------------------------------------------------------
 
@@ -184,10 +215,139 @@ class SyncTrainer:
         if self.state is None:
             self.init()
         batch = self._ensure_placed(batch)
+        start = time.perf_counter()
         self.state, loss = self._step_fn(self.state, batch)
+        loss = float(loss)  # blocks: the step really finished
+        self.last_step_ms = (time.perf_counter() - start) * 1e3
+        self._step_times.append(self.last_step_ms)
+        if len(self._step_times) > 100:
+            del self._step_times[:-100]
+        if self.save_every and self.store is not None and self.version % self.save_every == 0:
+            self.save(drop_if_busy=True)
         self.callbacks.fire("step", self)
         self.callbacks.fire("new_version", str(int(self.state.step)))
-        return float(loss)
+        return loss
+
+    @property
+    def mean_step_ms(self) -> Optional[float]:
+        """Rolling mean step wall time (last 100 steps)."""
+        if not self._step_times:
+            return None
+        return sum(self._step_times) / len(self._step_times)
+
+    def profile(self, log_dir: str):
+        """Context manager capturing a ``jax.profiler`` trace of the enclosed
+        steps (the TPU-native upgrade of the reference's wall-clock ``time``
+        logging, ``abstract_server.ts:98-103``). View with TensorBoard."""
+        from distriflow_tpu.utils.profiling import trace
+
+        return trace(log_dir)
+
+    # -- checkpointing -----------------------------------------------------
+
+    def save(self, wait: bool = False, drop_if_busy: bool = False) -> Optional[str]:
+        """Checkpoint the full TrainState (params + opt state + step).
+
+        The device->host gather happens on the caller's thread (cheap,
+        overlaps with nothing the devices need); the file write runs on a
+        background writer so the training loop never stalls on disk. The
+        queue is bounded (pending host snapshots are full state copies):
+        ``save()`` blocks for a slot (backpressure), auto-saves pass
+        ``drop_if_busy`` and skip instead. With ``wait`` the call blocks
+        until the write lands and raises that write's own error, if any.
+        """
+        if self.store is None:
+            raise RuntimeError("no checkpoint_dir configured")
+        if self.state is None:
+            raise RuntimeError("trainer not initialized")
+        version = str(self.version)
+        host_state = jax.device_get(
+            {"params": self.state.params, "opt_state": self.state.opt_state,
+             "step": self.state.step}
+        )
+        self._ensure_writer()
+        item = _SaveItem(version, host_state)
+        if drop_if_busy:
+            try:
+                self._save_queue.put_nowait(item)
+            except queue.Full:
+                self.logger.log(f"skipping checkpoint {version}: writer busy")
+                return None
+        else:
+            self._save_queue.put(item)
+        if wait:
+            item.done.wait()
+            if item.error is not None:
+                raise item.error
+        return version
+
+    def flush_saves(self) -> None:
+        """Block until every queued checkpoint write has landed; raises the
+        most recent failure since the last flush (then clears it)."""
+        if self._save_queue is not None:
+            self._save_queue.join()
+        if self._save_errors:
+            errors, self._save_errors = self._save_errors, []
+            raise errors[-1]
+
+    def close(self) -> None:
+        """Stop the checkpoint writer thread (flushes queued saves first)."""
+        if self._save_thread is not None and self._save_thread.is_alive():
+            self._save_queue.put(None)
+            self._save_thread.join(timeout=30)
+        self._save_thread = None
+
+    def restore(self, version: Optional[str] = None) -> bool:
+        """Resume from a checkpoint (latest by default). Returns False when
+        the store is empty (reference ``setup()`` resume, models.ts:98-111)."""
+        if self.store is None:
+            raise RuntimeError("no checkpoint_dir configured")
+        if self.state is None:
+            self.init()
+        version = version or self.store.last()
+        if version is None:
+            return False
+        like = {"params": self.state.params, "opt_state": self.state.opt_state,
+                "step": self.state.step}
+        # `like` is only read for tree structure and leaf shapes — device
+        # arrays serve directly, no device->host copy of the current state
+        host = self.store.load(version, like)
+        placed = jax.tree.map(
+            lambda v, cur: jax.device_put(v, cur.sharding),
+            host,
+            like,
+        )
+        self.state = TrainState(placed["params"], placed["opt_state"], placed["step"])
+        return True
+
+    def _ensure_writer(self) -> None:
+        if self._save_thread is not None and self._save_thread.is_alive():
+            return
+        # pending items are full host state snapshots: keep the queue tiny
+        self._save_queue = queue.Queue(maxsize=2)
+        # the closure captures only what the writer needs — not self — so a
+        # dropped trainer's device state is not pinned by the thread
+        q, store, errors, logger = self._save_queue, self.store, self._save_errors, self.logger
+
+        def writer():
+            while True:
+                item = q.get()
+                try:
+                    if item is None:
+                        return
+                    try:
+                        store.save(item.host_state, version=item.version)
+                    except Exception as e:  # surface on save(wait)/flush
+                        item.error = e
+                        errors.append(e)
+                        logger.log(f"checkpoint save failed: {e!r}")
+                    item.host_state = None  # release the snapshot promptly
+                    item.done.set()
+                finally:
+                    q.task_done()
+
+        self._save_thread = threading.Thread(target=writer, daemon=True)
+        self._save_thread.start()
 
     def step_async(self, batch: Batch) -> jnp.ndarray:
         """Like :meth:`step` but does not block on the loss (keeps the device

@@ -1,0 +1,129 @@
+"""SyncTrainer checkpoint/resume + observability tests.
+
+Reference persistence saves on every update on the serving thread
+(``server/models.ts:132-138``); here the trainer checkpoints the full
+TrainState (params + optimizer state + step) off-thread and resumes either
+the latest or a named version.
+"""
+
+import numpy as np
+import jax
+
+from distriflow_tpu.models import mnist_mlp
+from distriflow_tpu.parallel import data_parallel_mesh, shard_batch
+from distriflow_tpu.train.sync import SyncTrainer
+
+
+def _batch(mesh, n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    return shard_batch(mesh, (x, y))
+
+
+def _params_equal(a, b):
+    return all(
+        np.allclose(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def test_save_restore_roundtrip(tmp_path, devices):
+    mesh = data_parallel_mesh(devices)
+    trainer = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, optimizer="adam",
+                          learning_rate=1e-3, checkpoint_dir=str(tmp_path))
+    trainer.init(jax.random.PRNGKey(0))
+    batch = _batch(mesh)
+    for _ in range(3):
+        trainer.step(batch)
+    saved_version = trainer.save(wait=True)
+    assert saved_version == "3"
+    saved_params = jax.device_get(trainer.state.params)
+
+    for _ in range(2):
+        trainer.step(batch)
+    assert not _params_equal(saved_params, trainer.state.params)
+
+    assert trainer.restore()  # latest == "3"
+    assert trainer.version == 3
+    assert _params_equal(saved_params, trainer.state.params)
+    # optimizer state restored too: continuing matches a never-interrupted run
+    loss_resumed = trainer.step(batch)
+    assert np.isfinite(loss_resumed)
+
+
+def test_restore_empty_store_returns_false(tmp_path, devices):
+    trainer = SyncTrainer(mnist_mlp(hidden=8), mesh=data_parallel_mesh(devices),
+                          checkpoint_dir=str(tmp_path))
+    trainer.init(jax.random.PRNGKey(0))
+    assert trainer.restore() is False
+
+
+def test_save_every_autosaves_async(tmp_path, devices):
+    mesh = data_parallel_mesh(devices)
+    trainer = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh,
+                          checkpoint_dir=str(tmp_path), save_every=2)
+    trainer.init(jax.random.PRNGKey(0))
+    batch = _batch(mesh)
+    for _ in range(5):
+        trainer.step(batch)
+    trainer.flush_saves()
+    assert set(trainer.store.list()) == {"2", "4"}
+    assert trainer.store.last() == "4"
+
+
+def test_step_timing_stats(devices):
+    mesh = data_parallel_mesh(devices)
+    trainer = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh)
+    trainer.init(jax.random.PRNGKey(0))
+    batch = _batch(mesh)
+    assert trainer.last_step_ms is None
+    trainer.step(batch)
+    trainer.step(batch)
+    assert trainer.last_step_ms > 0
+    assert trainer.mean_step_ms > 0
+
+
+def test_fresh_trainer_resumes_other_trainers_checkpoint(tmp_path, devices):
+    """The resume story across process restarts (reference setup())."""
+    mesh = data_parallel_mesh(devices)
+    t1 = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, optimizer="momentum",
+                     checkpoint_dir=str(tmp_path))
+    t1.init(jax.random.PRNGKey(0))
+    batch = _batch(mesh)
+    for _ in range(2):
+        t1.step(batch)
+    t1.save(wait=True)
+
+    t2 = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, optimizer="momentum",
+                     checkpoint_dir=str(tmp_path))
+    t2.init(jax.random.PRNGKey(42))  # different init: must be overwritten
+    assert t2.restore()
+    assert t2.version == 2
+    assert _params_equal(t1.state.params, t2.state.params)
+
+
+def test_save_error_isolated_per_write(tmp_path, devices):
+    """A failed write surfaces once, then recovery: later saves succeed."""
+    import pytest
+
+    mesh = data_parallel_mesh(devices)
+    trainer = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh,
+                          checkpoint_dir=str(tmp_path / "ckpt"))
+    trainer.init(jax.random.PRNGKey(0))
+    trainer.step(_batch(mesh))
+
+    real_save = trainer.store.save
+    trainer.store.save = lambda *a, **k: (_ for _ in ()).throw(OSError("disk full"))
+    with pytest.raises(OSError):
+        trainer.save(wait=True)
+    trainer.store.save = real_save
+
+    # the old failure must not poison this save or the final flush
+    assert trainer.save(wait=True) == "1"
+    with pytest.raises(OSError):
+        trainer.flush_saves()  # reports the recorded failure once...
+    trainer.flush_saves()      # ...then it is cleared
+    assert trainer.store.last() == "1"
+    trainer.close()
+    assert trainer._save_thread is None
