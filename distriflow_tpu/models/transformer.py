@@ -43,8 +43,16 @@ class TransformerConfig:
     n_experts: int = 0  # 0 = dense FFN; >0 = MoE with EP-shardable experts
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
+    use_ulysses_attention: bool = False  # all-to-all SP (parallel/ulysses.py)
     use_flash_attention: bool = False  # Pallas kernel (distriflow_tpu/ops)
     causal: bool = True
+
+    def __post_init__(self):
+        if self.use_ring_attention and self.use_ulysses_attention:
+            raise ValueError(
+                "use_ring_attention and use_ulysses_attention are mutually "
+                "exclusive sequence-parallel strategies; pick one"
+            )
 
 
 class Attention(nn.Module):
@@ -64,8 +72,15 @@ class Attention(nn.Module):
         k = dense("k_proj")(x)
         v = dense("v_proj")(x)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, S, D]
-        if cfg.use_ring_attention and self.mesh is not None and self.mesh.shape["seq"] > 1:
+        seq_size = (
+            dict(self.mesh.shape).get("seq", 1) if self.mesh is not None else 1
+        )
+        if cfg.use_ring_attention and seq_size > 1:
             out = ring_attention(q, k, v, self.mesh, axis="seq", causal=cfg.causal)
+        elif cfg.use_ulysses_attention and seq_size > 1:
+            from distriflow_tpu.parallel.ulysses import ulysses_attention
+
+            out = ulysses_attention(q, k, v, self.mesh, axis="seq", causal=cfg.causal)
         elif cfg.use_flash_attention:
             from distriflow_tpu.ops import flash_attention  # lazy: pallas import
 

@@ -261,11 +261,16 @@ class SyncTrainer:
         if self.state is None:
             raise RuntimeError("trainer not initialized")
         version = str(self.version)
+        self._ensure_writer()
+        if drop_if_busy and self._save_queue.full():
+            # check BEFORE the gather: a skipped autosave must not pay a
+            # full device->host copy of the state just to discard it
+            self.logger.log(f"skipping checkpoint {version}: writer busy")
+            return None
         host_state = jax.device_get(
             {"params": self.state.params, "opt_state": self.state.opt_state,
              "step": self.state.step}
         )
-        self._ensure_writer()
         item = _SaveItem(version, host_state)
         if drop_if_busy:
             try:
@@ -287,7 +292,10 @@ class SyncTrainer:
         if self._save_queue is not None:
             self._save_queue.join()
         if self._save_errors:
-            errors, self._save_errors = self._save_errors, []
+            # clear in place: the writer closure holds a reference to this
+            # exact list — rebinding would hide all subsequent failures
+            errors = list(self._save_errors)
+            self._save_errors.clear()
             raise errors[-1]
 
     def close(self) -> None:
