@@ -6,5 +6,13 @@ from distriflow_tpu.data.dataset import (
     batch_to_data_msg,
     sample_batch,
 )
+from distriflow_tpu.data.prefetch import prefetch_to_device, sampling_iterator
 
-__all__ = ["Batch", "DistributedDataset", "batch_to_data_msg", "sample_batch"]
+__all__ = [
+    "Batch",
+    "DistributedDataset",
+    "batch_to_data_msg",
+    "sample_batch",
+    "prefetch_to_device",
+    "sampling_iterator",
+]

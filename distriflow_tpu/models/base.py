@@ -41,10 +41,26 @@ Params = Any  # a pytree of arrays
 Batch = Tuple[jnp.ndarray, jnp.ndarray]
 
 
-def _optimizer(name: str, learning_rate: float) -> optax.GradientTransformation:
+def _optimizer(
+    name: Union[str, optax.GradientTransformation],
+    learning_rate: Union[float, Callable[[Any], Any]],
+) -> optax.GradientTransformation:
     """Optimizer registry. The reference hardcodes 'sgd' (``models.ts:88``);
-    here sgd is the parity default and the registry is open via optax."""
-    registry: Dict[str, Callable[[float], optax.GradientTransformation]] = {
+    here sgd is the parity default and the registry is open via optax.
+
+    ``name`` may also be a ready-made ``optax.GradientTransformation``
+    (passed through untouched — bring any chain), and ``learning_rate`` may
+    be an optax schedule (step -> lr), e.g. from
+    ``distriflow_tpu.train.schedules``.
+    """
+    if isinstance(name, optax.GradientTransformation):
+        if learning_rate not in (None, 0.001):  # 0.001 = every caller's default
+            raise ValueError(
+                "learning_rate is ignored when passing a ready-made optax "
+                "transformation — set the rate inside the chain instead"
+            )
+        return name
+    registry: Dict[str, Callable[[Any], optax.GradientTransformation]] = {
         "sgd": optax.sgd,
         "momentum": lambda lr: optax.sgd(lr, momentum=0.9),
         "adam": optax.adam,

@@ -1,0 +1,70 @@
+"""Learning-rate schedule registry.
+
+No reference counterpart (the reference's learning rate is a fixed client
+hyperparameter, ``src/common/utils.ts:183``). Schedules are optax step->lr
+callables; every trainer's ``learning_rate`` argument accepts one directly
+(``distriflow_tpu.models.base._optimizer`` passes schedules through to the
+optax constructors, which evaluate them against the on-device step count —
+no host round trip per step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import optax
+
+Schedule = Callable[[Any], Any]  # step -> learning rate
+
+
+def constant(value: float) -> Schedule:
+    return optax.constant_schedule(value)
+
+
+def cosine(init_value: float, decay_steps: int, alpha: float = 0.0) -> Schedule:
+    """Cosine decay from ``init_value`` to ``alpha * init_value``."""
+    return optax.cosine_decay_schedule(init_value, decay_steps, alpha)
+
+
+def warmup_cosine(
+    peak_value: float,
+    warmup_steps: int,
+    decay_steps: int,
+    init_value: float = 0.0,
+    end_value: float = 0.0,
+) -> Schedule:
+    """Linear warmup to ``peak_value`` then cosine decay to ``end_value`` —
+    the standard large-batch TPU recipe."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=init_value,
+        peak_value=peak_value,
+        warmup_steps=warmup_steps,
+        decay_steps=decay_steps,
+        end_value=end_value,
+    )
+
+
+def exponential(
+    init_value: float, transition_steps: int, decay_rate: float
+) -> Schedule:
+    return optax.exponential_decay(init_value, transition_steps, decay_rate)
+
+
+def linear(init_value: float, end_value: float, transition_steps: int) -> Schedule:
+    return optax.linear_schedule(init_value, end_value, transition_steps)
+
+
+SCHEDULES: Dict[str, Callable[..., Schedule]] = {
+    "constant": constant,
+    "cosine": cosine,
+    "warmup_cosine": warmup_cosine,
+    "exponential": exponential,
+    "linear": linear,
+}
+
+
+def get_schedule(name: str, **kwargs: Any) -> Schedule:
+    """Build a schedule by registry name (strict: unknown names raise)."""
+    if name not in SCHEDULES:
+        raise KeyError(f"unknown schedule {name!r}; registered: {sorted(SCHEDULES)}")
+    return SCHEDULES[name](**kwargs)

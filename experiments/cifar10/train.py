@@ -22,9 +22,10 @@ import time
 import jax
 import numpy as np
 
-from distriflow_tpu.data.dataset import DistributedDataset, sample_batch
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.data.prefetch import prefetch_to_device, sampling_iterator
 from distriflow_tpu.models import cifar_convnet
-from distriflow_tpu.parallel import data_parallel_mesh, shard_batch
+from distriflow_tpu.parallel import data_parallel_mesh
 from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
 from distriflow_tpu.train.federated import FederatedAveragingTrainer
 from distriflow_tpu.train.sync import SyncTrainer
@@ -38,12 +39,12 @@ def run_sync(args, spec, train, val) -> float:
                           optimizer=args.optimizer, verbose=True)
     trainer.init(jax.random.PRNGKey(args.seed))
     x, y = to_xy(train)
-    n = len(x)
-    rng = np.random.RandomState(args.seed)
     start = time.perf_counter()
-    for step in range(args.steps):
-        idx = rng.randint(0, n, args.batch_size)
-        batch = shard_batch(mesh, sample_batch(x, y, idx))
+    stream = prefetch_to_device(
+        sampling_iterator(x, y, args.batch_size, steps=args.steps, seed=args.seed),
+        mesh,
+    )
+    for step, batch in enumerate(stream):
         loss = trainer.step(batch)
         if step % 20 == 0:
             print(f"step {step} loss {loss:.4f}", file=sys.stderr)

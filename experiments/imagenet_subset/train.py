@@ -15,11 +15,10 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from distriflow_tpu.data.dataset import sample_batch
+from distriflow_tpu.data.prefetch import prefetch_to_device, sampling_iterator
 from distriflow_tpu.models.mobilenet import mobilenet_v2
-from distriflow_tpu.parallel import data_parallel_mesh, shard_batch
+from distriflow_tpu.parallel import data_parallel_mesh
 from distriflow_tpu.train.sync import SyncTrainer
 
 from experiments.imagenet_subset.data import load_splits, to_xy
@@ -55,12 +54,12 @@ def main(argv=None) -> float:
     trainer.init(jax.random.PRNGKey(args.seed))
 
     x, y = to_xy(splits["train"], num_classes)
-    n = len(x)
-    rng = np.random.RandomState(args.seed)
     start = time.perf_counter()
-    for step in range(args.steps):
-        idx = rng.randint(0, n, args.batch_size)
-        batch = shard_batch(mesh, sample_batch(x, y, idx))
+    stream = prefetch_to_device(
+        sampling_iterator(x, y, args.batch_size, steps=args.steps, seed=args.seed),
+        mesh,
+    )
+    for step, batch in enumerate(stream):
         loss = trainer.step(batch)
         if step % 10 == 0:
             print(f"step {step} loss {loss:.4f}", file=sys.stderr)

@@ -1,0 +1,104 @@
+"""LR schedule registry + device prefetch tests."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distriflow_tpu.data.prefetch import prefetch_to_device, sampling_iterator
+from distriflow_tpu.models import mnist_mlp
+from distriflow_tpu.models.base import _optimizer
+from distriflow_tpu.parallel import data_parallel_mesh
+from distriflow_tpu.parallel.mesh import batch_sharding
+from distriflow_tpu.train.schedules import get_schedule
+from distriflow_tpu.train.sync import SyncTrainer
+
+
+# -- schedules ---------------------------------------------------------------
+
+
+def test_schedule_registry():
+    s = get_schedule("warmup_cosine", peak_value=0.1, warmup_steps=10, decay_steps=100)
+    assert float(s(0)) == 0.0
+    assert float(s(10)) == pytest.approx(0.1)
+    assert float(s(100)) < 0.1
+    with pytest.raises(KeyError, match="unknown schedule"):
+        get_schedule("cyclic")
+
+
+def test_optimizer_accepts_schedule_and_transform():
+    sched = get_schedule("cosine", init_value=0.1, decay_steps=50)
+    assert isinstance(_optimizer("adam", sched), optax.GradientTransformation)
+    chain = optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1))
+    assert _optimizer(chain, 0.0) is chain
+    with pytest.raises(KeyError, match="unknown optimizer"):
+        _optimizer("lion", 0.1)
+
+
+def test_trainer_with_schedule_and_custom_chain(devices):
+    mesh = data_parallel_mesh(devices)
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 32)]
+
+    sched = get_schedule("warmup_cosine", peak_value=5e-3, warmup_steps=2,
+                         decay_steps=20)
+    t1 = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, learning_rate=sched,
+                     optimizer="adam")
+    t1.init(jax.random.PRNGKey(0))
+    losses = [t1.step((x, y)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+    chain = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
+    t2 = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, optimizer=chain)
+    t2.init(jax.random.PRNGKey(0))
+    losses = [t2.step((x, y)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+# -- prefetch ----------------------------------------------------------------
+
+
+def test_prefetch_preserves_order_and_places(devices):
+    mesh = data_parallel_mesh(devices)
+    batches = [(np.full((8, 2), i, np.float32), np.full((8,), i, np.float32))
+               for i in range(7)]
+    out = list(prefetch_to_device(iter(batches), mesh, size=3))
+    assert len(out) == 7
+    sharding = batch_sharding(mesh)
+    for i, (x, y) in enumerate(out):
+        assert float(x[0, 0]) == i and float(y[0]) == i
+        assert x.sharding == sharding
+
+
+def test_prefetch_size_validation(devices):
+    with pytest.raises(ValueError, match="size"):
+        list(prefetch_to_device(iter([]), data_parallel_mesh(), size=0))
+
+
+def test_sampling_iterator_shapes():
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.eye(10, dtype=np.float32)
+    it = sampling_iterator(x, y, batch_size=6, steps=3, seed=1)
+    batches = list(it)
+    assert len(batches) == 3
+    assert all(bx.shape == (6, 4) and by.shape == (6, 10) for bx, by in batches)
+
+
+def test_prefetched_training_loop(devices):
+    """The intended composition: sampler -> prefetch -> trainer."""
+    mesh = data_parallel_mesh(devices)
+    rng = np.random.RandomState(0)
+    x = rng.rand(256, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 256)]
+    trainer = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, learning_rate=1e-2,
+                          optimizer="momentum")
+    trainer.init(jax.random.PRNGKey(0))
+    losses = [
+        trainer.step(batch)
+        for batch in prefetch_to_device(
+            sampling_iterator(x, y, batch_size=64, steps=10), mesh
+        )
+    ]
+    assert len(losses) == 10
+    assert losses[-1] < losses[0]
