@@ -54,11 +54,8 @@ def _optimizer(
     ``distriflow_tpu.train.schedules``.
     """
     if isinstance(name, optax.GradientTransformation):
-        if learning_rate not in (None, 0.001):  # 0.001 = every caller's default
-            raise ValueError(
-                "learning_rate is ignored when passing a ready-made optax "
-                "transformation — set the rate inside the chain instead"
-            )
+        # learning_rate is ignored for ready-made transformations (the rate
+        # lives inside the chain); 0.0/None/0.001 are the common "unset" values
         return name
     registry: Dict[str, Callable[[Any], optax.GradientTransformation]] = {
         "sgd": optax.sgd,
