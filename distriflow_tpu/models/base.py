@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -43,7 +44,8 @@ Batch = Tuple[jnp.ndarray, jnp.ndarray]
 
 def _optimizer(
     name: Union[str, optax.GradientTransformation],
-    learning_rate: Union[float, Callable[[Any], Any]],
+    learning_rate: Union[None, float, Callable[[Any], Any]],
+    default_rate: float = 0.001,
 ) -> optax.GradientTransformation:
     """Optimizer registry. The reference hardcodes 'sgd' (``models.ts:88``);
     here sgd is the parity default and the registry is open via optax.
@@ -51,12 +53,23 @@ def _optimizer(
     ``name`` may also be a ready-made ``optax.GradientTransformation``
     (passed through untouched — bring any chain), and ``learning_rate`` may
     be an optax schedule (step -> lr), e.g. from
-    ``distriflow_tpu.train.schedules``.
+    ``distriflow_tpu.train.schedules``. ``None`` means "unset": the caller's
+    ``default_rate`` applies (the reference client default 0.001,
+    ``src/common/utils.ts:183``), and no ignored-rate warning can fire when
+    a ready-made transformation is supplied.
     """
     if isinstance(name, optax.GradientTransformation):
-        # learning_rate is ignored for ready-made transformations (the rate
-        # lives inside the chain); 0.0/None/0.001 are the common "unset" values
+        if learning_rate is not None:
+            # the rate lives inside the chain; an explicit learning_rate
+            # would be silently dropped — say so
+            warnings.warn(
+                "learning_rate is ignored when passing a ready-made optax "
+                "transformation — set the rate inside the chain instead",
+                stacklevel=2,
+            )
         return name
+    if learning_rate is None:
+        learning_rate = default_rate
     registry: Dict[str, Callable[[Any], optax.GradientTransformation]] = {
         "sgd": optax.sgd,
         "momentum": lambda lr: optax.sgd(lr, momentum=0.9),
@@ -177,7 +190,7 @@ class SpecModel(DistributedModel):
         self,
         spec: ModelSpec,
         compile_config: Optional[CompileConfig] = None,
-        learning_rate: float = 0.001,
+        learning_rate: Optional[float] = None,  # None -> 0.001 (reference default)
         params: Optional[Params] = None,
         rng: Optional[jax.Array] = None,
     ):
@@ -187,7 +200,7 @@ class SpecModel(DistributedModel):
             # honor an explicitly-configured loss over the spec default (the
             # reference silently ignored it; src/common/models.ts:139)
             self.spec = dataclasses.replace(spec, loss=self.compile_config.loss)
-        self.learning_rate = learning_rate
+        self.learning_rate = 0.001 if learning_rate is None else learning_rate
         self._params = params
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._optimizer = _optimizer(self.compile_config.optimizer, learning_rate)

@@ -29,7 +29,7 @@ class DistributedDynamicModel(SpecModel):
         loss: str = "softmax_cross_entropy",
         input_shape: Sequence[int] = (),
         output_shape: Sequence[int] = (),
-        learning_rate: float = 0.001,
+        learning_rate: Optional[float] = None,  # None -> 0.001 (reference default)
         name: str = "dynamic",
     ):
         initial = jax.tree.map(jnp.asarray, params)

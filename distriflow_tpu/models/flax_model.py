@@ -68,7 +68,7 @@ class DistributedFlaxModel(SpecModel):
         input_shape: Sequence[int],
         output_shape: Sequence[int] = (),
         compile_config: Optional[CompileConfig] = None,
-        learning_rate: float = 0.001,
+        learning_rate: Optional[float] = None,  # None -> 0.001 (reference default)
         rng: Optional[jax.Array] = None,
     ):
         cc = compile_config or CompileConfig()

@@ -62,6 +62,10 @@ class DistributedServerConfig:
 class AbstractServer:
     """Shared mechanics of FederatedServer/AsynchronousSGDServer."""
 
+    #: subclass hook: how config.server_hyperparams becomes ServerHyperparams
+    #: (the async server swaps in its tolerant staleness default)
+    _hyperparams_factory = staticmethod(server_hyperparams)
+
     def __init__(
         self,
         model: DistributedModel | DistributedServerModel,
@@ -78,7 +82,7 @@ class AbstractServer:
         self.client_hyperparams: ClientHyperparams = client_hyperparams(
             self.config.client_hyperparams
         )
-        self.hyperparams: ServerHyperparams = server_hyperparams(
+        self.hyperparams: ServerHyperparams = self._hyperparams_factory(
             self.config.server_hyperparams
         )
         self.transport = transport or ServerTransport(

@@ -29,11 +29,21 @@ from distriflow_tpu.models.base import DistributedModel
 from distriflow_tpu.server.abstract_server import AbstractServer, DistributedServerConfig
 from distriflow_tpu.server.models import DistributedServerModel
 from distriflow_tpu.comm.transport import ServerTransport
+from distriflow_tpu.utils.config import (
+    ASYNC_DEFAULT_MAXIMUM_STALENESS,
+    async_server_hyperparams,
+)
 from distriflow_tpu.utils.messages import DownloadMsg, Events, UploadMsg
 from distriflow_tpu.utils.serialization import deserialize_tree
 
 
 class AsynchronousSGDServer(AbstractServer):
+    #: async-mode staleness default (see ``ASYNC_DEFAULT_MAXIMUM_STALENESS``)
+    DEFAULT_MAXIMUM_STALENESS = ASYNC_DEFAULT_MAXIMUM_STALENESS
+
+    # async mode tolerates in-flight staleness by default (sync default is 0)
+    _hyperparams_factory = staticmethod(async_server_hyperparams)
+
     def __init__(
         self,
         model: DistributedModel | DistributedServerModel,

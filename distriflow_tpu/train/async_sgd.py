@@ -35,7 +35,7 @@ import optax
 
 from distriflow_tpu.data.dataset import DistributedDataset
 from distriflow_tpu.models.base import ModelSpec, _optimizer
-from distriflow_tpu.utils.config import ServerHyperparams, server_hyperparams
+from distriflow_tpu.utils.config import ServerHyperparams, async_server_hyperparams
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 
 Params = Any
@@ -49,7 +49,7 @@ class AsyncSGDTrainer:
         spec: ModelSpec,
         dataset: DistributedDataset,
         devices: Optional[Sequence[jax.Device]] = None,
-        learning_rate: float = 0.001,
+        learning_rate: Optional[float] = None,  # None -> 0.001 (reference default)
         optimizer: str = "sgd",
         hyperparams: Optional[Dict[str, Any] | ServerHyperparams] = None,
         verbose: Optional[bool] = None,
@@ -58,9 +58,10 @@ class AsyncSGDTrainer:
         self.dataset = dataset
         self.devices = list(devices if devices is not None else jax.devices())
         if isinstance(hyperparams, ServerHyperparams):
+            # a ready-made dataclass is fully explicit — honor it verbatim
             self.hyperparams = hyperparams.validate()
         else:
-            self.hyperparams = server_hyperparams(hyperparams)
+            self.hyperparams = async_server_hyperparams(hyperparams)
         self.optimizer = _optimizer(optimizer, learning_rate)
         self.logger = VerboseLogger(f"AsyncSGD[{spec.name}]", verbose)
         self.callbacks = CallbackRegistry("new_version", "upload")

@@ -47,7 +47,7 @@ class FederatedAveragingTrainer:
         mesh: Optional[Mesh] = None,
         local_steps: int = 1,
         local_batch_size: int = 32,
-        learning_rate: float = 0.01,
+        learning_rate: Optional[float] = None,  # None -> 0.01 (FedAvg-typical)
         optimizer: str = "sgd",
         verbose: Optional[bool] = None,
     ):
@@ -55,7 +55,7 @@ class FederatedAveragingTrainer:
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.local_steps = local_steps
         self.local_batch_size = local_batch_size
-        self.optimizer = _optimizer(optimizer, learning_rate)
+        self.optimizer = _optimizer(optimizer, learning_rate, default_rate=0.01)
         self.logger = VerboseLogger(f"FedAvg[{spec.name}]", verbose)
         self.callbacks = CallbackRegistry("new_version", "round")
         self.params: Optional[Params] = None

@@ -95,7 +95,7 @@ class SyncTrainer:
         self,
         spec: ModelSpec,
         mesh: Optional[Mesh] = None,
-        learning_rate: float = 0.001,
+        learning_rate: Optional[float] = None,  # None -> 0.001 (reference default)
         optimizer: str = "sgd",
         param_rules: Rules = REPLICATED_RULES,
         grad_accum: int = 1,

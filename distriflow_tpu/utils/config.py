@@ -208,5 +208,26 @@ def server_hyperparams(overrides: Optional[Mapping[str, Any]] = None) -> ServerH
     return make_config(ServerHyperparams, overrides).validate()
 
 
+#: async-mode default for ``maximum_staleness`` when the user leaves it unset:
+#: with N concurrent workers the steady-state staleness is N-1 (every other
+#: worker's apply bumps the version mid-flight), so the sync-mode default of 0
+#: would reject most honest async work. 8 covers typical worker counts while
+#: still dropping pathologically stale gradients — the bound the reference
+#: promised but never implemented (``README.md:27``; its async server applies
+#: with no check at all, ``asynchronousSGD_server.ts:95-108``).
+ASYNC_DEFAULT_MAXIMUM_STALENESS = 8
+
+
+def async_server_hyperparams(
+    overrides: Optional[Mapping[str, Any]] = None,
+) -> ServerHyperparams:
+    """:func:`server_hyperparams` with the tolerant async-mode staleness
+    default. ``None`` values mean "unset" (matching :func:`override`)."""
+    hp = server_hyperparams(overrides)
+    if overrides is None or overrides.get("maximum_staleness") is None:
+        hp.maximum_staleness = ASYNC_DEFAULT_MAXIMUM_STALENESS
+    return hp
+
+
 def dataset_config(overrides: Optional[Mapping[str, Any]] = None) -> DatasetConfig:
     return make_config(DatasetConfig, overrides).validate()

@@ -168,6 +168,44 @@ def test_async_sgd_end_to_end(tmp_path):
         server.stop()
 
 
+def test_async_server_staleness_default_is_tolerant(tmp_path):
+    """Async mode must not inherit the sync-mode staleness-0 default: with N
+    concurrent workers the steady-state staleness is N-1, so 0 would reject
+    most honest work. Explicit settings (including 0) are honored."""
+    x = np.zeros((8, 28, 28, 1), np.float32)
+    y = np.eye(10, dtype=np.float32)[np.zeros(8, np.int64)]
+
+    def make(hp):
+        return AsynchronousSGDServer(
+            DistributedServerInMemoryModel(SpecModel(mnist_mlp(hidden=4))),
+            DistributedDataset(x, y, {"batch_size": 4}),
+            DistributedServerConfig(server_hyperparams=hp, save_dir=str(tmp_path)),
+        )
+
+    default = AsynchronousSGDServer.DEFAULT_MAXIMUM_STALENESS
+    assert make(None).hyperparams.maximum_staleness == default
+    assert make({"min_updates_per_version": 3}).hyperparams.maximum_staleness == default
+    # None means "unset" throughout the config system (override() skips it)
+    assert make({"maximum_staleness": None}).hyperparams.maximum_staleness == default
+    assert make({"maximum_staleness": 0}).hyperparams.maximum_staleness == 0
+    assert make({"maximum_staleness": 2}).hyperparams.maximum_staleness == 2
+
+    # the single-process trainer shares the same async default
+    from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
+    from distriflow_tpu.utils.config import ServerHyperparams
+
+    t = AsyncSGDTrainer(
+        mnist_mlp(hidden=4), DistributedDataset(x, y, {"batch_size": 4})
+    )
+    assert t.hyperparams.maximum_staleness == default
+    t0 = AsyncSGDTrainer(
+        mnist_mlp(hidden=4),
+        DistributedDataset(x, y, {"batch_size": 4}),
+        hyperparams=ServerHyperparams(),  # explicit dataclass: honored verbatim
+    )
+    assert t0.hyperparams.maximum_staleness == 0
+
+
 def test_async_sgd_two_clients_both_complete(tmp_path):
     """Multi-client async: stragglers must be re-dispatched when acks free
     work, and EVERY client gets trainingComplete (review finding: starved
