@@ -2,6 +2,7 @@
 
 from typing import Any, Optional
 
+from distriflow_tpu.checkpoint.sharded import ShardedCheckpointStore
 from distriflow_tpu.checkpoint.store import CheckpointStore
 
 
@@ -46,4 +47,4 @@ def load_model(save_dir: str, spec: Any = None, version: Optional[str] = None, *
     return model
 
 
-__all__ = ["CheckpointStore", "save_model", "load_model"]
+__all__ = ["CheckpointStore", "ShardedCheckpointStore", "save_model", "load_model"]

@@ -1,0 +1,432 @@
+"""Sharded checkpoints: each process writes only the shards it owns.
+
+:class:`~distriflow_tpu.checkpoint.store.CheckpointStore` gathers the full
+pytree to host before writing — correct on one host, but on a multi-host mesh
+it would materialize every parameter on every host and write N identical
+copies. This store keeps the reference persistence layer's semantics —
+versioned directory per save, ``current`` pointer, ``list``/``last``/resume
+(``src/server/models.ts:17-30,113-150``) — while writing the way Orbax does:
+one shard file per process, plus a single metadata index.
+
+Layout of ``save_dir/<version>/``::
+
+    meta.json       # leaf specs + full shard index (written by process 0)
+    shards.<p>.bin  # process p's owned shards, packed back to back
+
+Shard ownership and file offsets are computed **deterministically from the
+sharding alone**: every process derives the same global plan from
+``devices_indices_map`` plus ``(process_index, device.id)`` ordering, so no
+cross-host communication is needed to agree on the layout — replicas are
+deduplicated (the lowest-ranked device holding a shard writes it) and each
+byte of the state is written exactly once across the whole job.
+
+Restore has two paths:
+
+- **fast**: the target sharding partitions a leaf exactly as it was saved —
+  each process reads only the byte ranges of its addressable shards and
+  assembles a ``jax.Array`` via ``make_array_from_single_device_arrays``
+  (zero waste; this is the normal resume-on-the-same-mesh case);
+- **reshard**: any other target sharding — the global array is assembled from
+  the shard records and ``device_put`` against the new sharding, so
+  checkpoints survive mesh-shape changes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from distriflow_tpu.checkpoint.store import (
+    META_JSON,
+    CheckpointStore,
+    timestamp_version,
+)
+from distriflow_tpu.utils.serialization import _np_dtype
+
+Slices = Tuple[Tuple[int, int], ...]
+
+_COORD_TIMEOUT_MS = 10 * 60 * 1000
+
+
+class _Coordinator:
+    """Host-side cross-process coordination for collective saves.
+
+    Built on the jax.distributed coordination service (barrier + key/value
+    store) — deliberately NOT on device collectives: a save may run on a
+    background writer thread, and a device collective issued there would race
+    the training step's own collectives with no cross-host launch-order
+    guarantee (hang or collective mismatch). The coordination service is pure
+    host RPC, safe from any thread.
+    """
+
+    def __init__(self):
+        self.count = jax.process_count()
+        self.index = jax.process_index()
+        self._client = None
+        if self.count > 1:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+            if client is None:
+                raise RuntimeError(
+                    "sharded checkpointing across processes requires the "
+                    "jax.distributed coordination service "
+                    "(call jax.distributed.initialize())"
+                )
+            self._client = client
+
+    @property
+    def multi(self) -> bool:
+        return self._client is not None
+
+    def barrier(self, name: str) -> None:
+        if self._client is not None:
+            self._client.wait_at_barrier(name, timeout_in_ms=_COORD_TIMEOUT_MS)
+
+    def set(self, key: str, value: str) -> None:
+        if self._client is not None:
+            self._client.key_value_set(key, value)
+
+    def get(self, key: str) -> str:
+        return self._client.blocking_key_value_get(key, _COORD_TIMEOUT_MS)
+
+    def delete(self, key: str) -> None:
+        """Best-effort recycling of a write-once key."""
+        if self._client is not None:
+            try:
+                self._client.key_value_delete(key)
+            except Exception:
+                pass
+
+
+def _norm_slices(index: Tuple, shape: Tuple[int, ...]) -> Slices:
+    """devices_indices_map entry -> ((start, stop), ...) with Nones resolved."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _shard_nbytes(slices: Slices, itemsize: int) -> int:
+    return math.prod(stop - start for start, stop in slices) * itemsize if slices else itemsize
+
+
+@dataclass
+class _ShardRecord:
+    slices: Slices
+    process: int      # owning process (writes the bytes)
+    offset: int = 0   # byte offset within shards.<process>.bin
+    nbytes: int = 0
+
+
+@dataclass
+class _LeafPlan:
+    dtype: str
+    shape: Tuple[int, ...]
+    shards: List[_ShardRecord] = field(default_factory=list)
+
+
+@dataclass
+class ShardedSnapshot:
+    """A host-side snapshot of one process's owned shards + the global plan.
+
+    Built on the caller's thread (pays the device->host copies), written to
+    disk later — possibly on a background writer — without touching device
+    state again, so donated training buffers can be reused immediately.
+    """
+
+    plan: Dict[str, _LeafPlan]
+    payload: List[Tuple[int, np.ndarray]]  # (offset, shard bytes) for THIS process
+    extra_meta: Optional[Dict[str, Any]] = None
+
+
+def _plan_leaf(x: Any) -> Tuple[_LeafPlan, Dict[Slices, Any]]:
+    """Global shard plan for one leaf + {slices: owner device} map."""
+    if isinstance(x, jax.Array):
+        shape = tuple(x.shape)
+        dtype = x.dtype.name
+        index_map = x.sharding.devices_indices_map(shape)
+        by_slices: Dict[Slices, List[Any]] = {}
+        for dev, index in index_map.items():
+            by_slices.setdefault(_norm_slices(index, shape), []).append(dev)
+        plan = _LeafPlan(dtype=dtype, shape=shape)
+        owners: Dict[Slices, Any] = {}
+        itemsize = _np_dtype(dtype).itemsize
+        for slices in sorted(by_slices):
+            owner = min(by_slices[slices], key=lambda d: (d.process_index, d.id))
+            owners[slices] = owner
+            plan.shards.append(
+                _ShardRecord(
+                    slices=slices,
+                    process=owner.process_index,
+                    nbytes=_shard_nbytes(slices, itemsize),
+                )
+            )
+        return plan, owners
+    # host leaf (np array / python scalar): one shard, owned by process 0
+    arr = np.asarray(x)
+    slices: Slices = tuple((0, d) for d in arr.shape)
+    plan = _LeafPlan(dtype=arr.dtype.name if arr.dtype.name != "bool_" else "bool",
+                     shape=tuple(arr.shape))
+    plan.shards.append(
+        _ShardRecord(slices=slices, process=0, nbytes=arr.nbytes)
+    )
+    return plan, {slices: None}
+
+
+def _leaf_shard_data(x: Any, slices: Slices, owner: Any) -> np.ndarray:
+    """Host copy of the shard bytes for an owned (slices, device) pair."""
+    if owner is None:  # host leaf
+        return np.ascontiguousarray(np.asarray(x))
+    for sh in x.addressable_shards:
+        if sh.device == owner:
+            return np.ascontiguousarray(np.asarray(sh.data))
+    raise AssertionError(f"owned shard {slices} not addressable on this process")
+
+
+class ShardedCheckpointStore(CheckpointStore):
+    """Directory-per-version checkpoints, one shard file per process.
+
+    A store instance assumes exclusive ownership of ``save_dir`` (as the
+    reference's persistence layer does): leftover ``.building-*`` dirs from
+    a crashed job are cleared on construction.
+    """
+
+    def __init__(self, save_dir: str):
+        super().__init__(save_dir)
+        self._seq = 0  # per-save nonce for coordination-service keys
+        if jax.process_index() == 0:
+            for name in os.listdir(save_dir):
+                if name.startswith(".building-"):
+                    shutil.rmtree(os.path.join(save_dir, name), ignore_errors=True)
+
+    # -- write ------------------------------------------------------------
+
+    def snapshot(
+        self, tree: Any, extra_meta: Optional[Dict[str, Any]] = None
+    ) -> ShardedSnapshot:
+        """Host snapshot of this process's owned shards (device->host copy
+        happens here; :meth:`save` on a snapshot is pure file IO)."""
+        process = jax.process_index()
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        plan: Dict[str, _LeafPlan] = {}
+        payload: List[Tuple[int, np.ndarray]] = []
+        offsets = [0] * jax.process_count()  # per-process running file offset
+        for path, leaf in flat:
+            key = jax.tree_util.keystr(path)
+            leaf_plan, owners = _plan_leaf(leaf)
+            for rec in leaf_plan.shards:
+                rec.offset = offsets[rec.process]
+                offsets[rec.process] += rec.nbytes
+                if rec.process == process:
+                    payload.append(
+                        (rec.offset, _leaf_shard_data(leaf, rec.slices, owners[rec.slices]))
+                    )
+            plan[key] = leaf_plan
+        return ShardedSnapshot(plan=plan, payload=payload, extra_meta=extra_meta)
+
+    def save(
+        self,
+        tree: Any,
+        version: Optional[str] = None,
+        extra_meta: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Write ``tree`` (or a prepared :class:`ShardedSnapshot`) as a new
+        version. Every process must call this with the same version."""
+        snap = tree if isinstance(tree, ShardedSnapshot) else self.snapshot(tree, extra_meta)
+        if extra_meta is not None:
+            snap.extra_meta = extra_meta
+        version = version if version is not None else timestamp_version()
+        coord = _Coordinator()
+        self._seq += 1
+        # coordination-service keys are write-once; the per-store sequence
+        # number (identical across processes: saves are collective and
+        # ordered) keeps re-saves of the same version from colliding
+        tag = f"df-ckpt/{self.save_dir}/{version}/{self._seq}"
+        # all processes write into one deterministic build dir; process 0
+        # clears any leftover from a crashed earlier attempt first, so stale
+        # shard files can never be republished into a committed version
+        build_dir = os.path.join(self.save_dir, f".building-{version}")
+        if coord.index == 0:
+            shutil.rmtree(build_dir, ignore_errors=True)
+            os.makedirs(build_dir)
+        coord.barrier(f"{tag}/prepare")
+        err: Optional[BaseException] = None
+        try:
+            self._write_shards(build_dir, snap)
+        except BaseException as e:
+            err = e
+        coord.set(f"{tag}/status/{coord.index}", "fail" if err else "ok")
+        coord.barrier(f"{tag}/written")
+        if coord.multi:
+            # commit is collective: process 0 publishes only if EVERY process
+            # wrote successfully, and every process raises on any failure —
+            # a local swallow would leave peers committed to a torn version
+            if coord.index == 0:
+                all_ok = False
+                try:
+                    all_ok = err is None and all(
+                        coord.get(f"{tag}/status/{p}") == "ok"
+                        for p in range(1, coord.count)
+                    )
+                    if all_ok:
+                        self._publish_dir(build_dir, version)
+                except BaseException as e:
+                    # the verdict must reach the peers no matter what failed
+                    # here (publish rename, status timeout) or they would
+                    # block on the commit key until the coordination timeout
+                    all_ok = False
+                    err = err if err is not None else e
+                coord.set(f"{tag}/commit", "ok" if all_ok else "fail")
+                if not all_ok:
+                    shutil.rmtree(build_dir, ignore_errors=True)
+                committed = all_ok
+            else:
+                committed = coord.get(f"{tag}/commit") == "ok"
+                # ack: process 0 may only recycle the write-once keys after
+                # every peer has read the verdict
+                coord.set(f"{tag}/done/{coord.index}", "1")
+            if coord.index == 0:
+                for p in range(1, coord.count):
+                    coord.get(f"{tag}/done/{p}")
+                for p in range(coord.count):
+                    coord.delete(f"{tag}/status/{p}")
+                for p in range(1, coord.count):
+                    coord.delete(f"{tag}/done/{p}")
+                coord.delete(f"{tag}/commit")
+            if not committed:
+                if err is not None:
+                    raise err
+                raise RuntimeError(
+                    f"sharded checkpoint {version} aborted: a peer process "
+                    "failed to write its shards"
+                )
+        else:
+            if err is not None:
+                shutil.rmtree(build_dir, ignore_errors=True)
+                raise err
+            self._publish_dir(build_dir, version)
+        return version
+
+    def _write_shards(self, build_dir: str, snap: ShardedSnapshot) -> None:
+        my_file = os.path.join(build_dir, f"shards.{jax.process_index()}.bin")
+        with open(my_file, "wb") as f:
+            for offset, data in snap.payload:
+                assert f.tell() == offset, (f.tell(), offset)
+                f.write(data.tobytes())
+        if jax.process_index() == 0:
+            meta = {
+                "sharded": True,
+                "format": 1,
+                "processes": jax.process_count(),
+                "leaves": {
+                    key: {
+                        "dtype": p.dtype,
+                        "shape": list(p.shape),
+                        "shards": [
+                            {
+                                "slices": [list(se) for se in r.slices],
+                                "process": r.process,
+                                "offset": r.offset,
+                                "nbytes": r.nbytes,
+                            }
+                            for r in p.shards
+                        ],
+                    }
+                    for key, p in snap.plan.items()
+                },
+            }
+            if snap.extra_meta:
+                meta["extra"] = snap.extra_meta
+            with open(os.path.join(build_dir, META_JSON), "w") as f:
+                json.dump(meta, f)
+
+    # -- read -------------------------------------------------------------
+
+    def load(self, version: str, like: Any) -> Any:
+        """Load a version into the structure/shardings of ``like``.
+
+        Leaves whose template is a sharded ``jax.Array`` come back as
+        ``jax.Array`` with that sharding (per-shard reads when the
+        partitioning matches, reshard otherwise); host templates come back
+        as numpy.
+        """
+        d = os.path.join(self.save_dir, version)
+        with open(os.path.join(d, META_JSON)) as f:
+            meta = json.load(f)
+        if not meta.get("sharded"):
+            return super().load(version, like)
+        leaves_meta = meta["leaves"]
+        files: Dict[int, Any] = {}
+        try:
+            flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+            out = []
+            for path, template in flat:
+                key = jax.tree_util.keystr(path)
+                if key not in leaves_meta:
+                    raise KeyError(f"checkpoint {version} missing leaf {key!r}")
+                out.append(self._load_leaf(d, files, leaves_meta[key], template, key))
+            return jax.tree_util.tree_unflatten(treedef, out)
+        finally:
+            for f in files.values():
+                f.close()
+
+    def _read(self, d: str, files: Dict[int, Any], rec: Dict[str, Any],
+              dtype: np.dtype) -> np.ndarray:
+        p = rec["process"]
+        if p not in files:
+            files[p] = open(os.path.join(d, f"shards.{p}.bin"), "rb")
+        f = files[p]
+        f.seek(rec["offset"])
+        buf = f.read(rec["nbytes"])
+        if len(buf) != rec["nbytes"]:
+            raise IOError(f"short read in shards.{p}.bin at {rec['offset']}")
+        shape = [stop - start for start, stop in rec["slices"]]
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    def _load_leaf(self, d: str, files: Dict[int, Any], lm: Dict[str, Any],
+                   template: Any, key: str) -> Any:
+        shape = tuple(lm["shape"])
+        dtype = _np_dtype(lm["dtype"])
+        t_shape = getattr(template, "shape", None)
+        if t_shape is not None and tuple(t_shape) != shape:
+            raise ValueError(
+                f"shape mismatch at {key!r}: checkpoint {shape} vs template {tuple(t_shape)}"
+            )
+        records = {tuple(tuple(se) for se in r["slices"]): r for r in lm["shards"]}
+        sharding = getattr(template, "sharding", None)
+        if isinstance(template, jax.Array) and sharding is not None:
+            target = sharding.addressable_devices_indices_map(shape)
+            wanted = {dev: _norm_slices(index, shape) for dev, index in target.items()}
+            if all(s in records for s in wanted.values()):
+                # fast path: partitioning unchanged — read each distinct
+                # shard once (replicated leaves map many devices to the same
+                # record; re-reading per device would multiply the disk IO)
+                bufs: Dict[Slices, np.ndarray] = {}
+                arrays = []
+                for dev, s in wanted.items():
+                    if s not in bufs:
+                        bufs[s] = self._read(d, files, records[s], dtype)
+                    arrays.append(jax.device_put(bufs[s], dev))
+                return jax.make_array_from_single_device_arrays(shape, sharding, arrays)
+            # reshard path: assemble the global array, then place
+            return jax.device_put(self._assemble(d, files, lm, dtype), sharding)
+        return self._assemble(d, files, lm, dtype)
+
+    def _assemble(self, d: str, files: Dict[int, Any], lm: Dict[str, Any],
+                  dtype: np.dtype) -> np.ndarray:
+        shape = tuple(lm["shape"])
+        out = np.empty(shape, dtype=dtype)
+        for rec in lm["shards"]:
+            region = tuple(slice(start, stop) for start, stop in rec["slices"])
+            out[region] = self._read(d, files, rec, dtype)
+        return out

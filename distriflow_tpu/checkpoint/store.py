@@ -79,30 +79,37 @@ class CheckpointStore:
         blob, meta = flat_serialize(serialize_tree(host_tree))
         if extra_meta:
             meta["extra"] = extra_meta
-        final_dir = os.path.join(self.save_dir, version)
         tmp_dir = tempfile.mkdtemp(dir=self.save_dir, prefix=f".tmp-{version}-")
-        trash_dir = None
         try:
             with open(os.path.join(tmp_dir, DATA_BIN), "wb") as f:
                 f.write(blob)
             with open(os.path.join(tmp_dir, META_JSON), "w") as f:
                 json.dump(meta, f)
-            if os.path.isdir(final_dir):
-                # overwrite: move the old version aside first so readers never
-                # see a half-deleted directory; the rename-rename window is the
-                # only non-atomic moment and only exists when re-saving the
-                # SAME version string (never in normal timestamp/step flows)
-                trash_dir = tempfile.mkdtemp(dir=self.save_dir, prefix=".trash-")
-                os.rename(final_dir, os.path.join(trash_dir, version))
-            os.rename(tmp_dir, final_dir)
+            self._publish_dir(tmp_dir, version)
         except BaseException:
             shutil.rmtree(tmp_dir, ignore_errors=True)
             raise
+        return version
+
+    def _publish_dir(self, src_dir: str, version: str) -> None:
+        """Rename a fully-written directory into place and swap ``current``.
+
+        On overwrite, the old version is moved aside first so readers never
+        see a half-deleted directory; the rename-rename window is the only
+        non-atomic moment and only exists when re-saving the SAME version
+        string (never in normal timestamp/step flows).
+        """
+        final_dir = os.path.join(self.save_dir, version)
+        trash_dir = None
+        try:
+            if os.path.isdir(final_dir):
+                trash_dir = tempfile.mkdtemp(dir=self.save_dir, prefix=".trash-")
+                os.rename(final_dir, os.path.join(trash_dir, version))
+            os.rename(src_dir, final_dir)
         finally:
             if trash_dir is not None:
                 shutil.rmtree(trash_dir, ignore_errors=True)
         self._force_symlink(version)
-        return version
 
     def _force_symlink(self, version: str) -> None:
         link = os.path.join(self.save_dir, CURRENT)

@@ -103,6 +103,7 @@ class SyncTrainer:
         verbose: Optional[bool] = None,
         checkpoint_dir: Optional[str] = None,
         save_every: int = 0,
+        sharded_checkpoints: bool = False,
     ):
         self.spec = spec
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
@@ -122,9 +123,15 @@ class SyncTrainer:
         self.store = None
         self.save_every = save_every
         if checkpoint_dir is not None:
-            from distriflow_tpu.checkpoint.store import CheckpointStore
+            if sharded_checkpoints:
+                # each process writes only its owned shards (multi-host scale)
+                from distriflow_tpu.checkpoint.sharded import ShardedCheckpointStore
 
-            self.store = CheckpointStore(checkpoint_dir)
+                self.store = ShardedCheckpointStore(checkpoint_dir)
+            else:
+                from distriflow_tpu.checkpoint.store import CheckpointStore
+
+                self.store = CheckpointStore(checkpoint_dir)
         self._save_queue: Optional[queue.Queue] = None
         self._save_thread: Optional[threading.Thread] = None
         self._save_errors: List[Exception] = []
@@ -262,15 +269,25 @@ class SyncTrainer:
             raise RuntimeError("trainer not initialized")
         version = str(self.version)
         self._ensure_writer()
+        if drop_if_busy and hasattr(self.store, "snapshot") and jax.process_count() > 1:
+            # sharded saves are collective: every process must call save for
+            # every version or peers hang waiting at the commit exchange. A
+            # per-process skip decision (local queue fullness) would violate
+            # that, so fall back to backpressure — same decision everywhere.
+            drop_if_busy = False
         if drop_if_busy and self._save_queue.full():
             # check BEFORE the gather: a skipped autosave must not pay a
             # full device->host copy of the state just to discard it
             self.logger.log(f"skipping checkpoint {version}: writer busy")
             return None
-        host_state = jax.device_get(
-            {"params": self.state.params, "opt_state": self.state.opt_state,
-             "step": self.state.step}
-        )
+        state_tree = {"params": self.state.params, "opt_state": self.state.opt_state,
+                      "step": self.state.step}
+        if hasattr(self.store, "snapshot"):
+            # sharded store: host copy of only the shards this process owns;
+            # the writer thread then does pure file IO on the snapshot
+            host_state = self.store.snapshot(state_tree)
+        else:
+            host_state = jax.device_get(state_tree)
         item = _SaveItem(version, host_state)
         if drop_if_busy:
             try:
