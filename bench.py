@@ -48,17 +48,21 @@ def bench_distriflow() -> float:
     trainer.init(jax.random.PRNGKey(0))
 
     rng = np.random.RandomState(0)
-    x = rng.randn(GLOBAL_BATCH, 28, 28, 1).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, GLOBAL_BATCH)]
-    batch = shard_batch(mesh, (x, y))
+    # rotate distinct batch contents: repeated identical dispatches can be
+    # memoized by the runtime layer and would fake the step time
+    batches = []
+    for _ in range(8):
+        x = rng.randn(GLOBAL_BATCH, 28, 28, 1).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, GLOBAL_BATCH)]
+        batches.append(shard_batch(mesh, (x, y)))
 
-    for _ in range(WARMUP_STEPS):
-        loss = trainer.step_async(batch)
+    for i in range(WARMUP_STEPS):
+        loss = trainer.step_async(batches[i % len(batches)])
     jax.block_until_ready(loss)
 
     start = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        loss = trainer.step_async(batch)
+    for i in range(MEASURE_STEPS):
+        loss = trainer.step_async(batches[i % len(batches)])
     jax.block_until_ready(loss)
     elapsed = time.perf_counter() - start
     sps = GLOBAL_BATCH * MEASURE_STEPS / elapsed

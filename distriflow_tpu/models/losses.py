@@ -82,6 +82,17 @@ def softmax_cross_entropy_per_example(logits, targets):
     return optax.softmax_cross_entropy(logits.astype(jnp.float32), targets)
 
 
+def sparse_softmax_cross_entropy_per_example(logits, targets):
+    """Integer-label CE: ``targets`` are class ids shaped like the logits'
+    leading dims. TPU-first alternative to the one-hot form: for LM-sized
+    vocabularies a one-hot target tensor is a [tokens, V] HBM array built on
+    the host (the reference always one-hots, ``mnist_data.ts:66``); integer
+    labels keep the wire and HBM cost at [tokens]."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+
+
 PER_EXAMPLE: Dict[str, PerExampleFn] = {
     "absolute_difference": absolute_difference_per_example,
     "mean_squared_error": mean_squared_error_per_example,
@@ -91,6 +102,7 @@ PER_EXAMPLE: Dict[str, PerExampleFn] = {
     "log_loss": log_loss_per_example,
     "sigmoid_cross_entropy": sigmoid_cross_entropy_per_example,
     "softmax_cross_entropy": softmax_cross_entropy_per_example,
+    "sparse_softmax_cross_entropy": sparse_softmax_cross_entropy_per_example,
 }
 
 
@@ -112,6 +124,7 @@ huber_loss = LOSSES["huber_loss"]
 log_loss = LOSSES["log_loss"]
 sigmoid_cross_entropy = LOSSES["sigmoid_cross_entropy"]
 softmax_cross_entropy = LOSSES["softmax_cross_entropy"]
+sparse_softmax_cross_entropy = LOSSES["sparse_softmax_cross_entropy"]
 
 
 def get_loss(name: str) -> LossFn:
@@ -134,8 +147,9 @@ def register_loss(name: str, fn: PerExampleFn) -> None:
 
 
 def accuracy(logits: jnp.ndarray, targets: jnp.ndarray, weight=None) -> jnp.ndarray:
-    """Classification accuracy over one-hot targets (weight-aware)."""
-    correct = (jnp.argmax(logits, axis=-1) == jnp.argmax(targets, axis=-1)).astype(jnp.float32)
+    """Classification accuracy over one-hot OR integer targets (weight-aware)."""
+    labels = targets if targets.ndim == logits.ndim - 1 else jnp.argmax(targets, axis=-1)
+    correct = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
     return _weighted_mean(correct, weight)
 
 

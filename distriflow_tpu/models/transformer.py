@@ -46,6 +46,9 @@ class TransformerConfig:
     use_ulysses_attention: bool = False  # all-to-all SP (parallel/ulysses.py)
     use_flash_attention: bool = False  # Pallas kernel (distriflow_tpu/ops)
     causal: bool = True
+    # integer-label CE by default: LM targets are the [B, S] int32 next-token
+    # ids, never a [B, S, V] one-hot (HBM + wire cost scales with V otherwise)
+    loss: str = "sparse_softmax_cross_entropy"
 
     def __post_init__(self):
         if self.use_ring_attention and self.use_ulysses_attention:
@@ -278,7 +281,7 @@ def pipelined_transformer_lm(
     return ModelSpec(
         init=init,
         apply=apply,
-        loss="softmax_cross_entropy",
+        loss=config.loss,
         input_shape=(example_seq,),
         output_shape=(config.vocab_size,),
         name="pipelined_transformer_lm",
@@ -293,7 +296,8 @@ def transformer_lm(
     **overrides: Any,
 ) -> ModelSpec:
     """ModelSpec for the causal LM. ``x`` = int32 tokens ``[B, S]``; ``y`` =
-    one-hot next-token targets ``[B, S, V]`` (softmax CE loss).
+    int32 next-token ids ``[B, S]`` (sparse CE by default; set
+    ``config.loss="softmax_cross_entropy"`` for one-hot ``[B, S, V]`` targets).
 
     ``example_batch`` sizes the init-trace dummy; with ring attention on a
     mesh it must be divisible by the ``data`` axis (defaults to exactly that).
@@ -313,7 +317,7 @@ def transformer_lm(
     return ModelSpec(
         init=init,
         apply=module.apply,
-        loss="softmax_cross_entropy",
+        loss=config.loss,
         input_shape=(example_seq,),
         output_shape=(config.vocab_size,),
         name="transformer_lm",

@@ -17,6 +17,8 @@ from distriflow_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from distriflow_tpu.ops.fused_ce import (  # noqa: F401
     fused_softmax_cross_entropy,
     fused_softmax_cross_entropy_per_example,
+    fused_sparse_softmax_cross_entropy,
+    fused_sparse_softmax_cross_entropy_per_example,
 )
 
 

@@ -131,6 +131,13 @@ def _flash_forward(
             pltpu.VMEM((bq, d), jnp.float32),  # acc
         ],
         interpret=interpret,
+        # batch*head and Q-block axes are independent -> let Mosaic run them
+        # as parallel dimensions; only the K axis is a sequential reduction
+        # (the scratch recurrence). Without this the whole grid executes
+        # serially on the TensorCore.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * s * s * d // (2 if causal else 1),
             bytes_accessed=4 * b * h * s * d * q.dtype.itemsize,
@@ -146,8 +153,8 @@ def flash_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,  # 512x512 measured fastest on v5e (vs 128/256 tiles)
+    block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Fused attention over ``[B, H, S, D]`` tensors.

@@ -1,21 +1,31 @@
-"""Fused softmax cross-entropy as a Pallas TPU kernel.
+"""Fused softmax cross-entropy as Pallas TPU kernels.
 
 The baseline path (``optax.softmax_cross_entropy``) materializes
 ``log_softmax(logits)`` — a full [N, V] intermediate — before contracting
-with the one-hot targets. For LM-sized vocabularies that is a second
-HBM-resident [N, V] array and a wasted round trip. This kernel computes the
-per-row loss ``logsumexp(logits) - <logits, targets>`` in one VMEM pass per
-row block: the row max, the exp-sum, and the label contraction all happen
-on-chip and only [N] scalars leave.
+with the targets. For LM-sized vocabularies that is a second HBM-resident
+[N, V] array and a wasted round trip. These kernels stream the vocab
+dimension through VMEM in ``BLOCK_V``-wide tiles with an online logsumexp
+(running max ``m``, running exp-sum ``l``, running label contraction), so
+VMEM usage is O(BLOCK_N x BLOCK_V) regardless of vocabulary size — a 256k
+vocab costs the same on-chip memory as a 1k vocab. Only the [N] losses and
+[N] logsumexps leave the kernel.
 
-Backward (``softmax(logits) - targets``, weighted) runs as a second Pallas
-kernel — the probabilities still never hit HBM in forward, and backward
-writes them fused with the subtraction.
+Backward uses the saved logsumexp as a residual, which makes it
+embarrassingly parallel over both row and vocab tiles:
+``grad = (exp(x - lse) - target) * g`` — the probabilities still never hit
+HBM as a separate array; they are written fused with the subtraction.
 
-Registered in the loss registry as ``"fused_softmax_cross_entropy"``
-(drop-in for ``"softmax_cross_entropy"``; both resolve through
-``distriflow_tpu.models.losses.get_loss`` — the registry the reference
-declared but never used, ``src/common/models.ts:139``).
+Two variants:
+
+- ``fused_softmax_cross_entropy`` — dense one-hot/soft targets [N, V];
+- ``fused_sparse_softmax_cross_entropy`` — integer labels [N] (the LM path:
+  no one-hot ever exists, in HBM or anywhere else; the label contraction is
+  an in-kernel iota compare).
+
+Registered in the loss registry as ``"fused_softmax_cross_entropy"`` /
+``"fused_sparse_softmax_cross_entropy"`` (drop-ins for the unfused names;
+all resolve through ``distriflow_tpu.models.losses.get_loss`` — the registry
+the reference declared but never used, ``src/common/models.ts:139``).
 """
 
 from __future__ import annotations
@@ -25,89 +35,235 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-BLOCK_N = 256
-
-
-def _fwd_kernel(logits_ref, targets_ref, loss_ref):
-    x = logits_ref[:].astype(jnp.float32)  # [block_n, V]
-    t = targets_ref[:].astype(jnp.float32)
-    m = jnp.max(x, axis=-1, keepdims=True)
-    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True))
-    label = jnp.sum(x * t, axis=-1, keepdims=True)
-    loss_ref[:] = lse - label
+BLOCK_N = 256   # 256 x 4096 f32 = 4 MB tiles: the measured sweet spot on
+BLOCK_V = 4096  # v5e (2 MB tiles ran 5x slower; 8 MB tiles blow scoped VMEM)
+# backward streams logits in AND grads out (two [bn, bv] tensors double-
+# buffered); halve the vocab tile to stay under the 16 MB scoped VMEM limit
+BLOCK_V_BWD = 2048
+NEG_INF = -1e30
+_LANES = 128  # f32 tile width; m/l scratch is lane-replicated
 
 
-def _bwd_kernel(logits_ref, targets_ref, g_ref, grad_ref):
-    x = logits_ref[:].astype(jnp.float32)
-    t = targets_ref[:].astype(jnp.float32)
-    m = jnp.max(x, axis=-1, keepdims=True)
-    e = jnp.exp(x - m)
-    p = e / jnp.sum(e, axis=-1, keepdims=True)
+def _online_update(x, m_ref, l_ref):
+    """Advance the running (max, exp-sum) over one vocab tile; returns the
+    new per-row max (lane-replicated write happens here)."""
+    m = m_ref[:, :1]
+    l = l_ref[:, :1]
+    blk_max = jnp.max(x, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, blk_max)
+    corr = jnp.exp(m - new_m)
+    new_l = l * corr + jnp.sum(jnp.exp(x - new_m), axis=-1, keepdims=True)
+    m_ref[:] = jnp.broadcast_to(new_m, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(new_l, l_ref.shape)
+    return new_m
+
+
+def _mask_cols(x, vb, block_v, v_true):
+    """NEG_INF out the vocab-padding columns of the last tile."""
+    col = vb * block_v + lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(col < v_true, x, NEG_INF), col
+
+
+def _fwd_kernel(logits_ref, tgt_ref, loss_ref, lse_ref,
+                m_ref, l_ref, lab_ref, *, block_v, n_v, v_true, sparse):
+    """One (row-block, vocab-tile) forward step. ``sparse`` is a trace-time
+    flag: integer labels (in-kernel iota compare) vs dense target rows."""
+    vb = pl.program_id(1)
+
+    @pl.when(vb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        lab_ref[:] = jnp.zeros_like(lab_ref)
+
+    x, col = _mask_cols(logits_ref[:].astype(jnp.float32), vb, block_v, v_true)
+    new_m = _online_update(x, m_ref, l_ref)
+    if sparse:
+        hit = jnp.sum(jnp.where(col == tgt_ref[:], x, 0.0), axis=-1, keepdims=True)
+    else:
+        # mask BOTH operands: edge-tile lanes beyond v_true hold undefined
+        # values in x and t (no host-side padding)
+        t = jnp.where(col < v_true, tgt_ref[:].astype(jnp.float32), 0.0)
+        hit = jnp.sum(jnp.where(x > NEG_INF, x, 0.0) * t, axis=-1, keepdims=True)
+    lab_ref[:] = lab_ref[:] + jnp.broadcast_to(hit, lab_ref.shape)
+
+    @pl.when(vb == n_v - 1)
+    def _finalize():
+        lse = new_m + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        lse_ref[:] = lse
+        loss_ref[:] = lse - lab_ref[:, :1]
+
+
+def _bwd_kernel(logits_ref, tgt_ref, lse_ref, g_ref, grad_ref,
+                *, block_v, v_true, sparse):
+    vb = pl.program_id(1)
+    x, col = _mask_cols(logits_ref[:].astype(jnp.float32), vb, block_v, v_true)
+    p = jnp.exp(x - lse_ref[:])  # masked cols: exp(NEG_INF - lse) == 0
+    if sparse:
+        t = (col == tgt_ref[:]).astype(jnp.float32)
+    else:
+        t = jnp.where(col < v_true, tgt_ref[:].astype(jnp.float32), 0.0)
     grad_ref[:] = ((p - t) * g_ref[:].astype(jnp.float32)).astype(grad_ref.dtype)
 
 
-def _pad_rows(x: jnp.ndarray, block: int) -> jnp.ndarray:
-    pad = (-x.shape[0]) % block
-    if pad:
-        x = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
-    return x
+def _ce_call(kernel, n_outs, out_dtypes, out_cols, block_n, block_v,
+             interpret, logits, aux):
+    """Shared pallas_call wiring for the forward/backward CE kernels.
 
+    ``aux`` entries are blocked over vocab when logits-wide (dense targets)
+    and row-only otherwise (labels/lse/g, all [N, 1]). Non-divisible N/V are
+    handled by Pallas edge blocks (the kernels mask via ``v_true``; edge-row
+    garbage never escapes: partial output blocks only write in-bounds rows) —
+    no host-side padding copy of the [N, V] arrays is ever made.
+    """
+    n, v = logits.shape
+    n_rows = -(-n // block_n)
+    n_v = -(-v // block_v)
+    grid = (n_rows, n_v)
 
-def _rows_call(kernel, outs, block_n, interpret, *arrays):
-    n, v = arrays[0].shape
-    padded = [_pad_rows(a, block_n) for a in arrays]
-    np_ = padded[0].shape[0]
-    grid = (np_ // block_n,)
-    specs = [
-        pl.BlockSpec((block_n, a.shape[1]), lambda i: (i, 0)) for a in padded
-    ]
-    out = pl.pallas_call(
+    specs = [pl.BlockSpec((block_n, block_v), lambda i, j: (i, j))]
+    arrays = [logits]
+    for a in aux:
+        if a.shape[1] == v:  # vocab-wide (dense targets)
+            specs.append(pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)))
+        else:  # per-row column vector
+            specs.append(pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)))
+        arrays.append(a)
+
+    if out_cols == 1:
+        out_specs = [pl.BlockSpec((block_n, 1), lambda i, j: (i, 0))
+                     for _ in range(n_outs)]
+        out_shape = [jax.ShapeDtypeStruct((n, 1), d) for d in out_dtypes]
+    else:
+        out_specs = [pl.BlockSpec((block_n, block_v), lambda i, j: (i, j))]
+        out_shape = [jax.ShapeDtypeStruct((n, v), out_dtypes[0])]
+
+    kernel = functools.partial(kernel, block_v=block_v, v_true=v)
+    outs = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=specs,
-        out_specs=pl.BlockSpec((block_n, outs[1]), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((np_, outs[1]), outs[0]),
+        out_specs=out_specs if n_outs > 1 else out_specs[0],
+        out_shape=out_shape if n_outs > 1 else out_shape[0],
+        scratch_shapes=(
+            [pltpu.VMEM((block_n, _LANES), jnp.float32) for _ in range(3)]
+            if out_cols == 1 else []
+        ),
+        # rows are independent; the vocab axis is the online reduction in
+        # forward (scratch recurrence) and independent in backward — keep it
+        # 'arbitrary' (sequential) in both: correct everywhere, and backward
+        # row tiles still parallelize
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
         interpret=interpret,
-    )(*padded)
-    return out[:n]
+    )(*arrays)
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    if out_cols == 1:
+        return [o[:, 0] for o in outs]
+    return [outs[0]]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _default_interpret(interpret):
+    if interpret is None:
+        from distriflow_tpu.ops import default_interpret
+
+        return default_interpret()
+    return interpret
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _per_row_sparse_loss(
+    logits: jnp.ndarray, labels: jnp.ndarray,
+    block_n: int = BLOCK_N, block_v: int = BLOCK_V,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """[N, V] logits + [N] int labels -> [N] per-row CE."""
+    loss, _ = _sparse_fwd_impl(logits, labels, block_n, block_v, interpret)
+    return loss
+
+
+def _sparse_fwd_impl(logits, labels, block_n, block_v, interpret):
+    interpret = _default_interpret(interpret)
+    n_v = (logits.shape[1] + block_v - 1) // block_v
+    loss, lse = _ce_call(
+        functools.partial(_fwd_kernel, n_v=n_v, sparse=True),
+        2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
+        logits, [labels.astype(jnp.int32)[:, None]],
+    )
+    return loss, lse
+
+
+def _sparse_fwd(logits, labels, block_n, block_v, interpret):
+    loss, lse = _sparse_fwd_impl(logits, labels, block_n, block_v, interpret)
+    return loss, (logits, labels, lse)
+
+
+def _sparse_bwd(block_n, block_v, interpret, res, g):
+    logits, labels, lse = res
+    interpret = _default_interpret(interpret)
+    (grad,) = _ce_call(
+        functools.partial(_bwd_kernel, sparse=True),
+        1, (logits.dtype,), logits.shape[1], block_n,
+        min(block_v, BLOCK_V_BWD), interpret,
+        logits,
+        [labels.astype(jnp.int32)[:, None], lse[:, None],
+         g.astype(jnp.float32)[:, None]],
+    )
+    return grad, None  # integer labels get no gradient
+
+
+_per_row_sparse_loss.defvjp(_sparse_fwd, _sparse_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def _per_row_loss(
     logits: jnp.ndarray, targets: jnp.ndarray,
-    block_n: int = BLOCK_N, interpret: Optional[bool] = None,
+    block_n: int = BLOCK_N, block_v: int = BLOCK_V,
+    interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """[N, V] logits + one-hot targets -> [N] per-row CE."""
-    if interpret is None:
-        from distriflow_tpu.ops import default_interpret
+    """[N, V] logits + dense (one-hot/soft) targets -> [N] per-row CE."""
+    loss, _ = _dense_fwd_impl(logits, targets, block_n, block_v, interpret)
+    return loss
 
-        interpret = default_interpret()
-    out = _rows_call(
-        _fwd_kernel, (jnp.float32, 1), block_n, interpret, logits, targets
+
+def _dense_fwd_impl(logits, targets, block_n, block_v, interpret):
+    interpret = _default_interpret(interpret)
+    n_v = (logits.shape[1] + block_v - 1) // block_v
+    loss, lse = _ce_call(
+        functools.partial(_fwd_kernel, n_v=n_v, sparse=False),
+        2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
+        logits, [targets],
     )
-    return out[:, 0]
+    return loss, lse
 
 
-def _per_row_fwd(logits, targets, block_n, interpret):
-    return _per_row_loss(logits, targets, block_n, interpret), (logits, targets)
+def _dense_fwd(logits, targets, block_n, block_v, interpret):
+    loss, lse = _dense_fwd_impl(logits, targets, block_n, block_v, interpret)
+    return loss, (logits, targets, lse)
 
 
-def _per_row_bwd(block_n, interpret, res, g):
-    logits, targets = res
-    if interpret is None:
-        from distriflow_tpu.ops import default_interpret
-
-        interpret = default_interpret()
-    grad = _rows_call(
-        _bwd_kernel, (logits.dtype, logits.shape[1]), block_n, interpret,
-        logits, targets, g.astype(jnp.float32)[:, None],
+def _dense_bwd(block_n, block_v, interpret, res, g):
+    logits, targets, lse = res
+    interpret = _default_interpret(interpret)
+    (grad,) = _ce_call(
+        functools.partial(_bwd_kernel, sparse=False),
+        1, (logits.dtype,), logits.shape[1], block_n,
+        min(block_v, BLOCK_V_BWD), interpret,
+        logits,
+        [targets, lse[:, None], g.astype(jnp.float32)[:, None]],
     )
-    return grad, None  # one-hot targets get no gradient
+    return grad, None  # targets get no gradient (matches prior behavior)
 
 
-_per_row_loss.defvjp(_per_row_fwd, _per_row_bwd)
+_per_row_loss.defvjp(_dense_fwd, _dense_bwd)
+
+
+# -- public per-example / reduced forms --------------------------------------
 
 
 def fused_softmax_cross_entropy_per_example(
@@ -132,12 +288,46 @@ def fused_softmax_cross_entropy(
     )
 
 
+def fused_sparse_softmax_cross_entropy_per_example(
+    logits: jnp.ndarray, targets: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-example integer-label CE (targets shaped like logits' leading dims).
+
+    Labels must be in ``[0, V)``. An out-of-range label (e.g. an
+    ``ignore_index=-1`` convention) matches no vocab column: the row's loss
+    degenerates to its logsumexp and its gradient to pure softmax — unlike
+    ``optax.softmax_cross_entropy_with_integer_labels``, whose
+    ``take_along_axis`` silently wraps negative labels to the last class.
+    Mask ignored rows with the ``weight`` argument instead."""
+    lead = logits.shape[:-1]
+    v = logits.shape[-1]
+    flat = _per_row_sparse_loss(logits.reshape(-1, v), targets.reshape(-1))
+    return flat.reshape(lead)
+
+
+def fused_sparse_softmax_cross_entropy(
+    logits: jnp.ndarray, targets: jnp.ndarray, weight: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """Weighted-mean fused sparse CE (drop-in for
+    ``losses.sparse_softmax_cross_entropy``)."""
+    from distriflow_tpu.models.losses import _weighted_mean
+
+    return _weighted_mean(
+        fused_sparse_softmax_cross_entropy_per_example(logits, targets), weight
+    )
+
+
 def register() -> None:
     from distriflow_tpu.models import losses
 
     if "fused_softmax_cross_entropy" not in losses.LOSSES:
         losses.register_loss(
             "fused_softmax_cross_entropy", fused_softmax_cross_entropy_per_example
+        )
+    if "fused_sparse_softmax_cross_entropy" not in losses.LOSSES:
+        losses.register_loss(
+            "fused_sparse_softmax_cross_entropy",
+            fused_sparse_softmax_cross_entropy_per_example,
         )
 
 

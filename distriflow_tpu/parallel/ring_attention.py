@@ -48,7 +48,14 @@ def _attend_block(
     scale: float,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One online-softmax accumulation step against a K/V block."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    # preferred_element_type (not .astype after): the MXU natively emits f32
+    # from bf16 operands, and the explicit f32 output dtype stops XLA's
+    # bf16-propagation pass from truncating the scores inside the fused loop
+    # — with .astype, that truncation made the masked-softmax backward NaN
+    # at long sequence lengths under jit
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     if causal:
         sq, sk = q.shape[2], k.shape[2]
         q_pos = q_offset + jnp.arange(sq)[:, None]  # [Sq, 1]
@@ -64,7 +71,7 @@ def _attend_block(
     correction = jnp.where(m <= NEG_INF, 0.0, correction)
     new_l = l * correction + jnp.sum(p, axis=-1)
     new_o = o * correction[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+        "bhqk,bhkd->bhqd", p, v, preferred_element_type=jnp.float32
     )
     return new_m, new_l, new_o
 
@@ -120,13 +127,17 @@ def blockwise_attention(
 def dense_attention(q, k, v, causal: bool = True) -> jnp.ndarray:
     """Plain softmax attention (correctness oracle for tests)."""
     d = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / math.sqrt(d)
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
     if causal:
         sq, sk = q.shape[2], k.shape[2]
         mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
 
 
 def ring_attention(

@@ -32,7 +32,9 @@ def test_loss_registry_complete():
     for name, fn in LOSSES.items():
         preds = jnp.abs(jax.random.normal(jax.random.PRNGKey(0), (4, 3))) + 0.1
         preds = preds / preds.sum(-1, keepdims=True)
-        targets = jnp.eye(3)[jnp.array([0, 1, 2, 0])]
+        labels = jnp.array([0, 1, 2, 0])
+        # sparse losses take integer class ids; everything else one-hot/dense
+        targets = labels if "sparse" in name else jnp.eye(3)[labels]
         val = fn(preds, targets)
         assert val.shape == (), name
         assert bool(jnp.isfinite(val)), name

@@ -110,6 +110,96 @@ def test_fused_ce_registered_in_registry():
     )
 
 
+def test_sparse_ce_matches_onehot():
+    """Integer-label registry loss == one-hot loss on the same rows."""
+    from distriflow_tpu.models.losses import (
+        softmax_cross_entropy,
+        sparse_softmax_cross_entropy,
+    )
+
+    rng = np.random.RandomState(4)
+    logits = jnp.asarray(rng.randn(6, 9, 13).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 13, (6, 9)), jnp.int32)
+    onehot = jnp.eye(13, dtype=jnp.float32)[labels]
+    np.testing.assert_allclose(
+        float(sparse_softmax_cross_entropy(logits, labels)),
+        float(softmax_cross_entropy(logits, onehot)),
+        rtol=1e-6,
+    )
+
+
+def test_fused_sparse_ce_matches_optax():
+    from distriflow_tpu.ops import fused_sparse_softmax_cross_entropy
+
+    rng = np.random.RandomState(5)
+    logits = jnp.asarray(rng.randn(37, 50).astype(np.float32))  # non-divisible N
+    labels = jnp.asarray(rng.randint(0, 50, 37), jnp.int32)
+    got = fused_sparse_softmax_cross_entropy(logits, labels)
+    want = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+
+def test_fused_sparse_ce_grad_and_weighted():
+    from distriflow_tpu.ops import fused_sparse_softmax_cross_entropy
+
+    rng = np.random.RandomState(6)
+    logits = jnp.asarray(rng.randn(4, 6, 11).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 11, (4, 6)), jnp.int32)
+    w = jnp.asarray([1.0, 0.0, 1.0, 1.0])
+
+    def fused(l):
+        return fused_sparse_softmax_cross_entropy(l, labels, w)
+
+    def ref(l):
+        per = optax.softmax_cross_entropy_with_integer_labels(l, labels)
+        return jnp.sum(per * w[:, None]) / jnp.sum(w * 6)
+
+    np.testing.assert_allclose(float(fused(logits)), float(ref(logits)), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(fused)(logits)),
+        np.asarray(jax.grad(ref)(logits)),
+        atol=1e-6,
+    )
+
+
+def test_fused_ce_multi_vocab_tile():
+    """Force n_v > 1 (small block_v) so the cross-tile online-logsumexp and
+    label accumulation actually run — the default BLOCK_V covers any test
+    vocab in one tile, which would leave the streaming path untested."""
+    from distriflow_tpu.ops.fused_ce import _per_row_loss, _per_row_sparse_loss
+
+    rng = np.random.RandomState(8)
+    n, v = 37, 300  # non-divisible by both block dims
+    logits = jnp.asarray(rng.randn(n, v).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, v, n), jnp.int32)
+    onehot = jnp.eye(v, dtype=jnp.float32)[labels]
+    want = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+    got_sparse = _per_row_sparse_loss(logits, labels, 8, 128, True)
+    got_dense = _per_row_loss(logits, onehot, 8, 128, True)
+    np.testing.assert_allclose(np.asarray(got_sparse), np.asarray(want), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_dense), np.asarray(want), rtol=1e-5)
+
+    # gradients through the tiled backward (lse-residual path)
+    g_sparse = jax.grad(lambda l: jnp.mean(_per_row_sparse_loss(l, labels, 8, 128, True)))(logits)
+    g_dense = jax.grad(lambda l: jnp.mean(_per_row_loss(l, onehot, 8, 128, True)))(logits)
+    g_ref = jax.grad(lambda l: jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(l, labels)))(logits)
+    np.testing.assert_allclose(np.asarray(g_sparse), np.asarray(g_ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_dense), np.asarray(g_ref), atol=1e-6)
+
+
+def test_sparse_ce_registered_in_registry():
+    fn = get_loss("fused_sparse_softmax_cross_entropy")
+    logits = jnp.asarray(np.random.RandomState(7).randn(5, 7).astype(np.float32))
+    labels = jnp.asarray(np.arange(5) % 7, jnp.int32)
+    np.testing.assert_allclose(
+        float(fn(logits, labels)),
+        float(jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))),
+        rtol=1e-6,
+    )
+
+
 def test_transformer_with_flash_attention():
     from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
 

@@ -21,7 +21,7 @@ def _lm_batch(b=8, s=32, vocab=64, seed=0):
     rng = np.random.RandomState(seed)
     tokens = rng.randint(0, vocab, (b, s + 1))
     x = jnp.asarray(tokens[:, :-1], jnp.int32)
-    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[tokens[:, 1:]])
+    y = jnp.asarray(tokens[:, 1:], jnp.int32)  # sparse CE: integer targets
     return x, y
 
 
