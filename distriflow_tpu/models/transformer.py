@@ -46,6 +46,12 @@ class TransformerConfig:
     use_ulysses_attention: bool = False  # all-to-all SP (parallel/ulysses.py)
     use_flash_attention: bool = False  # Pallas kernel (distriflow_tpu/ops)
     causal: bool = True
+    # rotary position embeddings on q/k (parameter-free, TPU-friendly:
+    # two VPU multiplies fused into the attention prologue). Applied before
+    # the attention dispatch, so it composes with every path — dense,
+    # blockwise, flash, ring, Ulysses — positions are global iota
+    use_rope: bool = True
+    rope_base: float = 10000.0
     # integer-label CE by default: LM targets are the [B, S] int32 next-token
     # ids, never a [B, S, V] one-hot (HBM + wire cost scales with V otherwise)
     loss: str = "sparse_softmax_cross_entropy"
@@ -56,6 +62,37 @@ class TransformerConfig:
                 "use_ring_attention and use_ulysses_attention are mutually "
                 "exclusive sequence-parallel strategies; pick one"
             )
+
+
+def apply_rope(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    base: float = 10000.0,
+    offset: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rotary position embeddings over ``[B, H, S, D]`` q/k (D even).
+
+    Rotation runs in float32 (angle precision matters at long context) and
+    casts back to the input dtype; the attention score then depends only on
+    the relative position ``i - j``. ``offset`` shifts the absolute
+    positions (e.g. for decode-time caches)."""
+    d = q.shape[-1]
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {d}")
+    half = d // 2
+    pos = offset + jnp.arange(q.shape[2], dtype=jnp.float32)  # [S]
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)  # [half]
+    angles = pos[:, None] * freqs[None, :]  # [S, half]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+
+    def rot(x):
+        xf = x.astype(jnp.float32)
+        x1, x2 = xf[..., :half], xf[..., half:]
+        return jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).astype(x.dtype)
+
+    return rot(q), rot(k)
 
 
 class Attention(nn.Module):
@@ -75,6 +112,8 @@ class Attention(nn.Module):
         k = dense("k_proj")(x)
         v = dense("v_proj")(x)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, S, D]
+        if cfg.use_rope:
+            q, k = apply_rope(q, k, base=cfg.rope_base)
         seq_size = (
             dict(self.mesh.shape).get("seq", 1) if self.mesh is not None else 1
         )

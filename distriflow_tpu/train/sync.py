@@ -113,6 +113,7 @@ class SyncTrainer:
         self.logger = VerboseLogger(f"SyncTrainer[{spec.name}]", verbose)
         self.callbacks = CallbackRegistry("new_version", "step")
         self.state: Optional[TrainState] = None
+        self._donate = donate
         self._step_fn = self._build_step(donate)
         self._eval_fn = None
         # observability (reference time()/log wrappers, abstract_server.ts:92-103)
@@ -177,7 +178,7 @@ class SyncTrainer:
         def loss_fn(params: Params, x, y, w) -> jnp.ndarray:
             return spec.loss_fn(params, x, y, w)
 
-        def one_step(state: TrainState, batch) -> Tuple[TrainState, jnp.ndarray]:
+        def one_step(state: TrainState, batch):
             x, y, w = batch if len(batch) == 3 else (*batch, None)
             if accum > 1 and x.shape[0] % accum:
                 raise ValueError(
@@ -211,6 +212,7 @@ class SyncTrainer:
             new_params = optax.apply_updates(state.params, updates)
             return TrainState(new_params, new_opt, state.step + 1), loss
 
+        self._one_step = one_step  # raw (unjitted) body, reused by step_many
         return jax.jit(one_step, donate_argnums=(0,) if donate else ())
 
     def step(self, batch: Batch) -> float:
@@ -383,8 +385,55 @@ class SyncTrainer:
         self.state, loss = self._step_fn(self.state, batch)
         return loss
 
-    def _ensure_placed(self, batch) -> Any:
-        sharding = batch_sharding(self.mesh)
+    def step_many(self, batches: Batch) -> jnp.ndarray:
+        """Run K chained optimizer steps in ONE dispatch.
+
+        ``batches`` is the usual ``(x, y[, w])`` tuple with an extra leading
+        step axis: ``x`` is ``[K, B, ...]`` etc. The K steps run as a
+        device-side ``lax.scan`` — the TPU-idiomatic inner loop: one launch
+        amortizes host dispatch (and any transport latency between host and
+        device) over K real parameter updates, which dominates wall-clock
+        for small models. Semantically identical to K :meth:`step` calls
+        (the step counter advances K times); callbacks fire once per chunk.
+        Returns the ``[K]`` per-step losses (device array, not fetched).
+        """
+        if self.state is None:
+            self.init()
+        k = jax.tree.leaves(batches)[0].shape[0]
+        batches = self._ensure_placed(
+            batches, NamedSharding(self.mesh, P(None, "data")))
+        if getattr(self, "_multi_fn", None) is None:
+            one = self._one_step
+
+            def many(state, bt):
+                return jax.lax.scan(one, state, bt)
+
+            self._multi_fn = jax.jit(
+                many, donate_argnums=(0,) if self._donate else ())
+        start = time.perf_counter()
+        self.state, losses = self._multi_fn(self.state, batches)
+        chunk_ms = (time.perf_counter() - start) * 1e3
+        self.last_step_ms = chunk_ms / k  # per-step average for this chunk
+        self._step_times.append(self.last_step_ms)
+        if len(self._step_times) > 100:
+            del self._step_times[:-100]
+        self.callbacks.fire("step", self)
+        need_version = self.callbacks.has("new_version") or (
+            self.save_every and self.store is not None
+        )
+        if need_version:
+            # int(step) is a device fetch (a full pipeline sync on remote
+            # backends) — only pay it when someone is listening
+            version = self.version
+            if self.save_every and self.store is not None and any(
+                (version - i) % self.save_every == 0 for i in range(k)
+            ):
+                self.save(drop_if_busy=True)
+            self.callbacks.fire("new_version", str(version))
+        return losses
+
+    def _ensure_placed(self, batch, sharding: Optional[NamedSharding] = None) -> Any:
+        sharding = sharding if sharding is not None else batch_sharding(self.mesh)
         def place(v):
             if isinstance(v, jax.Array) and v.sharding == sharding:
                 return v

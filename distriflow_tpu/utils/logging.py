@@ -57,6 +57,14 @@ class CallbackRegistry:
         self._callbacks[name].append(fn)
         return fn
 
+    def has(self, name: str) -> bool:
+        """True when any callback is registered for ``name`` — lets hot
+        paths skip building expensive arguments (e.g. device fetches)."""
+        if name not in self._callbacks:  # same validation as fire(): a
+            # typo'd guard must fail loudly, not silently disable the branch
+            raise KeyError(f"unknown callback event {name!r}; valid: {sorted(self._callbacks)}")
+        return bool(self._callbacks[name])
+
     def fire(self, name: str, *args: Any, **kw: Any) -> None:
         if name not in self._callbacks:
             raise KeyError(f"unknown callback event {name!r}; valid: {sorted(self._callbacks)}")
