@@ -46,7 +46,7 @@ def bench_distriflow() -> float:
     import numpy as np
 
     from distriflow_tpu.models import mnist_mlp
-    from distriflow_tpu.parallel import data_parallel_mesh, shard_batch
+    from distriflow_tpu.parallel import data_parallel_mesh
     from distriflow_tpu.train.sync import SyncTrainer
 
     devices = jax.devices()

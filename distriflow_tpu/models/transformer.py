@@ -26,7 +26,8 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from distriflow_tpu.models.base import ModelSpec
 from distriflow_tpu.parallel.ring_attention import blockwise_attention, ring_attention
@@ -44,7 +45,11 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
     use_ulysses_attention: bool = False  # all-to-all SP (parallel/ulysses.py)
-    use_flash_attention: bool = False  # Pallas kernel (distriflow_tpu/ops)
+    # Pallas flash kernels (distriflow_tpu/ops): None = auto (on for TPU,
+    # off elsewhere — the kernel interpreter is test-only). Measured on v5e:
+    # matches XLA at S=1k, 2.4-2.8x faster at S=4k-8k, and the only
+    # non-OOM path at S=16k (XLA autodiff saves per-block score residuals)
+    use_flash_attention: Optional[bool] = None
     causal: bool = True
     # rotary position embeddings on q/k (parameter-free, TPU-friendly:
     # two VPU multiplies fused into the attention prologue). Applied before
@@ -95,6 +100,40 @@ def apply_rope(
     return rot(q), rot(k)
 
 
+def _sharded_flash_attention(q, k, v, causal, mesh):
+    """Flash attention that stays partitioned on a multi-device mesh.
+
+    ``pallas_call`` has no GSPMD partitioning rule: under plain jit on a
+    sharded mesh its operands would be all-gathered and the kernel run
+    replicated on every device. Batch and heads are embarrassingly parallel
+    in attention, so on a data/model-sharded mesh we shard_map the kernel
+    over those axes — each device runs flash on its own [B/dp, H/tp, S, D]
+    shard, no collectives. Requires B % dp == 0 and H % tp == 0 (the same
+    constraint Megatron TP already imposes on heads).
+    """
+    import functools as _ft
+
+    from distriflow_tpu.ops import flash_attention  # lazy: pallas import
+
+    fn = _ft.partial(flash_attention, causal=causal)
+    if mesh is None:
+        return fn(q, k, v)
+    parallel_axes = tuple(
+        ax for ax in ("data", "model")
+        if dict(mesh.shape).get(ax, 1) > 1
+    )
+    if not parallel_axes:
+        return fn(q, k, v)
+    spec = P(
+        "data" if "data" in parallel_axes else None,
+        "model" if "model" in parallel_axes else None,
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
 class Attention(nn.Module):
     config: TransformerConfig
     mesh: Optional[Mesh] = None
@@ -123,10 +162,11 @@ class Attention(nn.Module):
             from distriflow_tpu.parallel.ulysses import ulysses_attention
 
             out = ulysses_attention(q, k, v, self.mesh, axis="seq", causal=cfg.causal)
-        elif cfg.use_flash_attention:
-            from distriflow_tpu.ops import flash_attention  # lazy: pallas import
-
-            out = flash_attention(q, k, v, cfg.causal)
+        elif (
+            cfg.use_flash_attention
+            or (cfg.use_flash_attention is None and jax.default_backend() == "tpu")
+        ):
+            out = _sharded_flash_attention(q, k, v, cfg.causal, self.mesh)
         else:
             out = blockwise_attention(q, k, v, causal=cfg.causal)
         out = out.transpose(0, 2, 1, 3)  # [B, S, H, D]

@@ -1,24 +1,25 @@
-"""Flash attention: fused online-softmax attention as a Pallas TPU kernel.
+"""Flash attention: fused online-softmax attention as Pallas TPU kernels.
 
-The [S, S] score matrix never hits HBM: each grid step holds one Q block and
-one K/V block in VMEM and advances the flash recurrence (running max ``m``,
-running normalizer ``l``, unnormalized accumulator ``acc``) — the same
-recurrence as the pure-JAX ``blockwise_attention``
-(``distriflow_tpu/parallel/ring_attention.py``), which is this kernel's
-correctness oracle and its gradient path.
+The [S, S] score matrix never hits HBM — forward OR backward:
 
-Grid: ``(B*H, S/block_q, S/block_k)`` with the K dimension innermost; the
-accumulators live in VMEM scratch, which persists across the sequential
-innermost iterations on TPU, so VMEM usage is O(block·D) regardless of
-sequence length — long-context safe. Causal masking predicates away K blocks
-past the Q block's diagonal (~half the compute). Matmuls hit the MXU with
-float32 accumulation (``preferred_element_type``); masking/softmax run on
-the VPU. ``m``/``l`` scratch is lane-replicated to (block_q, 128) to stay on
-the natural f32 tile.
+- **Forward**: each grid step holds one Q block and one K/V block in VMEM and
+  advances the flash recurrence (running max ``m``, running normalizer ``l``,
+  unnormalized accumulator ``acc``) — the same recurrence as the pure-JAX
+  ``blockwise_attention`` (``distriflow_tpu/parallel/ring_attention.py``),
+  which is this kernel's correctness oracle. The per-row logsumexp is written
+  out as a residual.
+- **Backward**: two kernels over the saved (q, k, v, o, lse) — probabilities
+  are recomputed per tile as ``exp(s - lse)`` (no second softmax pass), and
+  with ``delta = rowsum(do * o)`` the score gradient is the closed form
+  ``ds = p * (dp - delta)``. The dq kernel accumulates over K/V tiles; the
+  dk/dv kernel accumulates over Q tiles. All four matmuls per tile hit the
+  MXU with float32 accumulation.
 
-Backward: ``jax.custom_vjp`` recomputes via ``blockwise_attention``'s VJP —
-flash-style recompute-in-backward (no residuals besides q/k/v), numerically
-exact since both compute identical softmax attention.
+Grids put batch*head and the output-tile axis in parallel dimensions (Mosaic
+runs them concurrently) and the reduction axis innermost-sequential (VMEM
+scratch persists across it). Causal masking predicates away fully-masked
+tiles (~half the compute each direction). VMEM usage is O(block · D)
+regardless of sequence length — long-context safe.
 """
 
 from __future__ import annotations
@@ -33,14 +34,14 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from distriflow_tpu.parallel.ring_attention import _auto_block, blockwise_attention
+from distriflow_tpu.parallel.ring_attention import _auto_block
 
 NEG_INF = -1e30
 _LANES = 128  # f32 tile width; m/l scratch is replicated across lanes
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-            *, block_q, block_k, n_kv, causal, scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, block_q, block_k, n_kv, causal, scale):
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -95,13 +96,133 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(kb == n_kv - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+        l_final = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_final).astype(o_ref.dtype)
+        # logsumexp residual for the backward kernels: m + log(l), with the
+        # scale already inside m (scores were pre-scaled)
+        safe_m = jnp.where(m_ref[:, :1] <= NEG_INF, 0.0, m_ref[:, :1])
+        # lane-replicated store (TPU blocks need a 128-multiple last dim)
+        lse_ref[0] = jnp.broadcast_to(safe_m + jnp.log(l_final), lse_ref.shape[1:])
 
 
-def _flash_forward(
-    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-    causal: bool, block_q: int, block_k: int, interpret: bool,
-) -> jnp.ndarray:
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, block_q, block_k, n_kv, causal, scale):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # masked: exp(NEG_INF - lse) = 0
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        ds = p * (dp - delta_ref[0][:, :1])
+        acc_ref[:] = acc_ref[:] + lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(kb * block_k < (qi + 1) * block_q)
+        def _():
+            _accumulate()
+    else:
+        _accumulate()
+
+    @pl.when(kb == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, block_q, block_k, n_q, causal, scale):
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dv_acc[:] = dv_acc[:] + lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # p^T @ do -> [block_k, D]
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1])
+        # q here already carries the 1/sqrt(D) scale (it built s); the
+        # contraction therefore yields dk = scale * ds^T @ q0 directly
+        dk_acc[:] = dk_acc[:] + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # ds^T @ q -> [block_k, D]
+
+    if causal:
+        # Q blocks entirely before this K block see none of it
+        @pl.when((qi + 1) * block_q > kb * block_k)
+        def _():
+            _accumulate()
+    else:
+        _accumulate()
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        from distriflow_tpu.ops import default_interpret
+
+        return default_interpret()
+    return interpret
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    interpret = _resolve_interpret(interpret)
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     bq = _auto_block(s, block_q)
@@ -113,9 +234,9 @@ def _flash_forward(
     vf = v.reshape(b * h, s, d)
 
     kernel = functools.partial(
-        _kernel, block_q=bq, block_k=bk, n_kv=n_kv, causal=causal, scale=scale
+        _fwd_kernel, block_q=bq, block_k=bk, n_kv=n_kv, causal=causal, scale=scale
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_kv),
         in_specs=[
@@ -123,18 +244,26 @@ def _flash_forward(
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            # lane-replicated residual (jax flash-attention convention: TPU
+            # output blocks need a 128-multiple last dim). Costs 128x the
+            # minimal [BH, S] residual — 0.5 KB/position of f32 — a deliberate
+            # trade against per-tile transposes in the backward reads.
+            jax.ShapeDtypeStruct((b * h, s, _LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, _LANES), jnp.float32),  # m (lane-replicated)
             pltpu.VMEM((bq, _LANES), jnp.float32),  # l
             pltpu.VMEM((bq, d), jnp.float32),  # acc
         ],
         interpret=interpret,
-        # batch*head and Q-block axes are independent -> let Mosaic run them
-        # as parallel dimensions; only the K axis is a sequential reduction
-        # (the scratch recurrence). Without this the whole grid executes
-        # serially on the TensorCore.
+        # batch*head and Q-block axes are independent -> parallel; only the
+        # K axis is a sequential reduction (the scratch recurrence)
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -144,7 +273,93 @@ def _flash_forward(
             transcendentals=b * h * s * s,
         ),
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse  # lse stays [B*H, S, LANES]
+
+
+_BWD_BLOCK_CAP = 256  # backward holds p/dp/ds tiles live at once: 512-wide
+# tiles spill scoped VMEM (measured 10x slowdown on v5e); 256 is the optimum
+
+
+def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    interpret = _resolve_interpret(interpret)
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq = _auto_block(s, min(block_q, _BWD_BLOCK_CAP))
+    bk = _auto_block(s, min(block_k, _BWD_BLOCK_CAP))
+    n_q, n_kv = s // bq, s // bk
+
+    # delta_i = rowsum(do_i * o_i): one cheap fused elementwise pass; makes
+    # ds = p * (dp - delta) local to each tile (the flash backward identity).
+    # Lane-replicated to match the lse layout (TPU block constraint).
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+        .reshape(b * h, s)[:, :, None],
+        (b * h, s, _LANES),
+    )
+
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, s, d)
+    vf = v.reshape(b * h, s, d)
+    dof = do.reshape(b * h, s, d)
+    lsef = lse  # already [B*H, S, LANES]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, block_q=bq, block_k=bk, n_kv=n_kv, causal=causal,
+            scale=scale,
+        ),
+        grid=(b * h, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, block_q=bq, block_k=bk, n_q=n_q, causal=causal,
+            scale=scale,
+        ),
+        grid=(b * h, n_kv, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, j, i: (bh, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),  # dk accumulator
+            pltpu.VMEM((bk, d), jnp.float32),  # dv accumulator
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(kf, vf, qf, dof, lsef, delta)
+
+    shape = (b, h, s, d)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -153,30 +368,27 @@ def flash_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = True,
-    block_q: int = 512,  # 512x512 measured fastest on v5e (vs 128/256 tiles)
-    block_k: int = 512,
+    block_q: int = 256,  # 256 tiles are the v5e optimum for the lse-emitting
+    block_k: int = 256,  # forward AND the backward; 512 spills scoped VMEM
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Fused attention over ``[B, H, S, D]`` tensors.
 
     ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere.
     """
-    if interpret is None:
-        from distriflow_tpu.ops import default_interpret
-
-        interpret = default_interpret()
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    return flash_attention(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # recompute-in-backward via the pure-JAX oracle (identical math)
-    _, vjp = jax.vjp(lambda q, k, v: blockwise_attention(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_backward(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -410,13 +410,10 @@ class SyncTrainer:
 
             self._multi_fn = jax.jit(
                 many, donate_argnums=(0,) if self._donate else ())
-        start = time.perf_counter()
+        # NB: no wall-clock recording here — the jitted scan returns on
+        # dispatch (async), so timing it would measure launch cost, not the
+        # K device steps; honest timing belongs to the caller's value fetch
         self.state, losses = self._multi_fn(self.state, batches)
-        chunk_ms = (time.perf_counter() - start) * 1e3
-        self.last_step_ms = chunk_ms / k  # per-step average for this chunk
-        self._step_times.append(self.last_step_ms)
-        if len(self._step_times) > 100:
-            del self._step_times[:-100]
         self.callbacks.fire("step", self)
         need_version = self.callbacks.has("new_version") or (
             self.save_every and self.store is not None

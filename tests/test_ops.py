@@ -54,6 +54,24 @@ def test_flash_attention_grad_matches_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
+@pytest.mark.parametrize("causal,bq,bk", [(False, 16, 16), (True, 16, 32), (True, 32, 16)])
+def test_flash_attention_grad_noncausal_and_uneven_blocks(causal, bq, bk):
+    """Backward kernels: non-causal path and asymmetric q/k tiles (the
+    causal tile-skip predicates differ per kernel and must stay exact)."""
+    q, k, v = _qkv(b=1, h=2, s=64, d=8, seed=3)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, bq, bk, True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
 def test_flash_attention_bf16():
     q, k, v = (t.astype(jnp.bfloat16) for t in _qkv(s=32, d=8))
     out = flash_attention(q, k, v, True, 16, 16, True)
@@ -198,6 +216,26 @@ def test_sparse_ce_registered_in_registry():
         float(jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))),
         rtol=1e-6,
     )
+
+
+def test_sharded_flash_attention_matches_dense(devices):
+    """Flash through shard_map on a data x model mesh == the dense oracle
+    (this is the auto-TPU path for multi-device meshes: pallas_call has no
+    GSPMD rule, so partitioning must come from shard_map over batch/heads)."""
+    from jax.sharding import Mesh
+
+    from distriflow_tpu.models.transformer import _sharded_flash_attention
+
+    mesh = Mesh(np.array(devices).reshape(4, 2), ("data", "model"))
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(4, 2, 64, 16).astype(np.float32))
+               for _ in range(3))
+    # interpret=None auto-selects interpret mode on the CPU test backend
+    out = jax.jit(
+        lambda q, k, v: _sharded_flash_attention(q, k, v, True, mesh)
+    )(q, k, v)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
 def test_transformer_with_flash_attention():
