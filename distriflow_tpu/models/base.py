@@ -99,6 +99,12 @@ class ModelSpec:
     input_shape: Tuple[int, ...] = ()
     output_shape: Tuple[int, ...] = ()
     name: str = "model"
+    # optional single-forward variant returning (preds, aux_scalar); the aux
+    # term (e.g. an MoE router load-balancing loss) is added to the training
+    # loss but excluded from eval metrics. Must compute the SAME preds as
+    # ``apply`` — it exists so auxiliary losses ride the one forward pass
+    # instead of a second one.
+    apply_with_aux: Optional[Callable[[Params, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]] = None
 
     def loss_fn(
         self,
@@ -108,8 +114,17 @@ class ModelSpec:
         weight: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
         """Weighted-mean loss; ``weight`` (per-example, 0 for padding rows)
-        makes padded partial batches exact on a sharded mesh."""
-        return losses_lib.get_loss(self.loss)(self.apply(params, x), y, weight)
+        makes padded partial batches exact on a sharded mesh.
+
+        Caveat: exactness covers the primary loss term. Models whose forward
+        pass has batch-coupled internals (MoE capacity routing — padding rows
+        still route and count in the load-balance statistics) are exact only
+        up to that coupling; mask at the data layer if it matters."""
+        loss = losses_lib.get_loss(self.loss)
+        if self.apply_with_aux is not None:
+            preds, aux = self.apply_with_aux(params, x)
+            return loss(preds, y, weight) + aux
+        return loss(self.apply(params, x), y, weight)
 
     def grad_fn(self) -> Callable[..., Tuple[jnp.ndarray, Params]]:
         """(params, x, y[, weight]) -> (loss, grads). Jit-compiled by callers."""

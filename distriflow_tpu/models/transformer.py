@@ -30,7 +30,11 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distriflow_tpu.models.base import ModelSpec
-from distriflow_tpu.parallel.ring_attention import blockwise_attention, ring_attention
+from distriflow_tpu.parallel.ring_attention import (
+    _auto_block,
+    blockwise_attention,
+    ring_attention,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +46,10 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq: int = 2048
     n_experts: int = 0  # 0 = dense FFN; >0 = MoE with EP-shardable experts
+    capacity_factor: float = 1.25  # expert buffer = factor * group / E
+    router_aux_weight: float = 0.01  # Switch load-balance loss weight
+    moe_group_size: int = 1024  # routing-group tokens (bounds dispatch size)
+    moe_dense_dispatch: bool = False  # True: exact all-experts dispatch
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
     use_ulysses_attention: bool = False  # all-to-all SP (parallel/ulysses.py)
@@ -187,12 +195,23 @@ class DenseFFN(nn.Module):
 
 
 class MoEFFN(nn.Module):
-    """Soft top-1 MoE: every expert computes, gate weights select.
+    """Switch-style top-1 MoE with capacity-based dispatch.
 
-    Round-1 implementation: dense dispatch (all tokens through all experts,
-    gated) — exact, simple, and the expert params carry a leading experts dim
-    shardable over the ``expert`` axis. A capacity-based all-to-all dispatch
-    is the planned optimization.
+    Each token routes to its argmax expert; each expert processes at most
+    ``capacity = capacity_factor * tokens / E`` tokens (overflow tokens pass
+    through the residual unchanged — standard Switch semantics). Dispatch
+    and combine are one-hot einsum contractions, the Mesh-TensorFlow
+    formulation GSPMD partitions well: with the expert dim of ``experts_wi``
+    / ``experts_wo`` sharded over the ``expert`` mesh axis and tokens over
+    ``data``, XLA lowers the dispatch/combine einsums to the expert
+    all-to-all. Compute per token is ONE expert FFN (the previous dense
+    dispatch ran every token through every expert: E-fold FLOPs).
+
+    The router gets gradients through the gate-probability scaling of the
+    combine, and sows the Switch load-balancing loss
+    ``E * sum_e f_e * P_e`` into the ``aux`` collection (a no-op when the
+    caller does not request it — e.g. the pipelined path).
+    ``moe_dense_dispatch=True`` restores the exact all-experts path.
     """
 
     config: TransformerConfig
@@ -201,11 +220,6 @@ class MoEFFN(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
         e = cfg.n_experts
-        gates = nn.Dense(e, name="router", dtype=jnp.float32)(x.astype(jnp.float32))
-        probs = jax.nn.softmax(gates, axis=-1)  # [B, S, E]
-        top = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype)
-        # straight-through: hard routing forward, soft gradient
-        dispatch = top + probs - lax_stop(probs)  # [B, S, E]
         wi = self.param(
             "experts_wi",
             nn.initializers.lecun_normal(),
@@ -218,14 +232,53 @@ class MoEFFN(nn.Module):
             (e, cfg.d_ff, cfg.d_model),
             jnp.float32,
         ).astype(cfg.dtype)
-        h = jnp.einsum("bsd,edf->bsef", x, wi)
-        h = nn.gelu(h)
-        out = jnp.einsum("bsef,efd->bsed", h, wo)
-        return jnp.einsum("bsed,bse->bsd", out, dispatch.astype(cfg.dtype))
+        gates = nn.Dense(e, name="router", dtype=jnp.float32)(x.astype(jnp.float32))
+        probs = jax.nn.softmax(gates, axis=-1)  # [B, S, E] f32
 
+        if cfg.moe_dense_dispatch:
+            # exact all-experts path (straight-through top-1 gate)
+            top = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype)
+            dispatch = top + probs - jax.lax.stop_gradient(probs)
+            h = jnp.einsum("bsd,edf->bsef", x, wi)
+            h = nn.gelu(h)
+            out = jnp.einsum("bsef,efd->bsed", h, wo)
+            return jnp.einsum("bsed,bse->bsd", out, dispatch.astype(cfg.dtype))
 
-def lax_stop(x):
-    return jax.lax.stop_gradient(x)
+        b, s, d = x.shape
+        n_tok = b * s
+        # tokens are routed within fixed-size groups (Mesh-TF "group_size"):
+        # the dispatch/combine tensors are [G, g, E, C] with C = factor*g/E,
+        # so their size is factor * T * g — LINEAR in total tokens (a single
+        # global group would make them quadratic)
+        g = _auto_block(n_tok, cfg.moe_group_size)
+        n_grp = n_tok // g
+        capacity = max(1, int(cfg.capacity_factor * g / e))
+        grp_x = x.reshape(n_grp, g, d)
+        grp_probs = probs.reshape(n_grp, g, e)
+        onehot = jax.nn.one_hot(jnp.argmax(grp_probs, -1), e,
+                                dtype=jnp.float32)  # [G, g, E]
+        gate = jnp.sum(grp_probs * onehot, axis=-1)  # [G, g] chosen prob
+        # Switch load-balancing aux: f_e = fraction routed to e, P_e = mean
+        # router prob; minimized (== 1) at uniform load
+        f_frac = jnp.mean(onehot, axis=(0, 1))
+        p_mean = jnp.mean(grp_probs, axis=(0, 1))
+        self.sow("aux", "load_balance", e * jnp.sum(f_frac * p_mean))
+        # position of each token within its expert's capacity buffer; both
+        # pos=0 (not routed here) and pos>capacity (overflow) land outside
+        # [0, C) and one_hot yields all-zero rows — no extra mask needed
+        pos = jnp.cumsum(onehot, axis=1) * onehot  # [G, g, E], 1-based
+        dispatch = jax.nn.one_hot(pos.astype(jnp.int32) - 1, capacity,
+                                  dtype=jnp.float32)  # [G, g, E, C] 0/1
+        combine = dispatch * gate[..., None, None]  # router grad flows here
+        expert_in = jnp.einsum(
+            "xtec,xtd->xecd", dispatch.astype(cfg.dtype), grp_x
+        )  # [G, E, C, d] — the expert all-to-all under GSPMD
+        h = nn.gelu(jnp.einsum("xecd,edf->xecf", expert_in, wi))
+        expert_out = jnp.einsum("xecf,efd->xecd", h, wo)
+        out = jnp.einsum(
+            "xtec,xecd->xtd", combine.astype(cfg.dtype), expert_out
+        )  # overflow tokens get zeros: they ride the residual connection
+        return out.reshape(b, s, d)
 
 
 class Block(nn.Module):
@@ -344,7 +397,9 @@ def pipelined_transformer_lm(
         tokens = jnp.zeros((example_batch, example_seq), jnp.int32)
         embed_params = embed_mod.init(r_embed, tokens)
         h = jnp.zeros((example_batch, example_seq, config.d_model), config.dtype)
-        stages = [stage_mod.init(r, h) for r in r_stages]
+        # filter to trainable params: with MoE stages, init also creates the
+        # sown 'aux' collection, which must not enter optimizer state
+        stages = [{"params": stage_mod.init(r, h)["params"]} for r in r_stages]
         stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *stages)
         return {
             "embed": embed_params,
@@ -391,7 +446,18 @@ def transformer_lm(
 
     def init(rng: jax.Array) -> Any:
         dummy = jnp.zeros((example_batch, example_seq), jnp.int32)
-        return module.init(rng, dummy)
+        variables = module.init(rng, dummy)
+        # keep only trainable params: sown collections (MoE aux losses)
+        # must not leak into the optimizer state
+        return {"params": variables["params"]}
+
+    apply_with_aux = None
+    if config.n_experts > 0 and config.router_aux_weight > 0 and not config.moe_dense_dispatch:
+        def apply_with_aux(params, tokens):
+            logits, aux_vars = module.apply(params, tokens, mutable=["aux"])
+            sown = jax.tree.leaves(aux_vars.get("aux", {}))
+            aux = sum(sown) * (config.router_aux_weight / max(len(sown), 1))
+            return logits, aux
 
     return ModelSpec(
         init=init,
@@ -400,4 +466,5 @@ def transformer_lm(
         input_shape=(example_seq,),
         output_shape=(config.vocab_size,),
         name="transformer_lm",
+        apply_with_aux=apply_with_aux,
     )

@@ -114,3 +114,16 @@ def test_validation_errors(devices):
                               d_ff=64, dtype=jnp.float32),
             mesh=mesh,
         )
+
+
+def test_pipelined_moe_init_has_only_params(devices):
+    """MoE stages sow an 'aux' collection at init; it must be filtered out of
+    the param tree (it is not trainable state)."""
+    import dataclasses
+
+    mesh = create_mesh(MeshConfig(pipe=2, data=2), devices[:4])
+    cfg = dataclasses.replace(CFG, n_experts=2)
+    spec = pipelined_transformer_lm(cfg, mesh=mesh, example_seq=16)
+    params = spec.init(jax.random.PRNGKey(0))
+    stage_keys = set(params["stages"].keys())
+    assert stage_keys == {"params"}, stage_keys

@@ -170,3 +170,81 @@ def test_ring_attention_model_matches_dense_model(devices):
     np.testing.assert_allclose(
         np.asarray(logits_dense), np.asarray(logits_ring), rtol=2e-4, atol=2e-4
     )
+
+
+def test_moe_capacity_dispatch_matches_reference():
+    """Capacity dispatch (no drops) == per-token loop: gate * FFN_argmax(x)."""
+    import dataclasses
+
+    from distriflow_tpu.models.transformer import MoEFFN
+
+    cfg = dataclasses.replace(
+        TINY, n_experts=4, d_ff=16, capacity_factor=100.0,  # no overflow
+    )
+    mod = MoEFFN(cfg)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, cfg.d_model).astype(np.float32))
+    variables = mod.init(jax.random.PRNGKey(0), x)
+    params = {"params": variables["params"]}
+    out, _ = mod.apply(params, x, mutable=["aux"])
+
+    p = variables["params"]
+    wi, wo = np.asarray(p["experts_wi"]), np.asarray(p["experts_wo"])
+    rk, rb = np.asarray(p["router"]["kernel"]), np.asarray(p["router"]["bias"])
+    xf = np.asarray(x).reshape(-1, cfg.d_model)
+    gates = xf @ rk + rb
+    probs = np.exp(gates - gates.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.zeros_like(xf)
+    for t in range(xf.shape[0]):
+        e = int(np.argmax(probs[t]))
+        h = xf[t] @ wi[e]
+        h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+        want[t] = (h @ wo[e]) * probs[t, e]
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(-1, cfg.d_model), want, atol=2e-5
+    )
+
+
+def test_moe_capacity_drops_overflow():
+    """With capacity 1 per expert, all-but-one token per expert returns zero
+    (overflow rides the residual in the Block)."""
+    import dataclasses
+
+    from distriflow_tpu.models.transformer import MoEFFN
+
+    cfg = dataclasses.replace(TINY, n_experts=2, d_ff=16, capacity_factor=0.125)
+    mod = MoEFFN(cfg)
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 16, cfg.d_model), jnp.float32)
+    variables = mod.init(jax.random.PRNGKey(0), x)
+    out, _ = mod.apply({"params": variables["params"]}, x, mutable=["aux"])
+    # capacity = max(1, int(0.125 * 16 / 2)) = 1 -> at most 2 nonzero rows
+    nonzero = np.count_nonzero(np.abs(np.asarray(out)[0]).sum(-1) > 1e-6)
+    assert nonzero <= 2, nonzero
+
+
+def test_moe_aux_loss_plumbed():
+    """transformer_lm with experts adds the router load-balance term to the
+    training loss via apply_with_aux (single forward pass)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, n_experts=4, d_ff=16)
+    spec = transformer_lm(cfg, example_seq=16)
+    assert spec.apply_with_aux is not None
+    params = spec.init(jax.random.PRNGKey(0))
+    assert set(params.keys()) == {"params"}  # sown collections filtered
+    rng = np.random.RandomState(2)
+    toks = rng.randint(0, cfg.vocab_size, (4, 17))
+    x = jnp.asarray(toks[:, :-1], jnp.int32)
+    y = jnp.asarray(toks[:, 1:], jnp.int32)
+    logits, aux = spec.apply_with_aux(params, x)
+    assert float(aux) > 0  # Switch aux >= router_aux_weight * 1 at any routing
+    plain = float(jax.numpy.mean(
+        __import__("optax").softmax_cross_entropy_with_integer_labels(logits, y)))
+    total = float(spec.loss_fn(params, x, y))
+    np.testing.assert_allclose(total, plain + float(aux), rtol=1e-6)
+    # trainable end to end
+    g = jax.grad(lambda p: spec.loss_fn(p, x, y))(params)
+    router_g = jax.tree.leaves(
+        g["params"]["layers_0"]["moe"]["router"])
+    assert any(float(np.abs(np.asarray(v)).max()) > 0 for v in router_g)
