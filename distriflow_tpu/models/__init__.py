@@ -17,6 +17,7 @@ from distriflow_tpu.models.losses import (
     register_loss,
     softmax_cross_entropy,
 )
+from distriflow_tpu.models.generate import generate
 from distriflow_tpu.models.mobilenet import MobileNetV2, mobilenet_v2
 from distriflow_tpu.models.zoo import MLP, ConvNet, cifar_convnet, mnist_convnet, mnist_mlp
 
@@ -42,4 +43,5 @@ __all__ = [
     "cifar_convnet",
     "mnist_convnet",
     "mnist_mlp",
+    "generate",
 ]

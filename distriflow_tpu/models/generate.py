@@ -1,0 +1,104 @@
+"""Autoregressive decoding for the transformer LM (KV cache).
+
+No reference counterpart (the reference has no sequence models,
+SURVEY.md §2.3). TPU-shaped decoding:
+
+- **prefill**: one forward over the whole prompt fills every layer's KV
+  cache (``TransformerLM(decode=True)`` + flax mutable ``cache``);
+- **decode loop**: a jit-compiled ``lax.scan`` over single-token steps —
+  the cache is carried functionally through the scan (static shapes,
+  no per-token dispatch from the host).
+
+Greedy (``temperature=0``) or temperature sampling. The cache holds
+``max_seq`` positions per layer; ``prompt_len + n_tokens`` must fit.
+
+Caveat: capacity-based MoE routes per decode step group, so expert-overflow
+behavior can differ from the training-time grouping; dense-FFN configs
+decode exactly (teacher-forcing logits match the training forward,
+see tests/test_generate.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distriflow_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+@functools.lru_cache(maxsize=32)
+def _build_fns(config: TransformerConfig, n_tokens: int, temperature: float):
+    """Jit-compiled prefill + decode scan, cached so repeated generate()
+    calls with the same config/shape hit the jit cache instead of paying
+    full XLA recompilation per call."""
+    cfg = dataclasses.replace(
+        config, use_ring_attention=False, use_ulysses_attention=False
+    )  # decode modules never take the sharded-attention paths
+    module = TransformerLM(cfg, mesh=None, decode=True)
+
+    @jax.jit
+    def prefill(params, prompt):
+        logits, vars_ = module.apply(params, prompt, mutable=["cache"])
+        return logits[:, -1], vars_["cache"]
+
+    def pick(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    @jax.jit
+    def decode_steps(params, cache, first_tok, rng):
+        def step(carry, key):
+            cache, tok = carry
+            logits, vars_ = module.apply(
+                {**params, "cache": cache}, tok[:, None], mutable=["cache"]
+            )
+            nxt = pick(logits[:, -1], key).astype(jnp.int32)
+            return (vars_["cache"], nxt), nxt
+
+        keys = jax.random.split(rng, n_tokens - 1)
+        (_, _), toks = jax.lax.scan(step, (cache, first_tok), keys)
+        return toks.T  # [B, n_tokens - 1]
+
+    return prefill, pick, decode_steps
+
+
+def generate(
+    config: TransformerConfig,
+    params,
+    prompt: jnp.ndarray,
+    n_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Generate ``n_tokens`` continuations of ``prompt`` ``[B, P] int32``.
+
+    Returns ``[B, P + n_tokens]`` (prompt + generated). ``temperature=0``
+    is greedy argmax; otherwise softmax sampling at the given temperature
+    (``rng`` required).
+    """
+    b, p = prompt.shape
+    if n_tokens <= 0:
+        return prompt
+    if p + n_tokens > config.max_seq:
+        raise ValueError(
+            f"prompt ({p}) + n_tokens ({n_tokens}) exceeds max_seq "
+            f"({config.max_seq}); raise config.max_seq"
+        )
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs rng=jax.random.PRNGKey(...)")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    prefill, pick, decode_steps = _build_fns(config, n_tokens, temperature)
+
+    last_logits, cache = prefill(params, prompt)
+    key0, key_rest = jax.random.split(rng)
+    first = pick(last_logits, key0).astype(jnp.int32)
+    out = [prompt, first[:, None]]
+    if n_tokens > 1:
+        out.append(decode_steps(params, cache, first, key_rest))
+    return jnp.concatenate(out, axis=1)

@@ -21,6 +21,7 @@ stay float32.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Optional, Tuple
 
 import flax.linen as nn
@@ -145,6 +146,7 @@ def _sharded_flash_attention(q, k, v, causal, mesh):
 class Attention(nn.Module):
     config: TransformerConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False  # KV-cache autoregressive mode (mutable 'cache')
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -159,6 +161,8 @@ class Attention(nn.Module):
         k = dense("k_proj")(x)
         v = dense("v_proj")(x)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, S, D]
+        if self.decode:
+            return self._decode_attend(q, k, v, b, s, head_dim)
         if cfg.use_rope:
             q, k = apply_rope(q, k, base=cfg.rope_base)
         seq_size = (
@@ -178,6 +182,47 @@ class Attention(nn.Module):
         else:
             out = blockwise_attention(q, k, v, causal=cfg.causal)
         out = out.transpose(0, 2, 1, 3)  # [B, S, H, D]
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype, use_bias=False
+        )(out)
+
+    def _decode_attend(self, q, k, v, b, s, head_dim):
+        """Incremental attention against the mutable KV cache.
+
+        The first call (prefill, any ``s``) fills positions ``[0, s)``; each
+        later call appends at the running index. q/k get RoPE at their
+        absolute positions. Decoding is matvec-bound, so this is the plain
+        XLA path (flash kernels buy nothing at query length 1)."""
+        cfg = self.config
+        cache_shape = (b, cfg.n_heads, cfg.max_seq, head_dim)
+        ck = self.variable("cache", "cached_k", jnp.zeros, cache_shape, cfg.dtype)
+        cv = self.variable("cache", "cached_v", jnp.zeros, cache_shape, cfg.dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        idx = ci.value
+        if cfg.use_rope:
+            q, k = apply_rope(q, k, base=cfg.rope_base, offset=idx)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, 0, idx, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, 0, idx, 0))
+        ci.value = idx + s
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, ck.value, preferred_element_type=jnp.float32
+        ) / math.sqrt(head_dim)  # [B, H, s, max_seq]
+        k_pos = jnp.arange(cfg.max_seq)[None, :]
+        q_pos = idx + jnp.arange(s)[:, None]
+        if cfg.causal:
+            visible = k_pos <= q_pos
+        else:
+            # non-causal configs still must not attend to empty cache slots
+            visible = jnp.broadcast_to(k_pos < idx + s, (s, cfg.max_seq))
+        scores = jnp.where(visible, scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, cv.value, preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        out = out.transpose(0, 2, 1, 3)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype, use_bias=False
         )(out)
@@ -284,12 +329,13 @@ class MoEFFN(nn.Module):
 class Block(nn.Module):
     config: TransformerConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
         h = nn.LayerNorm(name="ln_attn", dtype=jnp.float32)(x)
-        x = x + Attention(cfg, self.mesh, name="attn")(h)
+        x = x + Attention(cfg, self.mesh, self.decode, name="attn")(h)
         h = nn.LayerNorm(name="ln_mlp", dtype=jnp.float32)(x)
         ffn = MoEFFN(cfg, name="moe") if cfg.n_experts > 0 else DenseFFN(cfg, name="mlp")
         return x + ffn(h)
@@ -298,6 +344,7 @@ class Block(nn.Module):
 class TransformerLM(nn.Module):
     config: TransformerConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -305,7 +352,7 @@ class TransformerLM(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed",
                      dtype=cfg.dtype)(tokens)
         for i in range(cfg.n_layers):
-            x = Block(cfg, self.mesh, name=f"layers_{i}")(x)
+            x = Block(cfg, self.mesh, self.decode, name=f"layers_{i}")(x)
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
         logits = nn.Dense(cfg.vocab_size, name="lm_head", dtype=cfg.dtype,
                           use_bias=False)(x)

@@ -59,10 +59,21 @@ def main(argv=None) -> float:
     p.add_argument("--corpus-tokens", type=int, default=200_000)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--save-every", type=int, default=0)
+    p.add_argument("--generate", type=int, default=0,
+                   help="after training, decode N tokens from a corpus prompt "
+                        "and report how many follow the Markov structure")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
     import jax.numpy as jnp
+
+    gen_prompt_len = min(32, args.seq)
+    if args.generate and gen_prompt_len + args.generate > args.seq:
+        # fail BEFORE training, not after the run's budget is spent
+        p.error(
+            f"--generate {args.generate} + prompt {gen_prompt_len} exceeds "
+            f"--seq {args.seq} (the decode cache length)"
+        )
 
     mesh = parse_mesh(args.mesh)
     cfg = TransformerConfig(
@@ -124,6 +135,19 @@ def main(argv=None) -> float:
         f"(ppl {np.exp(unigram):.1f})",
         file=sys.stderr,
     )
+    if args.generate > 0:
+        from distriflow_tpu.models import generate as lm_generate
+
+        prompt = jnp.asarray(eval_corpus[None, :gen_prompt_len], jnp.int32)
+        out = lm_generate(cfg, trainer.get_params(), prompt, args.generate)
+        gen = np.asarray(out[0, gen_prompt_len:])
+        # a correct continuation only ever takes transitions that occur in
+        # the corpus; measure the fraction of generated bigrams that do
+        seen = set(zip(corpus[:-1].tolist(), corpus[1:].tolist()))
+        pairs = list(zip(np.asarray(out[0, 31:-1]).tolist(), gen.tolist()))
+        valid = sum(p in seen for p in pairs) / len(pairs)
+        print(f"generated {args.generate} tokens; {valid:.0%} of transitions "
+              f"follow the corpus Markov structure", file=sys.stderr)
     trainer.close()
     return eval_loss
 
