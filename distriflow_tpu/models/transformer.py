@@ -169,7 +169,11 @@ class Attention(nn.Module):
             dict(self.mesh.shape).get("seq", 1) if self.mesh is not None else 1
         )
         if cfg.use_ring_attention and seq_size > 1:
-            out = ring_attention(q, k, v, self.mesh, axis="seq", causal=cfg.causal)
+            # thread the flash preference: an explicit use_flash_attention
+            # opt-out must also disable the flash kernels inside the ring
+            out = ring_attention(q, k, v, self.mesh, axis="seq",
+                                 causal=cfg.causal,
+                                 use_flash=cfg.use_flash_attention)
         elif cfg.use_ulysses_attention and seq_size > 1:
             from distriflow_tpu.parallel.ulysses import ulysses_attention
 

@@ -280,7 +280,8 @@ _BWD_BLOCK_CAP = 256  # backward holds p/dp/ds tiles live at once: 512-wide
 # tiles spill scoped VMEM (measured 10x slowdown on v5e); 256 is the optimum
 
 
-def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+                    g_lse=None):
     interpret = _resolve_interpret(interpret)
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -291,11 +292,14 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     # delta_i = rowsum(do_i * o_i): one cheap fused elementwise pass; makes
     # ds = p * (dp - delta) local to each tile (the flash backward identity).
     # Lane-replicated to match the lse layout (TPU block constraint).
-    delta = jnp.broadcast_to(
-        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-        .reshape(b * h, s)[:, :, None],
-        (b * h, s, _LANES),
-    )
+    delta_rows = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).reshape(b * h, s)
+    if g_lse is not None:
+        # an lse cotangent folds into delta: dlse/ds_ij = p_ij, so the score
+        # gradient becomes ds = p * (dp - (delta - g_lse))
+        delta_rows = delta_rows - g_lse.astype(jnp.float32).reshape(b * h, s)
+    delta = jnp.broadcast_to(delta_rows[:, :, None], (b * h, s, _LANES))
 
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, s, d)
@@ -382,6 +386,42 @@ def flash_attention(
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+):
+    """Like :func:`flash_attention` but also returns the per-row logsumexp
+    ``[B, H, S]`` (f32) — the residual that lets partial attentions over
+    K/V chunks merge exactly (ring attention, sequence parallelism). Fully
+    differentiable including through the lse output."""
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    b, h, s, _ = q.shape
+    return out, lse[..., 0].reshape(b, h, s)
+
+
+def _fwd_with_lse(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    b, h, s, _ = q.shape
+    return (out, lse[..., 0].reshape(b, h, s)), (q, k, v, out, lse)
+
+
+def _bwd_with_lse(causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    do, g_lse = g
+    return _flash_backward(
+        q, k, v, o, lse, do, causal, block_q, block_k, interpret, g_lse=g_lse
+    )
+
+
+flash_attention_with_lse.defvjp(_fwd_with_lse, _bwd_with_lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):

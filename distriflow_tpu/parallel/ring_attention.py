@@ -147,6 +147,7 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "seq",
     causal: bool = True,
+    use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Distributed attention over sequence shards on the ``axis`` ring.
 
@@ -154,6 +155,12 @@ def ring_attention(
     ``axis`` on dim 2); output is sharded the same way. Within shard_map each
     device loops ``n`` times: attend to the held K/V chunk, then ``ppermute``
     K/V to the next device.
+
+    ``use_flash`` (None = auto: on for TPU) runs each chunk-vs-chunk
+    attention as the Pallas flash kernel and merges the per-chunk partials
+    through their logsumexp residuals — causal=True only, and only for the
+    diagonal step (each device's own chunk); earlier chunks attend densely
+    and later chunks merge with weight zero.
     """
     n = mesh.shape[axis]
     if q.shape[2] % n:
@@ -197,6 +204,42 @@ def ring_attention(
         m, l, o, _, _ = lax.fori_loop(0, n, body, (m, l, o, kc, vc))
         return (o / jnp.maximum(l, 1e-30)[..., None]).astype(qc.dtype)
 
+    def local_flash(qc, kc, vc):
+        # per-chunk Pallas flash + online lse merge: the chunk partials
+        # combine exactly because flash exports each row's logsumexp
+        from distriflow_tpu.ops.flash_attention import flash_attention_with_lse
+
+        my_index = lax.axis_index(axis)
+
+        def chunk_attn(kc, vc, chunk_causal):
+            o_i, lse_i = flash_attention_with_lse(qc, kc, vc, chunk_causal)
+            return o_i.astype(jnp.float32), lse_i
+
+        # step 0 holds this device's own chunk: the causal diagonal
+        o_acc, lse_acc = chunk_attn(kc, vc, causal)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+
+        def body(step, carry):
+            o_acc, lse_acc, kc, vc = carry
+            src = jnp.mod(my_index - step, n)
+            o_i, lse_i = chunk_attn(kc, vc, False)
+            if causal:
+                # chunks from later positions contribute nothing; NEG_INF
+                # (not -inf) keeps exp/logaddexp free of inf-inf NaNs
+                lse_i = jnp.where(src > my_index, NEG_INF, lse_i)
+            new_lse = jnp.logaddexp(lse_acc, lse_i)
+            o_acc = (
+                o_acc * jnp.exp(lse_acc - new_lse)[..., None]
+                + o_i * jnp.exp(lse_i - new_lse)[..., None]
+            )
+            kc = lax.ppermute(kc, axis, perm)
+            vc = lax.ppermute(vc, axis, perm)
+            return o_acc, new_lse, kc, vc
+
+        o_acc, _, _, _ = lax.fori_loop(1, n, body, (o_acc, lse_acc, kc, vc))
+        return o_acc.astype(qc.dtype)
+
     # batch rides the data axis and heads ride the model axis when present —
     # mentioning only `axis` would force an all-gather of the full global
     # batch and all heads onto every seq-group device, erasing DP/TP sharding
@@ -206,5 +249,11 @@ def ring_attention(
         axis,
         None,
     )
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    body = local_flash if use_flash else local
+    # pallas_call carries no varying-mesh-axes info, so the flash path must
+    # disable shard_map's vma check
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=not use_flash)
     return fn(q, k, v)
