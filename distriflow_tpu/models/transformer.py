@@ -109,6 +109,12 @@ def apply_rope(
     return rot(q), rot(k)
 
 
+def _default_use_flash() -> bool:
+    from distriflow_tpu.ops import default_use_flash
+
+    return default_use_flash()
+
+
 def _sharded_flash_attention(q, k, v, causal, mesh):
     """Flash attention that stays partitioned on a multi-device mesh.
 
@@ -177,10 +183,11 @@ class Attention(nn.Module):
         elif cfg.use_ulysses_attention and seq_size > 1:
             from distriflow_tpu.parallel.ulysses import ulysses_attention
 
-            out = ulysses_attention(q, k, v, self.mesh, axis="seq", causal=cfg.causal)
-        elif (
-            cfg.use_flash_attention
-            or (cfg.use_flash_attention is None and jax.default_backend() == "tpu")
+            out = ulysses_attention(q, k, v, self.mesh, axis="seq",
+                                    causal=cfg.causal,
+                                    use_flash=cfg.use_flash_attention)
+        elif cfg.use_flash_attention or (
+            cfg.use_flash_attention is None and _default_use_flash()
         ):
             out = _sharded_flash_attention(q, k, v, cfg.causal, self.mesh)
         else:

@@ -27,3 +27,9 @@ def default_interpret() -> bool:
     import jax
 
     return jax.default_backend() != "tpu"
+
+
+def default_use_flash() -> bool:
+    """Single source of truth for flash-kernel auto-enablement (the
+    compiled kernels exist only on TPU; interpret mode is test-only)."""
+    return not default_interpret()

@@ -250,7 +250,9 @@ def ring_attention(
         None,
     )
     if use_flash is None:
-        use_flash = jax.default_backend() == "tpu"
+        from distriflow_tpu.ops import default_use_flash
+
+        use_flash = default_use_flash()
     body = local_flash if use_flash else local
     # pallas_call carries no varying-mesh-axes info, so the flash path must
     # disable shard_map's vma check

@@ -35,12 +35,16 @@ def ulysses_attention(
     mesh: Mesh,
     axis: str = "seq",
     causal: bool = True,
+    use_flash: "bool | None" = None,
 ) -> jnp.ndarray:
     """All-to-all sequence-parallel attention.
 
     Inputs are GLOBAL ``[B, H, S, D]`` (sharded or shardable over ``axis``
     on the sequence dim); output is sharded the same way — drop-in
-    signature parity with :func:`ring_attention`.
+    signature parity with :func:`ring_attention`. After the all-to-all each
+    device attends over the FULL sequence for its head subset — exactly the
+    shape the Pallas flash kernel wants, so ``use_flash`` (None = auto on
+    TPU) runs the local attention as flash.
     """
     n = mesh.shape[axis]
     b, h, s, d = q.shape
@@ -57,6 +61,11 @@ def ulysses_attention(
             "the axis size"
         )
 
+    if use_flash is None:
+        from distriflow_tpu.ops import default_use_flash
+
+        use_flash = default_use_flash()
+
     def local(qc, kc, vc):
         # [B, H, S/n, D] -> all-to-all -> [B, H/n, S, D]: scatter heads,
         # gather sequence. tiled=True keeps the axis in place (no new dim).
@@ -66,9 +75,14 @@ def ulysses_attention(
         def swap_out(t):
             return lax.all_to_all(t, axis, split_axis=2, concat_axis=1, tiled=True)
 
-        out = blockwise_attention(
-            swap_in(qc), swap_in(kc), swap_in(vc), causal=causal
-        )
+        if use_flash:
+            from distriflow_tpu.ops import flash_attention
+
+            out = flash_attention(swap_in(qc), swap_in(kc), swap_in(vc), causal)
+        else:
+            out = blockwise_attention(
+                swap_in(qc), swap_in(kc), swap_in(vc), causal=causal
+            )
         return swap_out(out).astype(qc.dtype)
 
     names = mesh.axis_names
