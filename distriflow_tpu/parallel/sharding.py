@@ -88,7 +88,40 @@ def shard_params(params: Any, mesh: Mesh, rules: Rules = REPLICATED_RULES) -> An
     return jax.tree.map(jax.device_put, params, shardings)
 
 
-def opt_state_shardings(opt_state_shape: Any, params: Any, param_shardings: Any, mesh: Mesh) -> Any:
+def _zero_extend(sh: NamedSharding, shape, mesh: Mesh, axis: str) -> NamedSharding:
+    """Additionally shard a moment buffer's first shardable dim over ``axis``.
+
+    ZeRO-1 semantics: optimizer state need never be replicated across the
+    data-parallel group — each data shard owns a slice. The first dimension
+    that is currently unsharded and divisible by the axis size gets it;
+    buffers with no such dim keep the param's sharding.
+    """
+    size = dict(mesh.shape).get(axis, 1)
+    if size <= 1:
+        return sh
+    spec = list(sh.spec) + [None] * (len(shape) - len(sh.spec))
+    used = set()
+    for entry in spec:  # spec entries may be axis names or tuples of them
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        elif entry is not None:
+            used.add(entry)
+    if axis in used:  # a mesh axis may appear at most once per spec
+        return sh
+    for i, dim in enumerate(shape):
+        if spec[i] is None and dim % size == 0:
+            spec[i] = axis
+            return NamedSharding(mesh, P(*spec))
+    return sh
+
+
+def opt_state_shardings(
+    opt_state_shape: Any,
+    params: Any,
+    param_shardings: Any,
+    mesh: Mesh,
+    zero_axis: Optional[str] = None,
+) -> Any:
     """Shardings for an optax state, mirroring the param shardings.
 
     Optax moment buffers (mu/nu/trace/...) embed copies of the param pytree;
@@ -96,6 +129,11 @@ def opt_state_shardings(opt_state_shape: Any, params: Any, param_shardings: Any,
     everything else (counts, scalars) replicates. Needed because
     ``optimizer.init`` is shape-only (``zeros_like``), so XLA will not
     propagate input shardings into its outputs.
+
+    ``zero_axis`` (e.g. ``"data"``) additionally shards each moment buffer
+    over that axis (ZeRO-1): per-device optimizer memory drops by the
+    data-parallel degree, and XLA inserts the reduce-scatter/all-gather
+    pair around the update automatically.
     """
     param_by_path = {
         jax.tree_util.keystr(path): (sh, tuple(np.shape(leaf)))
@@ -110,6 +148,8 @@ def opt_state_shardings(opt_state_shape: Any, params: Any, param_shardings: Any,
         key = jax.tree_util.keystr(path)
         for p_key, (sh, p_shape) in param_by_path.items():
             if key.endswith(p_key) and tuple(np.shape(leaf)) == p_shape:
+                if zero_axis is not None:
+                    return _zero_extend(sh, p_shape, mesh, zero_axis)
                 return sh
         return replicated
 

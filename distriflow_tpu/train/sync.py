@@ -104,6 +104,7 @@ class SyncTrainer:
         checkpoint_dir: Optional[str] = None,
         save_every: int = 0,
         sharded_checkpoints: bool = False,
+        zero_optimizer_sharding: bool = False,
     ):
         self.spec = spec
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
@@ -114,6 +115,9 @@ class SyncTrainer:
         self.callbacks = CallbackRegistry("new_version", "step")
         self.state: Optional[TrainState] = None
         self._donate = donate
+        # ZeRO-1: moment buffers shard over the data axis (memory / dp);
+        # XLA inserts the reduce-scatter/all-gather pair around the update
+        self._zero_opt = zero_optimizer_sharding
         self._step_fn = self._build_step(donate)
         self._eval_fn = None
         # observability (reference time()/log wrappers, abstract_server.ts:92-103)
@@ -154,7 +158,10 @@ class SyncTrainer:
             param_sh = tree_shardings(params, self.mesh, self.param_rules)
             params = jax.tree.map(jax.device_put, params, param_sh)
             opt_shape = jax.eval_shape(self.optimizer.init, params)
-            opt_sh = opt_state_shardings(opt_shape, params, param_sh, self.mesh)
+            opt_sh = opt_state_shardings(
+                opt_shape, params, param_sh, self.mesh,
+                zero_axis="data" if self._zero_opt else None,
+            )
             opt_state = jax.jit(self.optimizer.init, out_shardings=opt_sh)(params)
             step = jax.device_put(jnp.int32(0), NamedSharding(self.mesh, P()))
             self.state = TrainState(params=params, opt_state=opt_state, step=step)
@@ -457,7 +464,15 @@ class SyncTrainer:
     def set_params(self, params: Params) -> None:
         if self.state is None:
             self.init()
-        placed = jax.tree.map(
-            jax.device_put, params, tree_shardings(params, self.mesh, self.param_rules)
+        param_sh = tree_shardings(params, self.mesh, self.param_rules)
+        placed = jax.tree.map(jax.device_put, params, param_sh)
+        # rebuild the optimizer state with the SAME sharding policy as
+        # init() — a plain eager init would silently replicate ZeRO-sharded
+        # moment buffers (memory regression + step recompilation)
+        opt_shape = jax.eval_shape(self.optimizer.init, placed)
+        opt_sh = opt_state_shardings(
+            opt_shape, placed, param_sh, self.mesh,
+            zero_axis="data" if self._zero_opt else None,
         )
-        self.state = TrainState(placed, self.optimizer.init(placed), self.state.step)
+        opt_state = jax.jit(self.optimizer.init, out_shardings=opt_sh)(placed)
+        self.state = TrainState(placed, opt_state, self.state.step)

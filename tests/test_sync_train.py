@@ -181,3 +181,53 @@ def test_step_many_fires_version_callback(devices):
     ys = np.stack([np.asarray(_mnist_like(16, seed=i)[1]) for i in range(3)])
     t.step_many((xs, ys))
     assert seen == ["3"]  # fired once per chunk, with the advanced counter
+
+
+def test_zero_optimizer_sharding_matches_replicated(devices):
+    """ZeRO-1 (moments sharded over data) is a pure memory layout change:
+    losses and params must match the replicated-optimizer run exactly, and
+    the moment buffers must actually be sharded."""
+    mesh = data_parallel_mesh(devices)
+    x, y = _mnist_like(32)
+
+    def run(zero):
+        t = SyncTrainer(mnist_mlp(hidden=16), mesh=mesh, learning_rate=0.05,
+                        optimizer="adam", zero_optimizer_sharding=zero)
+        t.init(jax.random.PRNGKey(0))
+        losses = [t.step((x, y)) for _ in range(4)]
+        return t, losses
+
+    t0, l0 = run(False)
+    t1, l1 = run(True)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0), rtol=2e-6)
+    for a, b in zip(jax.tree.leaves(t0.get_params()), jax.tree.leaves(t1.get_params())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-6)
+
+    # the adam mu buffer for the 784x16 kernel is sharded over data (8)
+    mu = t1.state.opt_state[0].mu
+    big = max(jax.tree_util.tree_leaves(mu), key=lambda v: v.size)
+    assert big.addressable_shards[0].data.shape[0] == big.shape[0] // 8
+    # replicated run keeps full copies
+    mu0 = t0.state.opt_state[0].mu
+    big0 = max(jax.tree_util.tree_leaves(mu0), key=lambda v: v.size)
+    assert big0.addressable_shards[0].data.shape == big0.shape
+
+
+def test_zero_sharding_skips_params_already_on_data_axis(devices):
+    """A param already sharded over 'data' must not get it twice (that would
+    be an invalid PartitionSpec), and set_params must preserve ZeRO layout."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = data_parallel_mesh(devices)
+    rules = ((r".*Dense_0.*kernel", P("data")), (r".*", P()))
+    t = SyncTrainer(mnist_mlp(hidden=16), mesh=mesh, learning_rate=0.05,
+                    optimizer="adam", param_rules=rules,
+                    zero_optimizer_sharding=True)
+    t.init(jax.random.PRNGKey(0))  # must not raise DuplicateSpecError
+    x, y = _mnist_like(16)
+    t.step((x, y))
+    # set_params keeps the ZeRO moment sharding
+    t.set_params(jax.tree.map(np.asarray, t.get_params()))
+    mu = t.state.opt_state[0].mu
+    big = max(jax.tree_util.tree_leaves(mu), key=lambda v: v.size)
+    assert big.addressable_shards[0].data.size < big.size
