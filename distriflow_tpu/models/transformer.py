@@ -66,6 +66,10 @@ class TransformerConfig:
     # blockwise, flash, ring, Ulysses — positions are global iota
     use_rope: bool = True
     rope_base: float = 10000.0
+    # rematerialize each block in backward (jax.checkpoint): activation
+    # memory drops from O(layers * S * d) to O(S * d) at ~1/3 extra FLOPs —
+    # the standard trade for long context / deep stacks
+    remat: bool = False
     # integer-label CE by default: LM targets are the [B, S] int32 next-token
     # ids, never a [B, S, V] one-hot (HBM + wire cost scales with V otherwise)
     loss: str = "sparse_softmax_cross_entropy"
@@ -362,8 +366,9 @@ class TransformerLM(nn.Module):
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed",
                      dtype=cfg.dtype)(tokens)
+        block_cls = nn.remat(Block) if (cfg.remat and not self.decode) else Block
         for i in range(cfg.n_layers):
-            x = Block(cfg, self.mesh, self.decode, name=f"layers_{i}")(x)
+            x = block_cls(cfg, self.mesh, self.decode, name=f"layers_{i}")(x)
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
         logits = nn.Dense(cfg.vocab_size, name="lm_head", dtype=cfg.dtype,
                           use_bias=False)(x)
@@ -436,6 +441,18 @@ def pipelined_transformer_lm(
         config = dataclasses.replace(config, **overrides)
     if mesh is None or "pipe" not in mesh.shape or mesh.shape["pipe"] < 2:
         raise ValueError("pipelined_transformer_lm needs a mesh with pipe >= 2")
+    if config.remat:
+        import warnings
+
+        # jax.checkpoint residuals cannot cross gpipe's hybrid manual/auto
+        # shard_map boundary when they carry auto-sharded (model/seq/expert)
+        # axes — remat inside pipeline stages is unsupported
+        warnings.warn(
+            "remat=True is ignored by pipelined_transformer_lm (checkpoint "
+            "residuals cannot cross the pipeline's hybrid shard_map); use "
+            "more pipeline stages or smaller microbatches for memory instead",
+            stacklevel=2,
+        )
     n_stages = mesh.shape["pipe"]
     if config.n_layers % n_stages:
         raise ValueError(

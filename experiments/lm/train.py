@@ -54,6 +54,8 @@ def main(argv=None) -> float:
     p.add_argument("--attention", choices=("auto", "flash", "blockwise", "ring", "ulysses"),
                    default="auto")
     p.add_argument("--dtype", choices=("bfloat16", "float32"), default="bfloat16")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks in backward (long-context memory)")
     p.add_argument("--mesh", default="", help="e.g. data=2,model=2,seq=2")
     p.add_argument("--learning-rate", type=float, default=3e-3)
     p.add_argument("--corpus-tokens", type=int, default=200_000)
@@ -88,6 +90,7 @@ def main(argv=None) -> float:
         use_flash_attention={"auto": None, "flash": True}.get(args.attention, False),
         use_ring_attention=args.attention == "ring",
         use_ulysses_attention=args.attention == "ulysses",
+        remat=args.remat,
     )
     spec = transformer_lm(cfg, mesh=mesh, example_seq=args.seq)
     trainer = SyncTrainer(

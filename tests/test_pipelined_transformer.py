@@ -127,3 +127,17 @@ def test_pipelined_moe_init_has_only_params(devices):
     params = spec.init(jax.random.PRNGKey(0))
     stage_keys = set(params["stages"].keys())
     assert stage_keys == {"params"}, stage_keys
+
+
+def test_pipelined_remat_warns_ignored(devices):
+    """remat cannot cross gpipe's hybrid shard_map; the flag must warn, not
+    silently do nothing (matching the ignored-learning_rate convention)."""
+    import dataclasses
+    import warnings
+
+    mesh = create_mesh(MeshConfig(pipe=2, data=2), devices[:4])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        pipelined_transformer_lm(
+            dataclasses.replace(CFG, remat=True), mesh=mesh, example_seq=16)
+    assert any("remat" in str(x.message) for x in w)

@@ -248,3 +248,19 @@ def test_moe_aux_loss_plumbed():
     router_g = jax.tree.leaves(
         g["params"]["layers_0"]["moe"]["router"])
     assert any(float(np.abs(np.asarray(v)).max()) > 0 for v in router_g)
+
+
+def test_remat_matches_no_remat():
+    """Rematerialization is compute-only: identical loss and gradients."""
+    import dataclasses
+
+    x, y = _lm_batch(b=4, s=32)
+    spec0 = transformer_lm(TINY, example_seq=32)
+    spec1 = transformer_lm(dataclasses.replace(TINY, remat=True), example_seq=32)
+    params = spec0.init(jax.random.PRNGKey(0))
+    # param trees are interchangeable (remat does not rename)
+    l0, g0 = jax.value_and_grad(lambda p: spec0.loss_fn(p, x, y))(params)
+    l1, g1 = jax.value_and_grad(lambda p: spec1.loss_fn(p, x, y))(params)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
