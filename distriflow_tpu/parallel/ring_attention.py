@@ -103,9 +103,13 @@ def blockwise_attention(
         raise ValueError(f"sequence {s} not divisible by block {block}")
     n_blocks = s // block
 
-    m = jnp.full((b, h, s), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, s), jnp.float32)
-    o = jnp.zeros((b, h, s, d), jnp.float32)
+    # derive the accumulators from q (not fresh zeros) so they inherit q's
+    # varying-mesh-axes type: fresh literals would mismatch the scan carry
+    # when this runs inside a shard_map body (e.g. the FedAvg local loop)
+    zero = jnp.zeros_like(q, jnp.float32)
+    m = zero[..., 0] + NEG_INF
+    l = zero[..., 0]
+    o = zero
 
     def body(i, carry):
         m, l, o = carry

@@ -1,0 +1,58 @@
+"""JSONL metrics logging: trainer integration, coercion, torn-tail reads."""
+
+import jax
+import numpy as np
+
+from distriflow_tpu.models import mnist_mlp
+from distriflow_tpu.parallel import data_parallel_mesh
+from distriflow_tpu.train.sync import SyncTrainer
+from distriflow_tpu.utils.metrics_log import MetricsLogger, read_metrics
+
+
+def test_logger_roundtrip_and_coercion(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path, stamp_time=False) as log:
+        log.log(step=1, loss=np.float32(0.5), skipped=None, arr=jax.numpy.ones(()))
+        log.log(step=2, loss=0.25)
+    rows = list(read_metrics(path))
+    assert rows == [{"step": 1, "loss": 0.5, "arr": 1.0}, {"step": 2, "loss": 0.25}]
+
+
+def test_torn_tail_skipped(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path, stamp_time=False) as log:
+        log.log(step=1)
+    with open(path, "a") as f:
+        f.write('{"step": 2, "lo')  # crash mid-append
+    assert list(read_metrics(path)) == [{"step": 1}]
+
+
+def test_trainer_step_callback_logs(tmp_path, devices):
+    path = str(tmp_path / "train.jsonl")
+    mesh = data_parallel_mesh(devices)
+    t = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, learning_rate=0.1)
+    t.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+    with MetricsLogger(path) as log:
+        t.callbacks.register(
+            "step", lambda tr: log.log(step=tr.version, step_ms=tr.last_step_ms))
+        for _ in range(3):
+            t.step((x, y))
+    rows = list(read_metrics(path))
+    assert [r["step"] for r in rows] == [1, 2, 3]
+    assert all("time" in r and r["step_ms"] > 0 for r in rows)
+
+
+def test_restart_after_torn_tail_keeps_new_rows(tmp_path):
+    """Reopening after a crash must terminate the torn line so post-restart
+    rows survive (only the torn row itself is lost)."""
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path, stamp_time=False) as log:
+        log.log(step=1)
+    with open(path, "a") as f:
+        f.write('{"step": 2, "lo')  # crash mid-append
+    with MetricsLogger(path, stamp_time=False) as log:
+        log.log(step=3)
+    assert list(read_metrics(path)) == [{"step": 1}, {"step": 3}]
