@@ -25,7 +25,7 @@ import time
 
 GLOBAL_BATCH = 1024
 WARMUP_STEPS = 5
-MEASURE_STEPS = 100  # steps per device-side scan chunk
+MEASURE_STEPS = 250  # steps per device-side scan chunk
 CHUNK_ROUNDS = 10    # pipelined chunk dispatches in the timed region
 HIDDEN = 10  # reference parity arch: flatten -> dense(10, relu) -> dense(10)
 

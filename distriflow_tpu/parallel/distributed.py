@@ -22,26 +22,34 @@ def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    auto_pod: bool = False,
 ) -> None:
     """Initialize the multi-host runtime (idempotent; no-op single-host).
 
-    With no arguments, relies on TPU pod auto-detection (metadata-based), the
-    JAX analog of the reference client's connect-and-await-Download handshake.
+    ``auto_pod=True`` calls ``jax.distributed.initialize()`` with no
+    arguments — TPU pod metadata auto-detection, the JAX analog of the
+    reference client's connect-and-await-Download handshake. It is explicit
+    (not the no-arg default) because on a single laptop/CI host the no-arg
+    jax call would fail looking for pod metadata; plain ``initialize()``
+    stays a safe no-op there.
     """
     global _initialized
     if _initialized:
         return
     if coordinator_address is None and "COORDINATOR_ADDRESS" in os.environ:
         coordinator_address = os.environ["COORDINATOR_ADDRESS"]
-    if coordinator_address is None and num_processes is None:
-        # single-process (or auto-detected pod) — nothing to wire up here
+    if coordinator_address is None and num_processes is None and not auto_pod:
+        # single-process — nothing to wire up
         _initialized = True
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    if auto_pod and coordinator_address is None and num_processes is None:
+        jax.distributed.initialize()
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
     _initialized = True
 
 
