@@ -444,9 +444,10 @@ def pipelined_transformer_lm(
     if config.remat:
         import warnings
 
-        # jax.checkpoint residuals cannot cross gpipe's hybrid manual/auto
-        # shard_map boundary when they carry auto-sharded (model/seq/expert)
-        # axes — remat inside pipeline stages is unsupported
+        # jax.checkpoint residuals (the auto-sharded stage params) become
+        # shard_map AD outputs needing specs over auto axes — unsupported
+        # through gpipe's hybrid manual/auto shard_map, even when the
+        # checkpoint is applied inside the body
         warnings.warn(
             "remat=True is ignored by pipelined_transformer_lm (checkpoint "
             "residuals cannot cross the pipeline's hybrid shard_map); use "
