@@ -18,6 +18,7 @@ from distriflow_tpu.models.losses import (
     softmax_cross_entropy,
 )
 from distriflow_tpu.models.generate import generate
+from distriflow_tpu.models.keras_import import spec_from_keras_json
 from distriflow_tpu.models.mobilenet import MobileNetV2, mobilenet_v2
 from distriflow_tpu.models.zoo import MLP, ConvNet, cifar_convnet, mnist_convnet, mnist_mlp
 
@@ -44,4 +45,5 @@ __all__ = [
     "mnist_convnet",
     "mnist_mlp",
     "generate",
+    "spec_from_keras_json",
 ]

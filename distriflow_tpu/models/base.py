@@ -291,8 +291,10 @@ def fetch_model(source: ModelSource, **kw: Any) -> DistributedModel:
     Parity with reference ``fetchModel`` (``src/common/utils.ts:236-244``),
     which accepts a string URL, a model instance, or an async factory. Here:
     a ModelSpec, an existing DistributedModel, a zero-arg factory returning a
-    ModelSpec, or a checkpoint-directory path string (loaded via
-    ``distriflow_tpu.checkpoint``).
+    ModelSpec, a tfjs-layers/Keras ``model.json`` path (the reference's
+    ``tf.loadLayersModel`` equivalent, via
+    :func:`distriflow_tpu.models.keras_import.spec_from_keras_json`), or a
+    checkpoint-directory path string (loaded via ``distriflow_tpu.checkpoint``).
     """
     if isinstance(source, DistributedModel):
         return source
@@ -304,6 +306,15 @@ def fetch_model(source: ModelSource, **kw: Any) -> DistributedModel:
             raise TypeError(f"model factory must return a ModelSpec, got {type(spec)}")
         return SpecModel(spec, **kw)
     if isinstance(source, str):
+        if source.endswith(".json"):
+            from distriflow_tpu.models.keras_import import spec_from_keras_json
+
+            spec_kw = {
+                k: kw.pop(k)
+                for k in ("input_shape", "loss", "logits_output", "load_weights", "dtype")
+                if k in kw
+            }
+            return SpecModel(spec_from_keras_json(source, **spec_kw), **kw)
         from distriflow_tpu.checkpoint import load_model  # lazy: layer dependency
 
         return load_model(source, **kw)

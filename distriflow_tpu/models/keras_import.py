@@ -1,0 +1,552 @@
+"""tfjs-layers / Keras ``model.json`` importer.
+
+The reference loads models from a string URL via ``tf.loadLayersModel``
+(``fetchModel``, ``src/common/utils.ts:236-244``) and ships its ConvNet as a
+tfjs-layers-format ``model.json`` (``experiment/mnist/model.json``). This
+module gives a reference user a direct on-ramp: parse that exact format —
+``{"modelTopology": {"model_config": ...}, "weightsManifest": [...]}`` or a
+bare Keras ``model_config`` — into a :class:`ModelSpec` whose forward pass is
+a pure JAX function, with optional weight loading from the binary shard files
+next to the JSON.
+
+Supported layers (the tfjs-layers subset the reference ecosystem actually
+uses): Conv2D, DepthwiseConv2D, Dense, Activation, MaxPooling2D,
+AveragePooling2D, GlobalAveragePooling2D, Flatten, Reshape, Dropout,
+BatchNormalization. Sequential topologies only — a graph-form
+``class_name: "Model"/"Functional"`` raises with a clear message.
+
+Semantics notes (deliberate, documented divergences):
+
+- **Dropout is identity.** The reference's ``fit`` computes gradients through
+  ``predictOnBatch`` (``src/common/models.ts:139``), which runs tfjs layers in
+  inference mode — dropout never fires in its training path either, so
+  identity IS parity.
+- **A trailing softmax is stripped by default** (``logits_output=True``) and
+  recorded so the spec's default ``softmax_cross_entropy`` loss sees logits —
+  the numerically-correct TPU formulation. ``predict_proba``-style behavior is
+  available with ``logits_output=False``.
+- **BatchNormalization uses the stored moving statistics** (inference form),
+  matching the same ``predictOnBatch`` training path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distriflow_tpu.models.base import ModelSpec
+
+Params = Dict[str, Dict[str, jnp.ndarray]]
+LayerFn = Callable[[Params, jnp.ndarray], jnp.ndarray]
+
+_ACTIVATIONS: Dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "softmax": jax.nn.softmax,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "softplus": jax.nn.softplus,
+    "gelu": jax.nn.gelu,
+}
+
+_DTYPES = {"float32": np.float32, "int32": np.int32, "bool": np.bool_, "uint8": np.uint8}
+
+
+def _activation(name: Optional[str]) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    name = name or "linear"
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"unsupported activation {name!r}; known: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[name]
+
+
+def _initializer(cfg: Optional[Dict[str, Any]]) -> Callable[..., jnp.ndarray]:
+    """Map a Keras initializer config to a jax.nn.initializers callable."""
+    init = jax.nn.initializers
+    if not cfg:
+        return init.zeros
+    cls = cfg.get("class_name", "Zeros")
+    c = cfg.get("config", {})
+    if cls in ("Zeros", "zeros"):
+        return init.zeros
+    if cls in ("Ones", "ones"):
+        return init.ones
+    if cls == "Constant":
+        value = c.get("value", 0.0)
+        return lambda key, shape, dtype=jnp.float32: jnp.full(shape, value, dtype)
+    if cls == "VarianceScaling":
+        return init.variance_scaling(
+            scale=c.get("scale", 1.0),
+            mode={"fan_in": "fan_in", "fan_out": "fan_out", "fan_avg": "fan_avg"}[
+                c.get("mode", "fan_avg")
+            ],
+            distribution={
+                "uniform": "uniform",
+                "normal": "truncated_normal",
+                "truncated_normal": "truncated_normal",
+                "untruncated_normal": "normal",
+            }[c.get("distribution", "uniform")],
+        )
+    if cls == "GlorotUniform":
+        return init.glorot_uniform()
+    if cls == "GlorotNormal":
+        return init.glorot_normal()
+    if cls == "HeUniform":
+        return init.he_uniform()
+    if cls == "HeNormal":
+        return init.he_normal()
+    if cls == "RandomUniform":
+        lo, hi = c.get("minval", -0.05), c.get("maxval", 0.05)
+        return lambda key, shape, dtype=jnp.float32: jax.random.uniform(
+            key, shape, dtype, lo, hi
+        )
+    if cls == "RandomNormal":
+        mean, std = c.get("mean", 0.0), c.get("stddev", 0.05)
+        return lambda key, shape, dtype=jnp.float32: (
+            mean + std * jax.random.normal(key, shape, dtype)
+        )
+    raise ValueError(f"unsupported initializer {cls!r}")
+
+
+def _pool_padding(cfg: Dict[str, Any]) -> str:
+    return {"valid": "VALID", "same": "SAME"}[cfg.get("padding", "valid")]
+
+
+class _Builder:
+    """Walks a Sequential layer list, producing per-layer param initializers
+    and a composed pure forward function.
+
+    Shape tracking is symbolic over the (batch-free) feature shape so we can
+    report ``output_shape`` and validate Flatten/Dense fan-ins at parse time.
+    """
+
+    def __init__(self, dtype: Any = jnp.float32):
+        self.dtype = dtype
+        self.inits: Dict[str, Dict[str, Tuple[Tuple[int, ...], Callable]]] = {}
+        self.fns: List[LayerFn] = []
+        self.names: List[str] = []  # resolved layer name per fn (1:1 with fns)
+        self.shape: Optional[Tuple[int, ...]] = None  # feature shape, no batch
+
+    # -- helpers -----------------------------------------------------------
+
+    def _need_shape(self, layer: str) -> Tuple[int, ...]:
+        if self.shape is None:
+            raise ValueError(
+                f"layer {layer!r} needs a known input shape; the first layer "
+                "must carry batch_input_shape (tfjs always exports it) or "
+                "pass input_shape= to spec_from_keras_json"
+            )
+        return self.shape
+
+    def _register(self, name: str, weights: Dict[str, Tuple[Tuple[int, ...], Callable]]):
+        if name in self.inits:
+            raise ValueError(f"duplicate layer name {name!r}")
+        self.inits[name] = weights
+
+    # -- layer lowerings ---------------------------------------------------
+
+    def add(self, class_name: str, cfg: Dict[str, Any]) -> None:
+        name = cfg.get("name", f"{class_name.lower()}_{len(self.fns)}")
+        if self.shape is None and "batch_input_shape" in cfg:
+            self.shape = tuple(int(d) for d in cfg["batch_input_shape"][1:])
+        handler = getattr(self, f"_add_{class_name}", None)
+        if handler is None:
+            raise ValueError(
+                f"unsupported layer {class_name!r}; supported: Conv2D, "
+                "DepthwiseConv2D, Dense, Activation, MaxPooling2D, "
+                "AveragePooling2D, GlobalAveragePooling2D, Flatten, Reshape, "
+                "Dropout, BatchNormalization"
+            )
+        handler(name, cfg)
+        self.names.append(name)  # every handler appends exactly one fn
+        assert len(self.names) == len(self.fns)
+
+    def _add_Conv2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        h, w, cin = self._need_shape(name)
+        kh, kw = cfg["kernel_size"]
+        filters = int(cfg["filters"])
+        strides = tuple(int(s) for s in cfg.get("strides", (1, 1)))
+        dilation = tuple(int(d) for d in cfg.get("dilation_rate", (1, 1)))
+        padding = _pool_padding(cfg)
+        use_bias = cfg.get("use_bias", True)
+        act = _activation(cfg.get("activation"))
+        weights = {"kernel": ((kh, kw, cin, filters), _initializer(cfg.get("kernel_initializer")))}
+        if use_bias:
+            weights["bias"] = ((filters,), _initializer(cfg.get("bias_initializer")))
+        self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, strides=strides,
+               padding=padding, dilation=dilation, use_bias=use_bias, act=act):
+            p = params[name]
+            y = jax.lax.conv_general_dilated(
+                x, p["kernel"].astype(x.dtype), strides, padding,
+                rhs_dilation=dilation,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            if use_bias:
+                y = y + p["bias"].astype(y.dtype)
+            return act(y)
+
+        self.fns.append(fn)
+        out = jax.eval_shape(
+            lambda r, k: jax.lax.conv_general_dilated(
+                r, k, strides, padding, rhs_dilation=dilation,
+                dimension_numbers=("NHWC", "HWIO", "NHWC")),
+            jax.ShapeDtypeStruct((1, h, w, cin), jnp.float32),
+            jax.ShapeDtypeStruct((kh, kw, cin, filters), jnp.float32))
+        self.shape = tuple(out.shape[1:])
+
+    def _add_DepthwiseConv2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        h, w, cin = self._need_shape(name)
+        kh, kw = cfg["kernel_size"]
+        mult = int(cfg.get("depth_multiplier", 1))
+        strides = tuple(int(s) for s in cfg.get("strides", (1, 1)))
+        dilation = tuple(int(d) for d in cfg.get("dilation_rate", (1, 1)))
+        padding = _pool_padding(cfg)
+        use_bias = cfg.get("use_bias", True)
+        act = _activation(cfg.get("activation"))
+        weights = {
+            "depthwise_kernel": (
+                (kh, kw, cin, mult),
+                _initializer(cfg.get("depthwise_initializer") or cfg.get("kernel_initializer")),
+            )
+        }
+        if use_bias:
+            weights["bias"] = ((cin * mult,), _initializer(cfg.get("bias_initializer")))
+        self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, strides=strides,
+               padding=padding, dilation=dilation, cin=cin, mult=mult,
+               use_bias=use_bias, act=act):
+            p = params[name]
+            # HWIO with feature_group_count=cin: kernel (kh, kw, 1, cin*mult)
+            k = p["depthwise_kernel"].astype(x.dtype)
+            k = k.transpose(0, 1, 3, 2).reshape(k.shape[0], k.shape[1], 1, cin * mult)
+            y = jax.lax.conv_general_dilated(
+                x, k, strides, padding, rhs_dilation=dilation,
+                feature_group_count=cin,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            if use_bias:
+                y = y + p["bias"].astype(y.dtype)
+            return act(y)
+
+        self.fns.append(fn)
+        ek_h = (kh - 1) * dilation[0] + 1  # dilated effective kernel extent
+        ek_w = (kw - 1) * dilation[1] + 1
+        oh = _conv_dim(h, ek_h, strides[0], padding)
+        ow = _conv_dim(w, ek_w, strides[1], padding)
+        self.shape = (oh, ow, cin * mult)
+
+    def _add_Dense(self, name: str, cfg: Dict[str, Any]) -> None:
+        shape = self._need_shape(name)
+        if len(shape) != 1:
+            raise ValueError(
+                f"Dense layer {name!r} expects flat input, got feature shape "
+                f"{shape}; insert a Flatten layer first (Keras would too)"
+            )
+        (fan_in,) = shape
+        units = int(cfg["units"])
+        use_bias = cfg.get("use_bias", True)
+        act = _activation(cfg.get("activation"))
+        weights = {"kernel": ((fan_in, units), _initializer(cfg.get("kernel_initializer")))}
+        if use_bias:
+            weights["bias"] = ((units,), _initializer(cfg.get("bias_initializer")))
+        self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, use_bias=use_bias, act=act):
+            p = params[name]
+            y = x @ p["kernel"].astype(x.dtype)
+            if use_bias:
+                y = y + p["bias"].astype(y.dtype)
+            return act(y)
+
+        self.fns.append(fn)
+        self.shape = (units,)
+
+    def _add_Activation(self, name: str, cfg: Dict[str, Any]) -> None:
+        act = _activation(cfg.get("activation"))
+        self.fns.append(lambda params, x, act=act: act(x))
+
+    def _pool(self, name: str, cfg: Dict[str, Any], reducer: str) -> None:
+        h, w, c = self._need_shape(name)
+        ph, pw = (int(d) for d in cfg.get("pool_size", (2, 2)))
+        strides = cfg.get("strides") or (ph, pw)
+        sh, sw = (int(s) for s in strides)
+        padding = _pool_padding(cfg)
+
+        def fn(params: Params, x: jnp.ndarray, ph=ph, pw=pw, sh=sh, sw=sw,
+               padding=padding, reducer=reducer):
+            window = (1, ph, pw, 1)
+            strides_ = (1, sh, sw, 1)
+            if reducer == "max":
+                return jax.lax.reduce_window(
+                    x, -jnp.inf, jax.lax.max, window, strides_, padding)
+            summed = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, window, strides_, padding)
+            if padding == "VALID":
+                return summed / (ph * pw)
+            counts = jax.lax.reduce_window(
+                jnp.ones_like(x), 0.0, jax.lax.add, window, strides_, padding)
+            return summed / counts
+
+        self.fns.append(fn)
+        self.shape = (_conv_dim(h, ph, sh, padding), _conv_dim(w, pw, sw, padding), c)
+
+    def _add_MaxPooling2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        self._pool(name, cfg, "max")
+
+    def _add_AveragePooling2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        self._pool(name, cfg, "avg")
+
+    def _add_GlobalAveragePooling2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        _, _, c = self._need_shape(name)
+        self.fns.append(lambda params, x: jnp.mean(x, axis=(1, 2)))
+        self.shape = (c,)
+
+    def _add_Flatten(self, name: str, cfg: Dict[str, Any]) -> None:
+        shape = self._need_shape(name)
+        self.fns.append(lambda params, x: x.reshape((x.shape[0], -1)))
+        self.shape = (int(np.prod(shape)),)
+
+    def _add_Reshape(self, name: str, cfg: Dict[str, Any]) -> None:
+        target = tuple(int(d) for d in cfg["target_shape"])
+        self.fns.append(lambda params, x, target=target: x.reshape((x.shape[0],) + target))
+        self.shape = target
+
+    def _add_Dropout(self, name: str, cfg: Dict[str, Any]) -> None:
+        # identity: the reference's fit path runs layers in inference mode
+        # (predictOnBatch, src/common/models.ts:139) — see module docstring
+        self.fns.append(lambda params, x: x)
+
+    def _add_BatchNormalization(self, name: str, cfg: Dict[str, Any]) -> None:
+        shape = self._need_shape(name)
+        c = shape[-1]
+        eps = float(cfg.get("epsilon", 1e-3))
+        scale = cfg.get("scale", True)
+        center = cfg.get("center", True)
+        weights = {
+            "moving_mean": ((c,), _initializer({"class_name": "Zeros"})),
+            "moving_variance": ((c,), _initializer({"class_name": "Ones"})),
+        }
+        if scale:
+            weights["gamma"] = ((c,), _initializer(cfg.get("gamma_initializer") or {"class_name": "Ones"}))
+        if center:
+            weights["beta"] = ((c,), _initializer(cfg.get("beta_initializer") or {"class_name": "Zeros"}))
+        self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, eps=eps, scale=scale, center=center):
+            p = params[name]
+            inv = jax.lax.rsqrt(p["moving_variance"].astype(x.dtype) + eps)
+            y = (x - p["moving_mean"].astype(x.dtype)) * inv
+            if scale:
+                y = y * p["gamma"].astype(x.dtype)
+            if center:
+                y = y + p["beta"].astype(x.dtype)
+            return y
+
+        self.fns.append(fn)
+
+
+def _conv_dim(size: int, k: int, stride: int, padding: str) -> int:
+    if padding == "SAME":
+        return -(-size // stride)
+    return (size - k) // stride + 1
+
+
+def _layer_list(topology: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Extract the Sequential layer list from any of the json shapes tfjs or
+    Keras emit: tfjs wraps under ``modelTopology``; the Sequential config is a
+    bare list (Keras ≤2.2, the reference's format) or ``{"layers": [...]}``."""
+    mt = topology.get("modelTopology", topology)
+    mc = mt.get("model_config", mt)
+    cls = mc.get("class_name")
+    if cls is None and "layers" in mc:
+        return mc["layers"]
+    if cls != "Sequential":
+        raise ValueError(
+            f"only Sequential topologies are supported, got class_name={cls!r} "
+            "(graph-form Functional models: build the module in flax and use "
+            "spec_from_flax)"
+        )
+    cfg = mc["config"]
+    return cfg if isinstance(cfg, list) else cfg["layers"]
+
+
+def load_keras_weights(model_json_path: str, manifest: List[Dict[str, Any]]) -> Params:
+    """Read a tfjs ``weightsManifest`` — binary shard files sit next to
+    model.json; each group's shards concatenate into one little-endian buffer
+    carrying the group's weights back to back."""
+    base = os.path.dirname(os.path.abspath(model_json_path))
+    params: Params = {}
+    for group in manifest:
+        buf = b"".join(
+            open(os.path.join(base, p), "rb").read() for p in group["paths"]
+        )
+        offset = 0
+        for w in group["weights"]:
+            if "quantization" in w:
+                raise ValueError(
+                    f"weight {w['name']!r} is quantized (tfjs --quantize_* "
+                    "export); quantized manifests are not supported — "
+                    "re-export without quantization"
+                )
+            dtype = _DTYPES[w.get("dtype", "float32")]
+            shape = tuple(int(d) for d in w["shape"])
+            count = int(np.prod(shape)) if shape else 1
+            arr = np.frombuffer(buf, dtype=dtype, count=count, offset=offset)
+            offset += arr.nbytes
+            layer, _, wname = w["name"].rpartition("/")
+            params.setdefault(layer, {})[wname] = jnp.asarray(arr.reshape(shape))
+        if offset != len(buf):
+            raise ValueError(
+                f"weight group {group['paths']}: manifest describes {offset} "
+                f"bytes but shards hold {len(buf)}"
+            )
+    return params
+
+
+def spec_from_keras_json(
+    path: str,
+    input_shape: Optional[Sequence[int]] = None,
+    loss: str = "softmax_cross_entropy",
+    logits_output: bool = True,
+    load_weights: bool = True,
+    dtype: Any = jnp.float32,
+) -> ModelSpec:
+    """Parse a tfjs-layers / Keras ``model.json`` into a :class:`ModelSpec`.
+
+    Parity with ``tf.loadLayersModel`` in the reference's ``fetchModel``
+    (``src/common/utils.ts:236-244``). If the file carries a
+    ``weightsManifest`` and the shard files exist next to it (and
+    ``load_weights``), ``init`` returns the trained weights; otherwise it
+    initializes from each layer's recorded Keras initializer.
+
+    ``logits_output=True`` strips ONE trailing softmax (whether a Dense
+    activation or a separate Activation layer) so the default
+    ``softmax_cross_entropy`` loss composes correctly; the stripped softmax
+    is noted in the spec name.
+    """
+    with open(path) as f:
+        topology = json.load(f)
+    layers = _layer_list(topology)
+    builder = _Builder(dtype=dtype)
+    if input_shape is not None:
+        builder.shape = tuple(int(d) for d in input_shape)
+    for layer in layers:
+        builder.add(layer["class_name"], dict(layer.get("config", {})))
+    if builder.shape is None:
+        raise ValueError("could not infer model shapes: no batch_input_shape anywhere")
+
+    in_shape = tuple(
+        int(d) for d in (input_shape if input_shape is not None
+                         else _input_shape_from(layers))
+    )
+    fns = list(builder.fns)
+    stripped = False
+    if logits_output and fns:
+        stripped = _strip_trailing_softmax(layers, fns, builder.names)
+
+    inits = builder.inits
+    loaded: Optional[Params] = None
+    manifest = topology.get("weightsManifest")
+    if load_weights and manifest:
+        try:
+            loaded = load_keras_weights(path, manifest)
+        except FileNotFoundError:
+            loaded = None  # topology-only json (shards not exported): cold init
+    if loaded is not None:
+        _check_loaded(loaded, inits)
+
+    def init(rng: jax.Array) -> Params:
+        if loaded is not None:
+            return jax.tree.map(lambda a: a.astype(dtype), loaded)
+        params: Params = {}
+        keys = jax.random.split(rng, max(1, len(inits)))
+        for key, (lname, weights) in zip(keys, sorted(inits.items())):
+            subkeys = jax.random.split(key, max(1, len(weights)))
+            params[lname] = {
+                wname: initf(k, shape, dtype)
+                for k, (wname, (shape, initf)) in zip(subkeys, sorted(weights.items()))
+            }
+        return params
+
+    def apply(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        y = x.astype(dtype)
+        for fn in fns:
+            y = fn(params, y)
+        return y
+
+    name = os.path.splitext(os.path.basename(path))[0]
+    return ModelSpec(
+        init=init,
+        apply=apply,
+        loss=loss,
+        input_shape=in_shape,
+        output_shape=tuple(builder.shape),
+        name=f"keras:{name}" + (":logits" if stripped else ""),
+    )
+
+
+def _input_shape_from(layers: List[Dict[str, Any]]) -> Tuple[int, ...]:
+    for layer in layers:
+        cfg = layer.get("config", {})
+        if "batch_input_shape" in cfg:
+            return tuple(int(d) for d in cfg["batch_input_shape"][1:])
+    raise ValueError("no batch_input_shape found; pass input_shape=")
+
+
+def _strip_trailing_softmax(
+    layers: List[Dict[str, Any]], fns: List[LayerFn], names: List[str]
+) -> bool:
+    """If the network ends in softmax, replace that final activation with
+    identity (in-place on ``fns``). Returns True if stripped."""
+    last = layers[-1]
+    cfg = last.get("config", {})
+    if last["class_name"] == "Activation" and cfg.get("activation") == "softmax":
+        fns[-1] = lambda params, x: x
+        return True
+    if last["class_name"] == "Dense" and cfg.get("activation") == "softmax":
+        # rebuild the final Dense minus its activation (we need the
+        # *pre*-softmax values); params live under the builder-resolved
+        # name (which may be a generated fallback, so don't re-derive it
+        # from cfg here)
+        name = names[-1]
+        use_bias = cfg.get("use_bias", True)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, use_bias=use_bias):
+            p = params[name]
+            y = x @ p["kernel"].astype(x.dtype)
+            if use_bias:
+                y = y + p["bias"].astype(y.dtype)
+            return y
+
+        fns[-1] = fn
+        return True
+    return False
+
+
+def _check_loaded(loaded: Params, inits: Dict[str, Any]) -> None:
+    missing = [
+        f"{l}/{w}" for l, ws in inits.items() for w in ws
+        if w not in loaded.get(l, {})
+    ]
+    if missing:
+        raise ValueError(
+            f"weightsManifest is missing parameters the topology declares: "
+            f"{missing[:8]}{'...' if len(missing) > 8 else ''}"
+        )
+    for lname, ws in inits.items():
+        for wname, (shape, _) in ws.items():
+            got = tuple(loaded[lname][wname].shape)
+            if got != tuple(shape):
+                raise ValueError(
+                    f"{lname}/{wname}: manifest shape {got} != topology shape {tuple(shape)}"
+                )
