@@ -10,10 +10,14 @@ a pure JAX function, with optional weight loading from the binary shard files
 next to the JSON.
 
 Supported layers (the tfjs-layers subset the reference ecosystem actually
-uses): Conv2D, DepthwiseConv2D, Dense, Activation, MaxPooling2D,
-AveragePooling2D, GlobalAveragePooling2D, Flatten, Reshape, Dropout,
-BatchNormalization. Sequential topologies only — a graph-form
-``class_name: "Model"/"Functional"`` raises with a clear message.
+uses): Conv2D, DepthwiseConv2D, Dense, Activation, ReLU, MaxPooling2D,
+AveragePooling2D, GlobalAveragePooling2D, Flatten, Reshape, ZeroPadding2D,
+Dropout, BatchNormalization, InputLayer; plus the merge layers Add,
+Subtract, Multiply, Average, Maximum, Minimum, Concatenate in graph-form
+models.
+Both ``Sequential`` and single-input/single-output ``Model``/``Functional``
+(DAG) topologies load; shared layers (a layer called at multiple graph
+nodes) raise with a clear message.
 
 Semantics notes (deliberate, documented divergences):
 
@@ -160,9 +164,11 @@ class _Builder:
         if handler is None:
             raise ValueError(
                 f"unsupported layer {class_name!r}; supported: Conv2D, "
-                "DepthwiseConv2D, Dense, Activation, MaxPooling2D, "
+                "DepthwiseConv2D, Dense, Activation, ReLU, MaxPooling2D, "
                 "AveragePooling2D, GlobalAveragePooling2D, Flatten, Reshape, "
-                "Dropout, BatchNormalization"
+                "ZeroPadding2D, Dropout, BatchNormalization, InputLayer "
+                "(+ Add/Subtract/Multiply/Average/Maximum/Minimum/"
+                "Concatenate in Functional graphs)"
             )
         handler(name, cfg)
         self.names.append(name)  # every handler appends exactly one fn
@@ -226,9 +232,12 @@ class _Builder:
                padding=padding, dilation=dilation, cin=cin, mult=mult,
                use_bias=use_bias, act=act):
             p = params[name]
-            # HWIO with feature_group_count=cin: kernel (kh, kw, 1, cin*mult)
+            # HWIO with feature_group_count=cin: kernel (kh, kw, 1, cin*mult).
+            # TF's output-channel order is channel-major (c*mult + m), which
+            # is exactly the C-order flatten of the trailing (cin, mult) dims
+            # — a plain reshape, NO transpose
             k = p["depthwise_kernel"].astype(x.dtype)
-            k = k.transpose(0, 1, 3, 2).reshape(k.shape[0], k.shape[1], 1, cin * mult)
+            k = k.reshape(k.shape[0], k.shape[1], 1, cin * mult)
             y = jax.lax.conv_general_dilated(
                 x, k, strides, padding, rhs_dilation=dilation,
                 feature_group_count=cin,
@@ -260,20 +269,46 @@ class _Builder:
         if use_bias:
             weights["bias"] = ((units,), _initializer(cfg.get("bias_initializer")))
         self._register(name, weights)
-
-        def fn(params: Params, x: jnp.ndarray, name=name, use_bias=use_bias, act=act):
-            p = params[name]
-            y = x @ p["kernel"].astype(x.dtype)
-            if use_bias:
-                y = y + p["bias"].astype(y.dtype)
-            return act(y)
-
-        self.fns.append(fn)
+        self.fns.append(_dense_fn(name, use_bias, act))
         self.shape = (units,)
+
+    def _add_InputLayer(self, name: str, cfg: Dict[str, Any]) -> None:
+        # identity; exists only to carry batch_input_shape (consumed in add())
+        self.fns.append(lambda params, x: x)
 
     def _add_Activation(self, name: str, cfg: Dict[str, Any]) -> None:
         act = _activation(cfg.get("activation"))
         self.fns.append(lambda params, x, act=act: act(x))
+
+    def _add_ReLU(self, name: str, cfg: Dict[str, Any]) -> None:
+        max_value = cfg.get("max_value")
+        slope = float(cfg.get("negative_slope") or 0.0)
+        threshold = float(cfg.get("threshold") or 0.0)
+
+        def fn(params: Params, x: jnp.ndarray, max_value=max_value,
+               slope=slope, threshold=threshold):
+            y = jnp.where(x >= threshold, x, slope * (x - threshold))
+            if max_value is not None:
+                y = jnp.minimum(y, max_value)
+            return y
+
+        self.fns.append(fn)
+
+    def _add_ZeroPadding2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        h, w, c = self._need_shape(name)
+        pad = cfg.get("padding", 1)
+        if isinstance(pad, int):
+            pad = ((pad, pad), (pad, pad))
+        elif isinstance(pad[0], int):
+            pad = ((pad[0], pad[0]), (pad[1], pad[1]))
+        (pt, pb), (pl, pr) = ((int(a), int(b)) for a, b in pad)
+
+        def fn(params: Params, x: jnp.ndarray, pads=(pt, pb, pl, pr)):
+            t, b, l, r = pads
+            return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+
+        self.fns.append(fn)
+        self.shape = (h + pt + pb, w + pl + pr, c)
 
     def _pool(self, name: str, cfg: Dict[str, Any], reducer: str) -> None:
         h, w, c = self._need_shape(name)
@@ -355,29 +390,215 @@ class _Builder:
         self.fns.append(fn)
 
 
+def _dense_fn(
+    name: str,
+    use_bias: bool,
+    act: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
+) -> LayerFn:
+    """The one Dense lowering, shared by the layer handler and both
+    softmax-strip rewrites (which need the same matmul minus activation)."""
+
+    def fn(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        p = params[name]
+        y = x @ p["kernel"].astype(x.dtype)
+        if use_bias:
+            y = y + p["bias"].astype(y.dtype)
+        return act(y)
+
+    return fn
+
+
 def _conv_dim(size: int, k: int, stride: int, padding: str) -> int:
     if padding == "SAME":
         return -(-size // stride)
     return (size - k) // stride + 1
 
 
-def _layer_list(topology: Dict[str, Any]) -> List[Dict[str, Any]]:
-    """Extract the Sequential layer list from any of the json shapes tfjs or
-    Keras emit: tfjs wraps under ``modelTopology``; the Sequential config is a
-    bare list (Keras ≤2.2, the reference's format) or ``{"layers": [...]}``."""
+def _model_config(topology: Dict[str, Any]) -> Tuple[str, Any]:
+    """Classify the json into ('Sequential', layer_list) or
+    ('Functional', graph_config), across the shapes tfjs and Keras emit:
+    tfjs wraps under ``modelTopology``; a Sequential config is a bare list
+    (Keras ≤2.2, the reference's format) or ``{"layers": [...]}``; graph
+    models are ``class_name: "Model"`` (Keras 2) / ``"Functional"``."""
     mt = topology.get("modelTopology", topology)
     mc = mt.get("model_config", mt)
     cls = mc.get("class_name")
     if cls is None and "layers" in mc:
-        return mc["layers"]
-    if cls != "Sequential":
+        return "Sequential", mc["layers"]
+    if cls == "Sequential":
+        cfg = mc["config"]
+        return "Sequential", (cfg if isinstance(cfg, list) else cfg["layers"])
+    if cls in ("Model", "Functional"):
+        return "Functional", mc["config"]
+    raise ValueError(
+        f"unsupported model_config class_name={cls!r} (expected Sequential, "
+        "Model, or Functional)"
+    )
+
+
+# -- graph (Functional) topologies ----------------------------------------
+
+_MERGE_LAYERS = ("Add", "Subtract", "Multiply", "Average", "Maximum",
+                 "Minimum", "Concatenate")
+
+
+def _merge_lowering(
+    class_name: str, cfg: Dict[str, Any], in_shapes: List[Tuple[int, ...]]
+) -> Tuple[Callable[[Params, List[jnp.ndarray]], jnp.ndarray], Tuple[int, ...]]:
+    """Lower a parameterless merge layer: (fn(params, xs) -> y, out_shape)."""
+    if class_name == "Concatenate":
+        full_rank = len(in_shapes[0]) + 1  # + batch dim
+        axis = int(cfg.get("axis", -1)) % full_rank
+        if axis == 0:
+            raise ValueError("Concatenate over the batch axis is not supported")
+        fi = axis - 1  # feature-shape index
+        base = list(in_shapes[0])
+        for s in in_shapes[1:]:
+            if len(s) != len(base) or any(
+                a != b for i, (a, b) in enumerate(zip(s, base)) if i != fi
+            ):
+                raise ValueError(
+                    f"Concatenate inputs disagree off-axis: {in_shapes}"
+                )
+        base[fi] = sum(s[fi] for s in in_shapes)
+        return (lambda params, xs, axis=axis: jnp.concatenate(xs, axis=axis),
+                tuple(base))
+    if any(s != in_shapes[0] for s in in_shapes[1:]):
+        raise ValueError(f"{class_name} inputs must agree in shape: {in_shapes}")
+    if class_name == "Subtract":
+        if len(in_shapes) != 2:
+            raise ValueError("Subtract takes exactly two inputs")
+        fn = lambda params, xs: xs[0] - xs[1]  # noqa: E731
+    elif class_name == "Add":
+        fn = lambda params, xs: sum(xs[1:], xs[0])  # noqa: E731
+    elif class_name == "Multiply":
+        def fn(params, xs):
+            y = xs[0]
+            for x in xs[1:]:
+                y = y * x
+            return y
+    elif class_name == "Average":
+        fn = lambda params, xs: sum(xs[1:], xs[0]) / len(xs)  # noqa: E731
+    elif class_name == "Maximum":
+        def fn(params, xs):
+            y = xs[0]
+            for x in xs[1:]:
+                y = jnp.maximum(y, x)
+            return y
+    else:  # Minimum
+        def fn(params, xs):
+            y = xs[0]
+            for x in xs[1:]:
+                y = jnp.minimum(y, x)
+            return y
+    return fn, in_shapes[0]
+
+
+GraphStep = Tuple[str, List[str], Callable[[Params, List[jnp.ndarray]], jnp.ndarray]]
+
+
+def _build_graph(
+    gconfig: Dict[str, Any],
+    builder: _Builder,
+    input_shape: Optional[Tuple[int, ...]],
+) -> Tuple[List[GraphStep], str, Tuple[int, ...], Tuple[int, ...]]:
+    """Lower a single-input/single-output layer DAG.
+
+    Returns (steps in topological order, output layer name, model input
+    feature shape, output feature shape). Layer params register in
+    ``builder.inits`` under each layer's graph name.
+    """
+    layers = gconfig["layers"]
+    if len(gconfig.get("input_layers", ())) != 1 or len(gconfig.get("output_layers", ())) != 1:
         raise ValueError(
-            f"only Sequential topologies are supported, got class_name={cls!r} "
-            "(graph-form Functional models: build the module in flax and use "
-            "spec_from_flax)"
+            "only single-input/single-output Functional graphs are supported"
         )
-    cfg = mc["config"]
-    return cfg if isinstance(cfg, list) else cfg["layers"]
+    in_name = gconfig["input_layers"][0][0]
+    out_name = gconfig["output_layers"][0][0]
+    shapes: Dict[str, Tuple[int, ...]] = {}
+    steps: List[GraphStep] = []
+    pending: Dict[str, Dict[str, Any]] = {l["name"]: l for l in layers}
+
+    while pending:
+        progressed = False
+        for name in list(pending):
+            layer = pending[name]
+            cls = layer["class_name"]
+            cfg = dict(layer.get("config", {}))
+            cfg.setdefault("name", name)  # graph name IS the param key
+            nodes = layer.get("inbound_nodes", [])
+            if cls == "InputLayer" or not nodes:
+                if name != in_name:
+                    raise ValueError(
+                        f"layer {name!r} has no inbound nodes but is not the "
+                        f"declared input layer {in_name!r}; multi-source "
+                        "graphs are not supported"
+                    )
+                shape = cfg.get("batch_input_shape")
+                shape = tuple(int(d) for d in shape[1:]) if shape else input_shape
+                if shape is None:
+                    raise ValueError(
+                        f"input layer {name!r} has no batch_input_shape; "
+                        "pass input_shape="
+                    )
+                shapes[name] = tuple(shape)
+                del pending[name]
+                progressed = True
+                continue
+            if len(nodes) > 1:
+                raise ValueError(
+                    f"layer {name!r} is called at {len(nodes)} graph nodes; "
+                    "shared layers are not supported"
+                )
+            parents = []
+            for p in nodes[0]:
+                if not isinstance(p, (list, tuple)) or not isinstance(p[0], str):
+                    raise ValueError(
+                        f"unrecognized inbound node format on {name!r}: {p!r}"
+                    )
+                parents.append(p[0])
+            if not all(p in shapes for p in parents):
+                continue  # parents not lowered yet
+            if cls in _MERGE_LAYERS:
+                fn, out_shape = _merge_lowering(cls, cfg, [shapes[p] for p in parents])
+                steps.append((name, parents, fn))
+            else:
+                builder.shape = shapes[parents[0]]
+                builder.add(cls, cfg)
+                single = builder.fns[-1]
+                steps.append(
+                    (name, parents, lambda params, xs, f=single: f(params, xs[0]))
+                )
+                out_shape = builder.shape
+            shapes[name] = tuple(out_shape)
+            del pending[name]
+            progressed = True
+        if pending and not progressed:
+            raise ValueError(
+                f"graph has a cycle or dangling inputs; unresolved: {sorted(pending)}"
+            )
+    if in_name not in shapes or out_name not in shapes:
+        raise ValueError(f"input/output layer {in_name!r}/{out_name!r} not in graph")
+    return steps, out_name, shapes[in_name], shapes[out_name]
+
+
+def _strip_graph_softmax(
+    layers: List[Dict[str, Any]], steps: List[GraphStep], out_name: str
+) -> bool:
+    """Graph-mode analog of :func:`_strip_trailing_softmax`: rewrite the
+    output node's fn if it ends in softmax. Returns True if stripped."""
+    layer = next(l for l in layers if l["name"] == out_name)
+    cfg = layer.get("config", {})
+    idx = next(i for i, (n, _, _) in enumerate(steps) if n == out_name)
+    name, parents, _ = steps[idx]
+    if layer["class_name"] == "Activation" and cfg.get("activation") == "softmax":
+        steps[idx] = (name, parents, lambda params, xs: xs[0])
+        return True
+    if layer["class_name"] == "Dense" and cfg.get("activation") == "softmax":
+        f = _dense_fn(name, cfg.get("use_bias", True))
+        steps[idx] = (name, parents, lambda params, xs, f=f: f(params, xs[0]))
+        return True
+    return False
 
 
 def load_keras_weights(model_json_path: str, manifest: List[Dict[str, Any]]) -> Params:
@@ -398,7 +619,13 @@ def load_keras_weights(model_json_path: str, manifest: List[Dict[str, Any]]) -> 
                     "export); quantized manifests are not supported — "
                     "re-export without quantization"
                 )
-            dtype = _DTYPES[w.get("dtype", "float32")]
+            dtype_name = w.get("dtype", "float32")
+            if dtype_name not in _DTYPES:
+                raise ValueError(
+                    f"weight {w['name']!r} has unsupported dtype "
+                    f"{dtype_name!r}; supported: {sorted(_DTYPES)}"
+                )
+            dtype = _DTYPES[dtype_name]
             shape = tuple(int(d) for d in w["shape"])
             count = int(np.prod(shape)) if shape else 1
             arr = np.frombuffer(buf, dtype=dtype, count=count, offset=offset)
@@ -436,23 +663,47 @@ def spec_from_keras_json(
     """
     with open(path) as f:
         topology = json.load(f)
-    layers = _layer_list(topology)
+    kind, config = _model_config(topology)
     builder = _Builder(dtype=dtype)
     if input_shape is not None:
-        builder.shape = tuple(int(d) for d in input_shape)
-    for layer in layers:
-        builder.add(layer["class_name"], dict(layer.get("config", {})))
-    if builder.shape is None:
-        raise ValueError("could not infer model shapes: no batch_input_shape anywhere")
+        input_shape = tuple(int(d) for d in input_shape)
 
-    in_shape = tuple(
-        int(d) for d in (input_shape if input_shape is not None
-                         else _input_shape_from(layers))
-    )
-    fns = list(builder.fns)
-    stripped = False
-    if logits_output and fns:
-        stripped = _strip_trailing_softmax(layers, fns, builder.names)
+    if kind == "Sequential":
+        layers = config
+        if input_shape is not None:
+            builder.shape = input_shape
+        for layer in layers:
+            builder.add(layer["class_name"], dict(layer.get("config", {})))
+        if builder.shape is None:
+            raise ValueError(
+                "could not infer model shapes: no batch_input_shape anywhere"
+            )
+        in_shape = (input_shape if input_shape is not None
+                    else _input_shape_from(layers))
+        out_shape = tuple(builder.shape)
+        fns = list(builder.fns)
+        stripped = False
+        if logits_output and fns:
+            stripped = _strip_trailing_softmax(layers, fns, builder.names)
+
+        def run(params: Params, y: jnp.ndarray) -> jnp.ndarray:
+            for fn in fns:
+                y = fn(params, y)
+            return y
+
+    else:  # Functional DAG
+        steps, out_name, in_shape, out_shape = _build_graph(
+            config, builder, input_shape
+        )
+        stripped = False
+        if logits_output and steps:
+            stripped = _strip_graph_softmax(config["layers"], steps, out_name)
+
+        def run(params: Params, y: jnp.ndarray) -> jnp.ndarray:
+            env: Dict[str, jnp.ndarray] = {config["input_layers"][0][0]: y}
+            for sname, parents, fn in steps:
+                env[sname] = fn(params, [env[p] for p in parents])
+            return env[out_name]
 
     inits = builder.inits
     loaded: Optional[Params] = None
@@ -479,18 +730,15 @@ def spec_from_keras_json(
         return params
 
     def apply(params: Params, x: jnp.ndarray) -> jnp.ndarray:
-        y = x.astype(dtype)
-        for fn in fns:
-            y = fn(params, y)
-        return y
+        return run(params, x.astype(dtype))
 
     name = os.path.splitext(os.path.basename(path))[0]
     return ModelSpec(
         init=init,
         apply=apply,
         loss=loss,
-        input_shape=in_shape,
-        output_shape=tuple(builder.shape),
+        input_shape=tuple(in_shape),
+        output_shape=tuple(out_shape),
         name=f"keras:{name}" + (":logits" if stripped else ""),
     )
 
@@ -518,17 +766,7 @@ def _strip_trailing_softmax(
         # *pre*-softmax values); params live under the builder-resolved
         # name (which may be a generated fallback, so don't re-derive it
         # from cfg here)
-        name = names[-1]
-        use_bias = cfg.get("use_bias", True)
-
-        def fn(params: Params, x: jnp.ndarray, name=name, use_bias=use_bias):
-            p = params[name]
-            y = x @ p["kernel"].astype(x.dtype)
-            if use_bias:
-                y = y + p["bias"].astype(y.dtype)
-            return y
-
-        fns[-1] = fn
+        fns[-1] = _dense_fn(names[-1], cfg.get("use_bias", True))
         return True
     return False
 

@@ -279,11 +279,182 @@ def test_depthwise_conv_with_dilation(tmp_path):
     np.testing.assert_allclose(out, 9.0, rtol=1e-6)  # 9 taps of ones
 
 
+def _graph_topology(merge="Add", shared_output=False):
+    """Functional DAG: input -> conv(1x1, ones) -> merge([conv, input]) ->
+    GAP -> Dense(3, softmax). Input (4, 4, 2)."""
+    layers = [
+        {
+            "name": "input_1",
+            "class_name": "InputLayer",
+            "config": {"batch_input_shape": [None, 4, 4, 2], "name": "input_1"},
+            "inbound_nodes": [],
+        },
+        {
+            "name": "conv_1",
+            "class_name": "Conv2D",
+            "config": {
+                "name": "conv_1",
+                "filters": 2,
+                "kernel_size": [1, 1],
+                "padding": "same",
+                "activation": "linear",
+                "use_bias": False,
+                "kernel_initializer": {"class_name": "Ones", "config": {}},
+            },
+            "inbound_nodes": [[["input_1", 0, 0, {}]]],
+        },
+        {
+            "name": "merge_1",
+            "class_name": merge,
+            "config": {"name": "merge_1", "axis": -1},
+            "inbound_nodes": [[["conv_1", 0, 0, {}], ["input_1", 0, 0, {}]]],
+        },
+        {
+            "name": "gap_1",
+            "class_name": "GlobalAveragePooling2D",
+            "config": {"name": "gap_1"},
+            "inbound_nodes": [[["merge_1", 0, 0, {}]]],
+        },
+        {
+            "name": "dense_out",
+            "class_name": "Dense",
+            "config": {
+                "name": "dense_out",
+                "units": 3,
+                "activation": "softmax",
+                "use_bias": True,
+                "kernel_initializer": {"class_name": "GlorotUniform", "config": {}},
+                "bias_initializer": {"class_name": "Zeros", "config": {}},
+            },
+            "inbound_nodes": [[["gap_1", 0, 0, {}]]],
+        },
+    ]
+    if shared_output:
+        layers[-1]["inbound_nodes"].append([["gap_1", 0, 0, {}]])
+    return {
+        "modelTopology": {
+            "model_config": {
+                "class_name": "Model",
+                "config": {
+                    "name": "graph_model",
+                    "layers": layers,
+                    "input_layers": [["input_1", 0, 0]],
+                    "output_layers": [["dense_out", 0, 0]],
+                },
+            }
+        }
+    }
+
+
+def test_functional_graph_add_skip_connection(tmp_path):
+    path = _write_model(tmp_path, _graph_topology("Add"))
+    spec = spec_from_keras_json(path)
+    assert spec.input_shape == (4, 4, 2)
+    assert spec.output_shape == (3,)
+    params = spec.init(jax.random.PRNGKey(0))
+    assert set(params) == {"conv_1", "dense_out"}
+    # ones 1x1 conv of ones input -> 2 per channel; skip adds the input's 1
+    # -> GAP gives 3 per channel; check through a hand-set dense identity
+    params["dense_out"]["kernel"] = jnp.zeros((2, 3)).at[0, 0].set(1.0)
+    params["dense_out"]["bias"] = jnp.zeros((3,))
+    out = np.asarray(spec.apply(params, jnp.ones((1, 4, 4, 2))))
+    np.testing.assert_allclose(out[0, 0], 3.0, rtol=1e-6)
+
+
+def test_functional_graph_concatenate(tmp_path):
+    path = _write_model(tmp_path, _graph_topology("Concatenate"))
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    # concat doubles channels: dense fan-in is 4
+    assert params["dense_out"]["kernel"].shape == (4, 3)
+    out = spec.apply(params, jnp.ones((2, 4, 4, 2)))
+    assert out.shape == (2, 3)
+
+
+def test_functional_graph_weight_loading_and_softmax_strip(tmp_path):
+    rng = np.random.RandomState(3)
+    conv_k = rng.randn(1, 1, 2, 2).astype(np.float32)
+    dense_k = rng.randn(2, 3).astype(np.float32)
+    dense_b = rng.randn(3).astype(np.float32)
+    path = _write_model(
+        tmp_path,
+        _graph_topology("Add"),
+        weights=[
+            ("conv_1/kernel", conv_k),
+            ("dense_out/kernel", dense_k),
+            ("dense_out/bias", dense_b),
+        ],
+    )
+    spec = spec_from_keras_json(path)  # logits: trailing softmax stripped
+    params = spec.init(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(params["conv_1"]["kernel"]), conv_k)
+    x = rng.randn(5, 4, 4, 2).astype(np.float32)
+    # manual forward: y = GAP(conv(x) + x) @ Wd + bd  (no softmax)
+    conv = np.einsum("bhwc,cd->bhwd", x, conv_k[0, 0])
+    gap = np.mean(conv + x, axis=(1, 2))
+    want = gap @ dense_k + dense_b
+    np.testing.assert_allclose(
+        np.asarray(spec.apply(params, jnp.asarray(x))), want, rtol=1e-4
+    )
+    proba = spec_from_keras_json(path, logits_output=False)
+    np.testing.assert_allclose(
+        np.asarray(proba.apply(params, jnp.asarray(x))),
+        np.asarray(jax.nn.softmax(jnp.asarray(want))),
+        rtol=1e-4,
+    )
+
+
+def test_functional_shared_layer_rejected(tmp_path):
+    path = _write_model(tmp_path, _graph_topology("Add", shared_output=True))
+    with pytest.raises(ValueError, match="shared layers"):
+        spec_from_keras_json(path)
+
+
+def test_depthwise_multiplier_channel_order(tmp_path):
+    """depth_multiplier=2: TF output-channel order is channel-major
+    (out = c*mult + m), aligned with the loaded bias and downstream weights."""
+    topo = {
+        "model_config": {
+            "class_name": "Sequential",
+            "config": [
+                {
+                    "class_name": "DepthwiseConv2D",
+                    "config": {
+                        "name": "dw_1",
+                        "kernel_size": [1, 1],
+                        "depth_multiplier": 2,
+                        "padding": "valid",
+                        "activation": "linear",
+                        "use_bias": False,
+                        "batch_input_shape": [None, 2, 2, 2],
+                    },
+                }
+            ],
+        }
+    }
+    kernel = np.zeros((1, 1, 2, 2), np.float32)  # (kh, kw, cin, mult)
+    for c in range(2):
+        for m in range(2):
+            kernel[0, 0, c, m] = 10 * c + m
+    path = _write_model(tmp_path, topo, weights=[("dw_1/depthwise_kernel", kernel)])
+    spec = spec_from_keras_json(path)
+    assert spec.output_shape == (2, 2, 4)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = np.zeros((1, 2, 2, 2), np.float32)
+    x[..., 0] = 1.0  # only input channel 0 active
+    out = np.asarray(spec.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(out[0, 0, 0], [0.0, 1.0, 0.0, 0.0])
+    x2 = np.zeros((1, 2, 2, 2), np.float32)
+    x2[..., 1] = 1.0  # only input channel 1
+    out2 = np.asarray(spec.apply(params, jnp.asarray(x2)))
+    np.testing.assert_allclose(out2[0, 0, 0], [0.0, 0.0, 10.0, 11.0])
+
+
 def test_unsupported_topology_raises(tmp_path):
-    topo = {"model_config": {"class_name": "Functional", "config": {"layers": []}}}
+    topo = {"model_config": {"class_name": "Weird", "config": {"layers": []}}}
     path = tmp_path / "model.json"
     path.write_text(json.dumps(topo))
-    with pytest.raises(ValueError, match="Sequential"):
+    with pytest.raises(ValueError, match="class_name"):
         spec_from_keras_json(str(path))
 
 
