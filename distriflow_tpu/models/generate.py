@@ -9,8 +9,10 @@ SURVEY.md §2.3). TPU-shaped decoding:
   the cache is carried functionally through the scan (static shapes,
   no per-token dispatch from the host).
 
-Greedy (``temperature=0``) or temperature sampling. The cache holds
-``max_seq`` positions per layer; ``prompt_len + n_tokens`` must fit.
+Greedy (``temperature=0``) or temperature sampling, optionally truncated
+to the top-k logits and/or a top-p (nucleus) cumulative-probability mass.
+The cache holds ``max_seq`` positions per layer; ``prompt_len + n_tokens``
+must fit.
 
 Caveat: capacity-based MoE routes per decode step group, so expert-overflow
 behavior can differ from the training-time grouping; dense-FFN configs
@@ -30,8 +32,41 @@ import jax.numpy as jnp
 from distriflow_tpu.models.transformer import TransformerConfig, TransformerLM
 
 
+def _truncate_logits(
+    logits: jnp.ndarray, top_k: Optional[int], top_p: Optional[float]
+) -> jnp.ndarray:
+    """Mask logits outside the top-k set and/or the top-p nucleus to -inf.
+
+    Standard (HF-style) composition: k first, then p over the distribution
+    *renormalized within* the surviving top-k set — the -inf-masked entries
+    contribute zero mass to the nucleus cumsum. Static shapes, scan-friendly.
+    """
+    neg = jnp.finfo(logits.dtype).min
+    if top_k is not None:
+        k = min(int(top_k), logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]  # k-th largest value
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)  # masked entries -> ~0
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p (always
+        # keeps the argmax: cum is shifted so position 0 sees mass 0)
+        keep_sorted = (cum - probs) < top_p
+        n_keep = jnp.sum(keep_sorted, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
+        logits = jnp.where(logits < cutoff, neg, logits)
+    return logits
+
+
 @functools.lru_cache(maxsize=32)
-def _build_fns(config: TransformerConfig, n_tokens: int, temperature: float):
+def _build_fns(
+    config: TransformerConfig,
+    n_tokens: int,
+    temperature: float,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+):
     """Jit-compiled prefill + decode scan, cached so repeated generate()
     calls with the same config/shape hit the jit cache instead of paying
     full XLA recompilation per call."""
@@ -47,7 +82,10 @@ def _build_fns(config: TransformerConfig, n_tokens: int, temperature: float):
 
     def pick(logits, key):
         if temperature > 0:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
+            logits = logits / temperature
+            if top_k is not None or top_p is not None:
+                logits = _truncate_logits(logits, top_k, top_p)
+            return jax.random.categorical(key, logits, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
     @jax.jit
@@ -74,12 +112,17 @@ def generate(
     n_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
     """Generate ``n_tokens`` continuations of ``prompt`` ``[B, P] int32``.
 
     Returns ``[B, P + n_tokens]`` (prompt + generated). ``temperature=0``
     is greedy argmax; otherwise softmax sampling at the given temperature
-    (``rng`` required).
+    (``rng`` required), optionally restricted to the ``top_k`` highest
+    logits and/or the ``top_p`` nucleus (smallest set of tokens whose
+    probability mass reaches ``top_p``; both given = k first, then p over
+    the top-k-renormalized distribution).
     """
     b, p = prompt.shape
     if n_tokens <= 0:
@@ -91,9 +134,15 @@ def generate(
         )
     if temperature > 0 and rng is None:
         raise ValueError("temperature sampling needs rng=jax.random.PRNGKey(...)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    prefill, pick, decode_steps = _build_fns(config, n_tokens, temperature)
+    prefill, pick, decode_steps = _build_fns(
+        config, n_tokens, temperature, top_k, top_p
+    )
 
     last_logits, cache = prefill(params, prompt)
     key0, key_rest = jax.random.split(rng)

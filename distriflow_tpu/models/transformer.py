@@ -419,7 +419,9 @@ def pipelined_transformer_lm(
     example_batch: Optional[int] = None,
     **overrides: Any,
 ) -> ModelSpec:
-    """Pipeline-parallel causal LM over the mesh's ``pipe`` axis (DP x PP).
+    """Pipeline-parallel causal LM over the mesh's ``pipe`` axis
+    (DP x PP x TP — Megatron sharding inside stages rides the automatic
+    ``model`` axis through the pipeline's hybrid shard_map).
 
     The layer stack splits into P = ``mesh.shape['pipe']`` stages of
     ``n_layers / P`` blocks; stage params carry a leading stages dim sharded

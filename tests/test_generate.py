@@ -131,3 +131,75 @@ def test_noncausal_decode_prefill_matches_training_forward():
         params, x, mutable=["cache"])
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(full), atol=2e-5)
+
+
+def test_truncate_logits_top_k():
+    from distriflow_tpu.models.generate import _truncate_logits
+
+    logits = jnp.asarray([[4.0, 1.0, 3.0, 2.0, 0.0]])
+    out = np.asarray(_truncate_logits(logits, top_k=2, top_p=None))
+    neg = np.finfo(np.float32).min
+    np.testing.assert_allclose(out[0], [4.0, neg, 3.0, neg, neg])
+
+
+def test_truncate_logits_top_p():
+    from distriflow_tpu.models.generate import _truncate_logits
+
+    # probs ~ [0.643, 0.236, 0.087, 0.032, 0.002]; nucleus at 0.7 keeps 2
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0, -2.0]])
+    out = np.asarray(_truncate_logits(logits, top_k=None, top_p=0.7))
+    neg = np.finfo(np.float32).min
+    np.testing.assert_allclose(out[0], [4.0, 3.0, neg, neg, neg])
+    # top_p so small only the argmax survives
+    out1 = np.asarray(_truncate_logits(logits, top_k=None, top_p=1e-6))
+    np.testing.assert_allclose(out1[0], [4.0, neg, neg, neg, neg])
+    # top_p=1.0 keeps everything
+    outall = np.asarray(_truncate_logits(logits, top_k=None, top_p=1.0))
+    np.testing.assert_allclose(outall, np.asarray(logits))
+
+
+def test_top_k_1_matches_greedy():
+    params = _params(CFG)
+    prompt = jnp.asarray([[1, 2, 3], [9, 8, 7]], jnp.int32)
+    greedy = generate(CFG, params, prompt, n_tokens=6)
+    k1 = generate(CFG, params, prompt, n_tokens=6, temperature=1.5,
+                  rng=jax.random.PRNGKey(3), top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_truncate_logits_k_then_p_renormalizes():
+    """Nucleus mass is computed within the surviving top-k set (HF
+    semantics), not over the raw distribution."""
+    from distriflow_tpu.models.generate import _truncate_logits
+
+    # raw probs ~ [0.4, 0.3, 0.15, 0.15]; top_k=2 renormalizes the top two
+    # to [0.571, 0.429], so top_p=0.5 keeps ONLY the argmax (0.571 >= 0.5).
+    # Computing the nucleus over the raw distribution would keep both.
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.15, 0.15]]))
+    out = np.asarray(_truncate_logits(logits, top_k=2, top_p=0.5))
+    neg = np.finfo(np.float32).min
+    assert out[0, 0] == pytest.approx(np.log(0.4))
+    np.testing.assert_array_equal(out[0, 1:], [neg, neg, neg])
+
+
+def test_tiny_top_p_matches_greedy():
+    """top_p small enough that only the argmax survives: sampling at high
+    temperature must still reproduce the greedy sequence (catches the
+    truncation branch silently not firing)."""
+    params = _params(CFG)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    greedy = generate(CFG, params, prompt, n_tokens=8)
+    out = generate(CFG, params, prompt, n_tokens=8, temperature=2.0,
+                   rng=jax.random.PRNGKey(5), top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(out))
+
+
+def test_sampling_param_validation():
+    params = _params(CFG)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(CFG, params, prompt, n_tokens=2, temperature=1.0,
+                 rng=jax.random.PRNGKey(0), top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(CFG, params, prompt, n_tokens=2, temperature=1.0,
+                 rng=jax.random.PRNGKey(0), top_p=1.5)
