@@ -19,6 +19,7 @@ Run:  python -m experiments.lm.train --steps 200 --seq 512
 from __future__ import annotations
 
 import argparse
+import itertools
 import sys
 import time
 
@@ -58,6 +59,11 @@ def main(argv=None) -> float:
                    help="rematerialize blocks in backward (long-context memory)")
     p.add_argument("--mesh", default="", help="e.g. data=2,model=2,seq=2")
     p.add_argument("--learning-rate", type=float, default=3e-3)
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="run K optimizer steps per device dispatch "
+                        "(lax.scan via SyncTrainer.step_many) — amortizes "
+                        "host/transport latency, which dominates small-model "
+                        "wall clock; loss prints once per chunk")
     p.add_argument("--corpus-tokens", type=int, default=200_000)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--save-every", type=int, default=0)
@@ -110,20 +116,53 @@ def main(argv=None) -> float:
     split = max(len(corpus) - max(4 * (args.seq + 1), len(corpus) // 10),
                 args.seq + 2)
     train_corpus, eval_corpus = corpus[:split], corpus[split:]
+    # one device dispatch per k steps; a partial tail chunk would force a
+    # second XLA compile (different scan length / separate step fn) inside
+    # the run, so only full chunks execute — dropped steps are logged
+    k = max(1, min(args.steps_per_dispatch, args.steps)) if args.steps else 1
+    run_steps = (args.steps // k) * k
+    if run_steps < args.steps:
+        print(
+            f"note: running {run_steps} of {args.steps} steps — the "
+            f"{args.steps - run_steps}-step tail is not a full "
+            f"--steps-per-dispatch chunk ({k}); pick --steps divisible "
+            "by it to run them all",
+            file=sys.stderr,
+        )
     start = time.perf_counter()
+    timed_steps = 0
     last = None
     # seed by the resumed step so a restarted run continues the batch
     # stream instead of replaying the windows it already trained on
-    for step, (x, y) in enumerate(
-        batches(train_corpus, args.batch_size, args.seq, args.steps,
-                args.seed + start_step),
-        start=start_step,
-    ):
-        last = trainer.step((x, y))
-        if step % 20 == 0:
+    stream = batches(train_corpus, args.batch_size, args.seq, run_steps,
+                     args.seed + start_step)
+    step = start_step
+    while True:
+        chunk = list(itertools.islice(stream, k))
+        if len(chunk) < k or not chunk:
+            break
+        if k > 1:
+            xs = np.stack([c[0] for c in chunk])
+            ys = np.stack([c[1] for c in chunk])
+            # step_many returns a device array; [-1] fetch is the barrier
+            last = float(trainer.step_many((xs, ys))[-1])
+        else:
+            last = trainer.step(chunk[0])
+        first_dispatch = step == start_step
+        step += k
+        if first_dispatch:
+            # restart the clock after the first dispatch: XLA compilation
+            # (~20-40s) would otherwise swamp short runs — report
+            # steady-state throughput
+            start = time.perf_counter()
+        else:
+            timed_steps += k
+        if (step // k) % max(1, 20 // k) == 0 or k >= 20:
             print(f"step {step} loss {last:.4f}", file=sys.stderr)
     elapsed = time.perf_counter() - start
-    tok_s = args.steps * args.batch_size * args.seq / elapsed
+    # steady-state only: runs that fit in one dispatch have no timed steps
+    tok_s = (timed_steps * args.batch_size * args.seq / elapsed
+             if timed_steps else float("nan"))
 
     # held-out eval (aux-free, jitted via the trainer) vs the context-free
     # unigram baseline

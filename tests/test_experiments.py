@@ -163,3 +163,18 @@ def test_lm_train_tiny():
     ])
     assert np.isfinite(eval_loss)
     assert eval_loss < np.log(256)  # learned at least the unigram skew
+
+
+def test_lm_train_chunked_dispatch_matches():
+    """--steps-per-dispatch runs the same optimizer trajectory as per-step
+    dispatch (step_many is semantically K step() calls)."""
+    from experiments.lm import train as lm_train
+
+    common = [
+        "--steps", "24", "--seq", "64", "--batch-size", "8",
+        "--n-layers", "1", "--d-model", "64", "--d-ff", "128",
+        "--corpus-tokens", "20000", "--dtype", "float32",
+    ]
+    loss_1 = lm_train.main(common)
+    loss_k = lm_train.main(common + ["--steps-per-dispatch", "8"])
+    np.testing.assert_allclose(loss_1, loss_k, rtol=1e-4)
