@@ -282,6 +282,40 @@ class SpecModel(DistributedModel):
         return tuple(self.spec.output_shape)
 
 
+def with_uint8_inputs(
+    spec: ModelSpec, scale: float = 1.0 / 255.0, offset: float = 0.0
+) -> ModelSpec:
+    """Wire-format adapter: the model accepts raw uint8 inputs and
+    normalizes on device (``x * scale + offset`` after a float32 cast).
+
+    Streaming pixels as uint8 cuts host->device bytes 4x vs float32 — and on
+    a tunneled/DCN-fed accelerator the input stream, not compute, is usually
+    the binding constraint (measured here: ~16 MB/s tunnel vs 2.5 ms/step
+    CIFAR compute). Pair with integer labels + a sparse loss to shrink the
+    label stream too.
+    """
+
+    def norm(x: jnp.ndarray) -> jnp.ndarray:
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            # already-normalized floats would be silently re-scaled by
+            # 1/255 — a near-certain wire-format mix-up; fail at trace time
+            raise TypeError(
+                f"with_uint8_inputs got {x.dtype} input; this spec expects "
+                "raw integer pixels (feed the un-normalized uint8 stream, "
+                "or use the base spec for float inputs)"
+            )
+        return x.astype(jnp.float32) * scale + offset
+
+    apply = spec.apply
+    new = dataclasses.replace(spec, apply=lambda p, x: apply(p, norm(x)))
+    if spec.apply_with_aux is not None:
+        with_aux = spec.apply_with_aux
+        new = dataclasses.replace(
+            new, apply_with_aux=lambda p, x: with_aux(p, norm(x))
+        )
+    return new
+
+
 ModelSource = Union[ModelSpec, DistributedModel, Callable[[], "ModelSpec"], str]
 
 

@@ -2,6 +2,14 @@
 
 from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
 from distriflow_tpu.train.federated import FederatedAveragingTrainer
+from distriflow_tpu.train.loop import ChunkedRunResult, run_chunked
 from distriflow_tpu.train.sync import SyncTrainer, TrainState
 
-__all__ = ["AsyncSGDTrainer", "FederatedAveragingTrainer", "SyncTrainer", "TrainState"]
+__all__ = [
+    "AsyncSGDTrainer",
+    "ChunkedRunResult",
+    "FederatedAveragingTrainer",
+    "SyncTrainer",
+    "TrainState",
+    "run_chunked",
+]

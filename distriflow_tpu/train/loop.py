@@ -1,0 +1,89 @@
+"""Chunked host training loop: one device dispatch per K optimizer steps.
+
+On a tunneled or remote accelerator, per-step dispatch latency (tens to
+hundreds of ms) dominates wall clock for small models; the reference has the
+same problem in sharper form (a full serialize -> websocket -> aggregate ->
+broadcast round per step, SURVEY.md §3.3). The TPU-idiomatic fix is to run K
+steps as a device-side ``lax.scan`` (:meth:`SyncTrainer.step_many`) so one
+dispatch covers K real parameter updates.
+
+:func:`run_chunked` packages the loop the experiment CLIs share: chunk a host
+batch stream, stack each chunk to ``[K, B, ...]``, dispatch, and keep honest
+steady-state timing (the first, compiling dispatch is excluded; partial tail
+chunks are not run — a different scan length would force a second XLA compile
+mid-run).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Iterable, NamedTuple, Optional
+
+import jax
+import numpy as np
+
+
+class ChunkedRunResult(NamedTuple):
+    steps_run: int       # optimizer steps actually executed
+    timed_steps: int     # steps inside the steady-state timing window
+    elapsed_s: float     # wall time of the timed window (value-fetch barrier)
+    last_loss: Optional[float]  # loss of the final executed step
+
+    @property
+    def steps_per_sec(self) -> float:
+        """Steady-state steps/sec; nan if everything fit in one dispatch."""
+        if not self.timed_steps:
+            return float("nan")
+        return self.timed_steps / self.elapsed_s
+
+
+def run_chunked(
+    trainer: Any,
+    stream: Iterable[Any],
+    steps: int,
+    steps_per_dispatch: int = 1,
+    log: Optional[Callable[[int, float], None]] = None,
+    log_every: int = 20,
+) -> ChunkedRunResult:
+    """Drive ``trainer`` over ``stream`` with one dispatch per K steps.
+
+    ``stream`` yields host batch pytrees (``(x, y)`` / ``(x, y, w)``); each
+    chunk of K is stacked to a leading step axis and run through
+    ``trainer.step_many`` (K > 1) or ``trainer.step`` (K == 1) — identical
+    optimizer trajectories either way. ``steps`` bounds how many batches are
+    consumed; only full chunks run (``steps % K`` tail steps are skipped —
+    the caller logs this, knowing its CLI flags). ``log(step, loss)`` fires
+    roughly every ``log_every`` steps and after the final chunk.
+    """
+    k = max(1, min(steps_per_dispatch, steps)) if steps else 1
+    run_steps = (steps // k) * k
+    stream = iter(stream)
+    start = time.perf_counter()
+    timed_steps = 0
+    step = 0
+    last: Optional[float] = None
+    while step < run_steps:
+        chunk = list(itertools.islice(stream, k))
+        if len(chunk) < k:
+            break  # stream ran dry early
+        if k > 1:
+            stacked = jax.tree.map(lambda *xs: np.stack(xs), *chunk)
+            # [-1] value fetch doubles as the device barrier
+            last = float(trainer.step_many(stacked)[-1])
+        else:
+            last = float(trainer.step(chunk[0]))
+        first_dispatch = step == 0
+        step += k
+        if first_dispatch:
+            # steady-state timing: the first dispatch carries XLA
+            # compilation (~20-40s) and would swamp short runs
+            start = time.perf_counter()
+        else:
+            timed_steps += k
+        if log is not None and (
+            step >= run_steps or (step // k) % max(1, log_every // k) == 0
+        ):
+            log(step, last)
+    elapsed = time.perf_counter() - start
+    return ChunkedRunResult(step, timed_steps, elapsed, last)

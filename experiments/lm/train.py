@@ -19,9 +19,7 @@ Run:  python -m experiments.lm.train --steps 200 --seq 512
 from __future__ import annotations
 
 import argparse
-import itertools
 import sys
-import time
 
 import jax
 import numpy as np
@@ -30,6 +28,7 @@ from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
 from distriflow_tpu.parallel import create_mesh, data_parallel_mesh
 from distriflow_tpu.parallel.sharding import TRANSFORMER_TP_RULES
 from distriflow_tpu.train.sync import SyncTrainer
+from distriflow_tpu.train.loop import run_chunked
 from distriflow_tpu.utils.config import MeshConfig
 
 from experiments.lm.data import VOCAB, batches, generate_corpus
@@ -116,53 +115,27 @@ def main(argv=None) -> float:
     split = max(len(corpus) - max(4 * (args.seq + 1), len(corpus) // 10),
                 args.seq + 2)
     train_corpus, eval_corpus = corpus[:split], corpus[split:]
-    # one device dispatch per k steps; a partial tail chunk would force a
-    # second XLA compile (different scan length / separate step fn) inside
-    # the run, so only full chunks execute — dropped steps are logged
-    k = max(1, min(args.steps_per_dispatch, args.steps)) if args.steps else 1
-    run_steps = (args.steps // k) * k
-    if run_steps < args.steps:
+    # one device dispatch per --steps-per-dispatch steps (run_chunked:
+    # steady-state timing, full chunks only); seed by the resumed step so a
+    # restarted run continues the batch stream instead of replaying windows
+    res = run_chunked(
+        trainer,
+        batches(train_corpus, args.batch_size, args.seq, args.steps,
+                args.seed + start_step),
+        steps=args.steps,
+        steps_per_dispatch=args.steps_per_dispatch,
+        log=lambda s, l: print(
+            f"step {start_step + s} loss {l:.4f}", file=sys.stderr),
+    )
+    if res.steps_run < args.steps:
         print(
-            f"note: running {run_steps} of {args.steps} steps — the "
-            f"{args.steps - run_steps}-step tail is not a full "
-            f"--steps-per-dispatch chunk ({k}); pick --steps divisible "
+            f"note: ran {res.steps_run} of {args.steps} steps — the tail is "
+            f"not a full --steps-per-dispatch chunk; pick --steps divisible "
             "by it to run them all",
             file=sys.stderr,
         )
-    start = time.perf_counter()
-    timed_steps = 0
-    last = None
-    # seed by the resumed step so a restarted run continues the batch
-    # stream instead of replaying the windows it already trained on
-    stream = batches(train_corpus, args.batch_size, args.seq, run_steps,
-                     args.seed + start_step)
-    step = start_step
-    while True:
-        chunk = list(itertools.islice(stream, k))
-        if len(chunk) < k or not chunk:
-            break
-        if k > 1:
-            xs = np.stack([c[0] for c in chunk])
-            ys = np.stack([c[1] for c in chunk])
-            # step_many returns a device array; [-1] fetch is the barrier
-            last = float(trainer.step_many((xs, ys))[-1])
-        else:
-            last = trainer.step(chunk[0])
-        first_dispatch = step == start_step
-        step += k
-        if first_dispatch:
-            # restart the clock after the first dispatch: XLA compilation
-            # (~20-40s) would otherwise swamp short runs — report
-            # steady-state throughput
-            start = time.perf_counter()
-        else:
-            timed_steps += k
-        if (step // k) % max(1, 20 // k) == 0 or k >= 20:
-            print(f"step {step} loss {last:.4f}", file=sys.stderr)
-    elapsed = time.perf_counter() - start
     # steady-state only: runs that fit in one dispatch have no timed steps
-    tok_s = (timed_steps * args.batch_size * args.seq / elapsed
-             if timed_steps else float("nan"))
+    tok_s = res.steps_per_sec * args.batch_size * args.seq
 
     # held-out eval (aux-free, jitted via the trainer) vs the context-free
     # unigram baseline

@@ -1,0 +1,105 @@
+"""run_chunked loop + uint8 wire-format adapter."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distriflow_tpu.models import mnist_mlp
+from distriflow_tpu.models.base import with_uint8_inputs
+from distriflow_tpu.parallel import data_parallel_mesh
+from distriflow_tpu.train import run_chunked
+from distriflow_tpu.train.sync import SyncTrainer
+
+
+def _stream(n, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        x = rng.randn(batch, 28, 28, 1).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)]
+        yield x, y
+
+
+def _trainer(devices):
+    mesh = data_parallel_mesh(devices)
+    t = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, learning_rate=0.01)
+    t.init(jax.random.PRNGKey(0))
+    return t
+
+
+def test_chunked_matches_per_step(devices):
+    t1 = _trainer(devices)
+    r1 = run_chunked(t1, _stream(12), steps=12, steps_per_dispatch=1)
+    tk = _trainer(devices)
+    rk = run_chunked(tk, _stream(12), steps=12, steps_per_dispatch=4)
+    assert r1.steps_run == rk.steps_run == 12
+    np.testing.assert_allclose(r1.last_loss, rk.last_loss, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(t1.get_params())[0]),
+        np.asarray(jax.tree.leaves(tk.get_params())[0]),
+        rtol=1e-5,
+    )
+
+
+def test_chunked_drops_partial_tail(devices):
+    t = _trainer(devices)
+    res = run_chunked(t, _stream(10), steps=10, steps_per_dispatch=4)
+    assert res.steps_run == 8  # 10 // 4 * 4
+    assert res.timed_steps == 4  # first (compiling) chunk excluded
+
+
+def test_chunked_clamps_k_to_steps(devices):
+    t = _trainer(devices)
+    res = run_chunked(t, _stream(3), steps=3, steps_per_dispatch=100)
+    assert res.steps_run == 3
+    assert np.isnan(res.steps_per_sec)  # single dispatch -> no timed window
+
+
+def test_chunked_zero_steps(devices):
+    t = _trainer(devices)
+    res = run_chunked(t, _stream(0), steps=0, steps_per_dispatch=4)
+    assert res.steps_run == 0 and res.last_loss is None
+
+
+def test_chunked_logs(devices):
+    t = _trainer(devices)
+    seen = []
+    run_chunked(t, _stream(8), steps=8, steps_per_dispatch=2,
+                log=lambda s, l: seen.append(s))
+    assert seen and seen[-1] == 8
+
+
+def test_with_uint8_inputs_equivalence():
+    spec = mnist_mlp(hidden=8)
+    u8 = with_uint8_inputs(spec)
+    params = spec.init(jax.random.PRNGKey(0))
+    raw = np.random.RandomState(0).randint(0, 256, (4, 28, 28, 1)).astype(np.uint8)
+    got = u8.apply(params, jnp.asarray(raw))
+    want = spec.apply(params, jnp.asarray(raw.astype(np.float32) / 255.0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_with_uint8_inputs_trains_sparse(devices):
+    spec = dataclasses.replace(
+        with_uint8_inputs(mnist_mlp(hidden=8)),
+        loss="sparse_softmax_cross_entropy",
+    )
+    mesh = data_parallel_mesh(devices)
+    t = SyncTrainer(spec, mesh=mesh, learning_rate=0.05)
+    t.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 256, (32, 28, 28, 1)).astype(np.uint8)
+    y = rng.randint(0, 10, 32).astype(np.int32)
+    l0 = float(t.step((x, y)))
+    for _ in range(5):
+        ln = float(t.step((x, y)))
+    assert ln < l0
+
+
+def test_with_uint8_inputs_rejects_float_stream():
+    spec = with_uint8_inputs(mnist_mlp(hidden=8))
+    params = spec.init(jax.random.PRNGKey(0))
+    with pytest.raises(TypeError, match="uint8"):
+        spec.apply(params, jnp.ones((2, 28, 28, 1), jnp.float32))
