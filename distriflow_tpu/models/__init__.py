@@ -17,6 +17,7 @@ from distriflow_tpu.models.losses import (
     register_loss,
     softmax_cross_entropy,
 )
+from distriflow_tpu.models.base import with_uint8_inputs
 from distriflow_tpu.models.generate import generate
 from distriflow_tpu.models.keras_import import spec_from_keras_json
 from distriflow_tpu.models.mobilenet import MobileNetV2, mobilenet_v2
@@ -46,4 +47,5 @@ __all__ = [
     "mnist_mlp",
     "generate",
     "spec_from_keras_json",
+    "with_uint8_inputs",
 ]

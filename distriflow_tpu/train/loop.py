@@ -29,6 +29,7 @@ class ChunkedRunResult(NamedTuple):
     timed_steps: int     # steps inside the steady-state timing window
     elapsed_s: float     # wall time of the timed window (value-fetch barrier)
     last_loss: Optional[float]  # loss of the final executed step
+    ran_dry: bool = False  # the batch stream ended before `steps` batches
 
     @property
     def steps_per_sec(self) -> float:
@@ -36,6 +37,18 @@ class ChunkedRunResult(NamedTuple):
         if not self.timed_steps:
             return float("nan")
         return self.timed_steps / self.elapsed_s
+
+    def tail_note(self, requested_steps: int) -> Optional[str]:
+        """Human-readable note when fewer than ``requested_steps`` ran
+        (shared by the experiment CLIs), or None if all ran."""
+        if self.steps_run >= requested_steps:
+            return None
+        if self.ran_dry:
+            return (f"note: ran {self.steps_run} of {requested_steps} steps "
+                    "— the batch stream ended early")
+        return (f"note: ran {self.steps_run} of {requested_steps} steps — "
+                "the tail is not a full --steps-per-dispatch chunk; pick a "
+                "step count divisible by it to run them all")
 
 
 def run_chunked(
@@ -63,10 +76,12 @@ def run_chunked(
     timed_steps = 0
     step = 0
     last: Optional[float] = None
+    ran_dry = False
     while step < run_steps:
         chunk = list(itertools.islice(stream, k))
         if len(chunk) < k:
-            break  # stream ran dry early
+            ran_dry = True  # stream ended before `steps` batches
+            break
         if k > 1:
             stacked = jax.tree.map(lambda *xs: np.stack(xs), *chunk)
             # [-1] value fetch doubles as the device barrier
@@ -86,4 +101,4 @@ def run_chunked(
         ):
             log(step, last)
     elapsed = time.perf_counter() - start
-    return ChunkedRunResult(step, timed_steps, elapsed, last)
+    return ChunkedRunResult(step, timed_steps, elapsed, last, ran_dry)

@@ -72,11 +72,10 @@ def to_xy(split: Split, classes: int = 10) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def to_xy_raw(split: Split) -> Tuple[np.ndarray, np.ndarray]:
-    """Wire-efficient form: uint8 pixels + int32 labels (4x + 40x smaller
-    than float32 + one-hot). Pair with
-    ``distriflow_tpu.models.with_uint8_inputs`` and a sparse loss."""
-    imgs, labels = split
-    return imgs.astype(np.uint8), labels.astype(np.int32)
+    """Wire-efficient form: see ``distriflow_tpu.data.prefetch.to_uint8_wire``."""
+    from distriflow_tpu.data.prefetch import to_uint8_wire
+
+    return to_uint8_wire(*split)
 
 
 def load_splits(data_dir: Optional[str] = None, seed: int = 0) -> Dict[str, Split]:

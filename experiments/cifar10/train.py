@@ -49,7 +49,7 @@ def run_sync(args, spec, train, val) -> float:
                           optimizer=args.optimizer, verbose=True)
     trainer.init(jax.random.PRNGKey(args.seed))
     x, y = (to_xy_raw if raw_wire else to_xy)(train)
-    k = getattr(args, "steps_per_dispatch", 1)
+    k = args.steps_per_dispatch
     stream = sampling_iterator(x, y, args.batch_size, steps=args.steps,
                                seed=args.seed)
     if k <= 1:
@@ -59,13 +59,9 @@ def run_sync(args, spec, train, val) -> float:
         trainer, stream, steps=args.steps, steps_per_dispatch=k,
         log=lambda s, l: print(f"step {s} loss {l:.4f}", file=sys.stderr),
     )
-    if res.steps_run < args.steps:
-        print(
-            f"note: ran {res.steps_run} of {args.steps} steps — the tail is "
-            "not a full --steps-per-dispatch chunk; pick --steps divisible "
-            "by it to run them all",
-            file=sys.stderr,
-        )
+    note = res.tail_note(args.steps)
+    if note:
+        print(note, file=sys.stderr)
     # steady-state throughput (first, compiling dispatch excluded); a run
     # that fits in one dispatch has no steady-state window to time
     sps = res.steps_per_sec * args.batch_size

@@ -112,3 +112,10 @@ def to_xy(split: Split, num_classes: int) -> Tuple[np.ndarray, np.ndarray]:
     x = imgs.astype(np.float32) / 255.0
     y = np.eye(num_classes, dtype=np.float32)[labels]
     return x, y
+
+
+def to_xy_raw(split: Split) -> Tuple[np.ndarray, np.ndarray]:
+    """Wire-efficient form: see ``distriflow_tpu.data.prefetch.to_uint8_wire``."""
+    from distriflow_tpu.data.prefetch import to_uint8_wire
+
+    return to_uint8_wire(*split)

@@ -127,13 +127,9 @@ def main(argv=None) -> float:
         log=lambda s, l: print(
             f"step {start_step + s} loss {l:.4f}", file=sys.stderr),
     )
-    if res.steps_run < args.steps:
-        print(
-            f"note: ran {res.steps_run} of {args.steps} steps — the tail is "
-            f"not a full --steps-per-dispatch chunk; pick --steps divisible "
-            "by it to run them all",
-            file=sys.stderr,
-        )
+    note = res.tail_note(args.steps)
+    if note:
+        print(note, file=sys.stderr)
     # steady-state only: runs that fit in one dispatch have no timed steps
     tok_s = res.steps_per_sec * args.batch_size * args.seq
 
