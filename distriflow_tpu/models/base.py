@@ -340,15 +340,17 @@ def fetch_model(source: ModelSource, **kw: Any) -> DistributedModel:
             raise TypeError(f"model factory must return a ModelSpec, got {type(spec)}")
         return SpecModel(spec, **kw)
     if isinstance(source, str):
-        if source.endswith(".json"):
-            from distriflow_tpu.models.keras_import import spec_from_keras_json
+        if source.endswith((".json", ".h5", ".hdf5")):
+            from distriflow_tpu.models import keras_import
 
+            parse = (keras_import.spec_from_keras_json if source.endswith(".json")
+                     else keras_import.spec_from_keras_h5)
             spec_kw = {
                 k: kw.pop(k)
                 for k in ("input_shape", "loss", "logits_output", "load_weights", "dtype")
                 if k in kw
             }
-            return SpecModel(spec_from_keras_json(source, **spec_kw), **kw)
+            return SpecModel(parse(source, **spec_kw), **kw)
         from distriflow_tpu.checkpoint import load_model  # lazy: layer dependency
 
         return load_model(source, **kw)

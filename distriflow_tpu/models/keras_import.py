@@ -663,6 +663,113 @@ def spec_from_keras_json(
     """
     with open(path) as f:
         topology = json.load(f)
+    loaded: Optional[Params] = None
+    manifest = topology.get("weightsManifest")
+    if load_weights and manifest:
+        try:
+            loaded = load_keras_weights(path, manifest)
+        except FileNotFoundError:
+            loaded = None  # topology-only json (shards not exported): cold init
+    return _spec_from_topology(
+        topology,
+        name=os.path.splitext(os.path.basename(path))[0],
+        loaded=loaded,
+        input_shape=input_shape,
+        loss=loss,
+        logits_output=logits_output,
+        dtype=dtype,
+    )
+
+
+def spec_from_keras_h5(
+    path: str,
+    input_shape: Optional[Sequence[int]] = None,
+    loss: str = "softmax_cross_entropy",
+    logits_output: bool = True,
+    load_weights: bool = True,
+    dtype: Any = jnp.float32,
+) -> ModelSpec:
+    """Parse a Keras HDF5 (``.h5``) model file into a :class:`ModelSpec`.
+
+    The other common Keras artifact (``model.save('m.h5')``): topology in
+    the ``model_config`` attribute, trained weights under ``model_weights``.
+    Same layer support and semantics as :func:`spec_from_keras_json`.
+    """
+    import h5py  # in-image dependency; imported lazily like the json path
+
+    with h5py.File(path, "r") as f:
+        cfg = f.attrs.get("model_config")
+        if cfg is None:
+            raise ValueError(
+                f"{path!r} has no model_config attribute — not a Keras "
+                "model file (weights-only .h5 files need the architecture; "
+                "save with model.save, not save_weights)"
+            )
+        if isinstance(cfg, bytes):
+            cfg = cfg.decode("utf-8")
+        topology = {"modelTopology": {"model_config": json.loads(cfg)}}
+        loaded: Optional[Params] = None
+        if load_weights and "model_weights" in f:
+            mw = f["model_weights"]
+            # an empty group (architecture-only save) means cold init, not
+            # "all weights missing"
+            loaded = _load_h5_weights(mw) or None
+            if loaded is None and len(mw) > 0:
+                # the group HOLDS something but the legacy layer_names/
+                # weight_names attrs didn't resolve it — silently training
+                # from scratch would masquerade as fine-tuning
+                raise ValueError(
+                    f"{path!r}: model_weights contains {len(mw)} entries but "
+                    "none parsed via the Keras layer_names/weight_names "
+                    "layout; unsupported exporter — pass load_weights=False "
+                    "to cold-init explicitly"
+                )
+    return _spec_from_topology(
+        topology,
+        name=os.path.splitext(os.path.basename(path))[0],
+        loaded=loaded,
+        input_shape=input_shape,
+        loss=loss,
+        logits_output=logits_output,
+        dtype=dtype,
+    )
+
+
+def _load_h5_weights(mw: Any) -> Params:
+    """Read a Keras ``model_weights`` HDF5 group into our params tree.
+
+    Weight names look like ``dense_1/kernel:0`` (possibly nested one group
+    deeper); the layer key is the path segment before the leaf, the leaf
+    drops the ``:N`` suffix.
+    """
+    params: Params = {}
+
+    def _names(attrs, key):
+        return [n.decode("utf-8") if isinstance(n, bytes) else str(n)
+                for n in attrs.get(key, [])]
+
+    for lname in _names(mw.attrs, "layer_names"):
+        group = mw[lname]
+        for wpath in _names(group.attrs, "weight_names"):
+            arr = np.asarray(group[wpath])
+            head, _, leaf = wpath.rpartition("/")
+            leaf = leaf.split(":")[0]
+            layer = head.split("/")[-1] if head else lname
+            params.setdefault(layer, {})[leaf] = jnp.asarray(arr)
+    return params
+
+
+def _spec_from_topology(
+    topology: Dict[str, Any],
+    name: str,
+    loaded: Optional[Params],
+    input_shape: Optional[Sequence[int]],
+    loss: str,
+    logits_output: bool,
+    dtype: Any,
+) -> ModelSpec:
+    """Shared core: lower a parsed topology (+ optionally loaded weights)
+    to a ModelSpec. Both file formats funnel here."""
     kind, config = _model_config(topology)
     builder = _Builder(dtype=dtype)
     if input_shape is not None:
@@ -706,13 +813,6 @@ def spec_from_keras_json(
             return env[out_name]
 
     inits = builder.inits
-    loaded: Optional[Params] = None
-    manifest = topology.get("weightsManifest")
-    if load_weights and manifest:
-        try:
-            loaded = load_keras_weights(path, manifest)
-        except FileNotFoundError:
-            loaded = None  # topology-only json (shards not exported): cold init
     if loaded is not None:
         _check_loaded(loaded, inits)
 
@@ -732,7 +832,6 @@ def spec_from_keras_json(
     def apply(params: Params, x: jnp.ndarray) -> jnp.ndarray:
         return run(params, x.astype(dtype))
 
-    name = os.path.splitext(os.path.basename(path))[0]
     return ModelSpec(
         init=init,
         apply=apply,

@@ -515,3 +515,104 @@ def test_reference_model_json_parses():
     out = spec.apply(params, jnp.zeros((2, 28, 28, 1)))
     assert out.shape == (2, 5)
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+def _write_h5(tmp_path, model_config, weights):
+    """Write a Keras-layout .h5: model_config attr + model_weights group."""
+    import h5py
+
+    path = str(tmp_path / "model.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config)
+        mw = f.create_group("model_weights")
+        by_layer = {}
+        for name, arr in weights:
+            by_layer.setdefault(name.split("/")[0], []).append((name, arr))
+        mw.attrs["layer_names"] = [l.encode() for l in by_layer]
+        for layer, ws in by_layer.items():
+            g = mw.create_group(layer)
+            g.attrs["weight_names"] = [f"{n}:0".encode() for n, _ in ws]
+            for n, arr in ws:
+                g.create_dataset(f"{n}:0", data=arr)
+    return path
+
+
+def test_h5_topology_and_weights(tmp_path):
+    from distriflow_tpu.models import fetch_model, spec_from_keras_h5
+
+    rng = np.random.RandomState(11)
+    kernel = rng.randn(3, 7).astype(np.float32)
+    bias = rng.randn(7).astype(np.float32)
+    mc = {
+        "class_name": "Sequential",
+        "config": [
+            _dense_cfg("dense_1", 7, activation="softmax", batch_input=[None, 3])
+        ],
+    }
+    path = _write_h5(tmp_path, mc, [("dense_1/kernel", kernel), ("dense_1/bias", bias)])
+    spec = spec_from_keras_h5(path)
+    assert spec.input_shape == (3,) and spec.output_shape == (7,)
+    params = spec.init(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(params["dense_1"]["kernel"]), kernel)
+    x = rng.randn(4, 3).astype(np.float32)
+    # trailing softmax stripped -> logits
+    np.testing.assert_allclose(
+        np.asarray(spec.apply(params, jnp.asarray(x))), x @ kernel + bias, rtol=1e-5
+    )
+    # fetch_model dispatches .h5 paths
+    model = fetch_model(path, learning_rate=0.05)
+    model.setup()
+    grads = model.fit(jnp.asarray(x), np.eye(7, dtype=np.float32)[[0, 1, 2, 3]])
+    assert grads["dense_1"]["kernel"].shape == (3, 7)
+
+
+def test_h5_without_config_rejected(tmp_path):
+    import h5py
+
+    from distriflow_tpu.models import spec_from_keras_h5
+
+    path = str(tmp_path / "weights_only.h5")
+    with h5py.File(path, "w") as f:
+        f.create_group("model_weights")
+    with pytest.raises(ValueError, match="model_config"):
+        spec_from_keras_h5(path)
+
+
+def test_h5_cold_init_without_weights(tmp_path):
+    from distriflow_tpu.models import spec_from_keras_h5
+
+    mc = {
+        "class_name": "Sequential",
+        "config": [
+            _dense_cfg("dense_1", 5, activation="linear", batch_input=[None, 4])
+        ],
+    }
+    path = _write_h5(tmp_path, mc, [])
+    spec = spec_from_keras_h5(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    assert params["dense_1"]["kernel"].shape == (4, 5)
+
+
+def test_h5_unparseable_weights_rejected(tmp_path):
+    """A populated model_weights group that the legacy attrs layout cannot
+    resolve must raise, not silently cold-init."""
+    import h5py
+
+    from distriflow_tpu.models import spec_from_keras_h5
+
+    mc = {
+        "class_name": "Sequential",
+        "config": [
+            _dense_cfg("dense_1", 5, activation="linear", batch_input=[None, 4])
+        ],
+    }
+    path = str(tmp_path / "weird.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(mc)
+        mw = f.create_group("model_weights")
+        g = mw.create_group("dense_1")  # datasets present, no attrs layout
+        g.create_dataset("kernel:0", data=np.zeros((4, 5), np.float32))
+    with pytest.raises(ValueError, match="layer_names"):
+        spec_from_keras_h5(path)
+    spec = spec_from_keras_h5(path, load_weights=False)  # explicit cold init
+    assert spec.init(jax.random.PRNGKey(0))["dense_1"]["kernel"].shape == (4, 5)
