@@ -201,8 +201,8 @@ class ShardedCheckpointStore(CheckpointStore):
     a crashed job are cleared on construction.
     """
 
-    def __init__(self, save_dir: str):
-        super().__init__(save_dir)
+    def __init__(self, save_dir: str, max_to_keep: Optional[int] = None):
+        super().__init__(save_dir, max_to_keep)
         self._seq = 0  # per-save nonce for coordination-service keys
         if jax.process_index() == 0:
             for name in os.listdir(save_dir):

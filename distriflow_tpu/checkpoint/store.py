@@ -55,10 +55,19 @@ _timestamp_version = timestamp_version  # internal alias
 
 
 class CheckpointStore:
-    """Directory-per-version checkpoints of arbitrary pytrees."""
+    """Directory-per-version checkpoints of arbitrary pytrees.
 
-    def __init__(self, save_dir: str):
+    ``max_to_keep`` bounds disk growth: after each publish, versions beyond
+    the newest N are deleted (the reference keeps every update's checkpoint
+    forever, ``server/models.ts:132-138`` — unbounded growth at one dir per
+    step). ``None`` preserves the reference behavior.
+    """
+
+    def __init__(self, save_dir: str, max_to_keep: Optional[int] = None):
+        if max_to_keep is not None and max_to_keep < 1:
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
         self.save_dir = save_dir
+        self.max_to_keep = max_to_keep
         os.makedirs(save_dir, exist_ok=True)
 
     # -- write ------------------------------------------------------------
@@ -100,16 +109,44 @@ class CheckpointStore:
         string (never in normal timestamp/step flows).
         """
         final_dir = os.path.join(self.save_dir, version)
-        trash_dir = None
-        try:
-            if os.path.isdir(final_dir):
-                trash_dir = tempfile.mkdtemp(dir=self.save_dir, prefix=".trash-")
-                os.rename(final_dir, os.path.join(trash_dir, version))
-            os.rename(src_dir, final_dir)
-        finally:
-            if trash_dir is not None:
-                shutil.rmtree(trash_dir, ignore_errors=True)
+        if os.path.isdir(final_dir):
+            # move the old version aside first so readers never see a
+            # half-deleted directory (re-saving the same version string)
+            self._trash(final_dir)
+        os.rename(src_dir, final_dir)
         self._force_symlink(version)
+        try:
+            self._prune()
+        except Exception:
+            # pruning is best-effort housekeeping: the save IS published
+            # (renamed + `current` swapped); a disk-pressure error here must
+            # not report the whole save as failed — or, in the sharded
+            # store's collective commit, abort every peer over a version
+            # that is actually live
+            pass
+
+    def _trash(self, path: str) -> None:
+        """Move a version directory aside then delete it, so readers never
+        see a half-deleted directory."""
+        trash_dir = tempfile.mkdtemp(dir=self.save_dir, prefix=".trash-")
+        try:
+            os.rename(path, os.path.join(trash_dir, os.path.basename(path)))
+        except OSError:
+            pass  # concurrent prune/delete: someone else got it
+        finally:
+            shutil.rmtree(trash_dir, ignore_errors=True)
+
+    def _prune(self) -> None:
+        """Delete versions beyond the newest ``max_to_keep`` (runs on the
+        publishing process only — multi-host safe for the sharded store)."""
+        if self.max_to_keep is None:
+            return
+        versions = self.list()
+        current = self.last()
+        for v in versions[: -self.max_to_keep]:
+            if v == current:
+                continue  # never delete the published pointer's target
+            self._trash(os.path.join(self.save_dir, v))
 
     def _force_symlink(self, version: str) -> None:
         link = os.path.join(self.save_dir, CURRENT)

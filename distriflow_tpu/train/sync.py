@@ -103,6 +103,7 @@ class SyncTrainer:
         verbose: Optional[bool] = None,
         checkpoint_dir: Optional[str] = None,
         save_every: int = 0,
+        max_checkpoints: Optional[int] = None,
         sharded_checkpoints: bool = False,
         zero_optimizer_sharding: bool = False,
     ):
@@ -132,11 +133,11 @@ class SyncTrainer:
                 # each process writes only its owned shards (multi-host scale)
                 from distriflow_tpu.checkpoint.sharded import ShardedCheckpointStore
 
-                self.store = ShardedCheckpointStore(checkpoint_dir)
+                self.store = ShardedCheckpointStore(checkpoint_dir, max_checkpoints)
             else:
                 from distriflow_tpu.checkpoint.store import CheckpointStore
 
-                self.store = CheckpointStore(checkpoint_dir)
+                self.store = CheckpointStore(checkpoint_dir, max_checkpoints)
         self._save_queue: Optional[queue.Queue] = None
         self._save_thread: Optional[threading.Thread] = None
         self._save_errors: List[Exception] = []
@@ -258,6 +259,83 @@ class SyncTrainer:
         from distriflow_tpu.utils.profiling import trace
 
         return trace(log_dir)
+
+    # rough per-chip peak dense bf16 FLOP/s by device kind, for mfu();
+    # public figures, matched by substring of jax's device_kind string
+    PEAK_BF16_FLOPS = {
+        "v6 lite": 918e12,  # Trillium / v6e
+        "v6e": 918e12,
+        "v5p": 459e12,
+        "v5 lite": 197e12,  # v5e
+        "v5e": 197e12,
+        "v4": 275e12,
+        "v3": 123e12,
+    }
+
+    def cost_analysis(self, batch: Batch) -> Dict[str, float]:
+        """XLA cost analysis of the compiled **per-device** step program
+        (flops, bytes accessed, ...). Multiply by the mesh size for whole-
+        mesh totals. Analysis only — the batch contributes shapes/dtypes
+        (lowered as ShapeDtypeStructs; no data ever moves to the device)
+        and results are cached per batch signature."""
+        if self.state is None:
+            self.init()
+        sharding = batch_sharding(self.mesh)
+        structs = jax.tree.map(
+            lambda v: jax.ShapeDtypeStruct(
+                jnp.shape(v), jnp.asarray(v).dtype if not hasattr(v, "dtype") else v.dtype,
+                sharding=sharding),
+            batch,
+        )
+        key = tuple((s.shape, str(s.dtype)) for s in jax.tree.leaves(structs))
+        cache = getattr(self, "_cost_cache", None)
+        if cache is None:
+            cache = self._cost_cache = {}
+        if key not in cache:
+            analysis = self._step_fn.lower(self.state, structs).compile().cost_analysis()
+            if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
+                analysis = analysis[0]
+            cache[key] = dict(analysis)
+        return cache[key]
+
+    def mfu(
+        self,
+        batch: Batch,
+        step_seconds: Optional[float] = None,
+        peak_flops_per_chip: Optional[float] = None,
+    ) -> float:
+        """Model FLOPs utilization of one step: per-device analyzed flops /
+        (step time x per-chip peak).
+
+        ``step_seconds`` defaults to the rolling mean of :meth:`step` wall
+        times — which includes dispatch latency, so for honest MFU on small
+        models measure through ``step_many``/``run_chunked`` and pass the
+        per-step time explicitly. ``peak_flops_per_chip`` is looked up from
+        the device kind (dense bf16 peak) when not given.
+        """
+        if step_seconds is None:
+            if self.mean_step_ms is None:
+                raise ValueError("no steps timed yet; pass step_seconds=")
+            step_seconds = self.mean_step_ms / 1e3
+        if peak_flops_per_chip is None:
+            kind = jax.devices()[0].device_kind
+            for key, peak in self.PEAK_BF16_FLOPS.items():
+                if key in kind.lower():
+                    peak_flops_per_chip = peak
+                    break
+            else:
+                raise ValueError(
+                    f"unknown device kind {kind!r}; pass peak_flops_per_chip="
+                )
+        analysis = self.cost_analysis(batch)
+        if not analysis.get("flops"):
+            # a 0.0 here would read as "fully dispatch-bound", not "backend
+            # reports no flop counts" — fail loudly like the unknown-kind path
+            raise ValueError(
+                "compiled-step cost analysis reports no 'flops' on this "
+                f"backend (keys: {sorted(analysis)}); MFU unavailable"
+            )
+        return float(analysis["flops"]) / (step_seconds * peak_flops_per_chip)
 
     # -- checkpointing -----------------------------------------------------
 

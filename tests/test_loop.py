@@ -103,3 +103,43 @@ def test_with_uint8_inputs_rejects_float_stream():
     params = spec.init(jax.random.PRNGKey(0))
     with pytest.raises(TypeError, match="uint8"):
         spec.apply(params, jnp.ones((2, 28, 28, 1), jnp.float32))
+
+
+def test_cost_analysis_and_mfu(devices):
+    t = _trainer(devices)
+    batch = next(_stream(1))
+    ca = t.cost_analysis(batch)
+    assert ca.get("flops", 0) > 0
+    # explicit knobs: mfu = flops / (t * peak)
+    got = t.mfu(batch, step_seconds=1.0, peak_flops_per_chip=ca["flops"])
+    np.testing.assert_allclose(got, 1.0, rtol=1e-6)
+    with pytest.raises(ValueError, match="step_seconds"):
+        t.mfu(batch)  # nothing timed yet
+
+
+def test_checkpoint_max_to_keep(tmp_path, devices):
+    from distriflow_tpu.checkpoint.store import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "ck"), max_to_keep=3)
+    for i in range(7):
+        store.save({"w": np.full((2,), i, np.float32)}, version=str(i))
+    assert store.list() == ["4", "5", "6"]
+    assert store.last() == "6"
+    # newest survives intact
+    loaded = store.load("6", {"w": np.zeros(2, np.float32)})
+    np.testing.assert_allclose(loaded["w"], 6.0)
+    with pytest.raises(ValueError, match="max_to_keep"):
+        CheckpointStore(str(tmp_path / "bad"), max_to_keep=0)
+
+
+def test_trainer_max_checkpoints(tmp_path, devices):
+    t = SyncTrainer(
+        mnist_mlp(hidden=8), mesh=data_parallel_mesh(devices),
+        learning_rate=0.01, checkpoint_dir=str(tmp_path / "ck"),
+        save_every=1, max_checkpoints=2,
+    )
+    t.init(jax.random.PRNGKey(0))
+    for batch in _stream(5):
+        t.step(batch)
+    t.close()
+    assert len(t.store.list()) <= 2
