@@ -23,6 +23,7 @@ import os
 import shutil
 import tempfile
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -117,13 +118,18 @@ class CheckpointStore:
         self._force_symlink(version)
         try:
             self._prune()
-        except Exception:
+        except Exception as e:
             # pruning is best-effort housekeeping: the save IS published
             # (renamed + `current` swapped); a disk-pressure error here must
             # not report the whole save as failed — or, in the sharded
             # store's collective commit, abort every peer over a version
-            # that is actually live
-            pass
+            # that is actually live. But say so: a persistently failing
+            # prune means max_to_keep has silently stopped bounding disk.
+            warnings.warn(
+                f"checkpoint prune failed after publishing {version}: {e!r} "
+                "(save succeeded; old versions may accumulate)",
+                stacklevel=2,
+            )
 
     def _trash(self, path: str) -> None:
         """Move a version directory aside then delete it, so readers never

@@ -59,6 +59,23 @@ def _truncate_logits(
     return logits
 
 
+def _decode_module(config: TransformerConfig) -> TransformerLM:
+    """The decode-mode module all decoding paths share: sharded-attention
+    variants never apply to incremental decoding."""
+    cfg = dataclasses.replace(
+        config, use_ring_attention=False, use_ulysses_attention=False
+    )
+    return TransformerLM(cfg, mesh=None, decode=True)
+
+
+def _check_fits(p: int, n_tokens: int, config: TransformerConfig) -> None:
+    if p + n_tokens > config.max_seq:
+        raise ValueError(
+            f"prompt ({p}) + n_tokens ({n_tokens}) exceeds max_seq "
+            f"({config.max_seq}); raise config.max_seq"
+        )
+
+
 @functools.lru_cache(maxsize=32)
 def _build_fns(
     config: TransformerConfig,
@@ -70,10 +87,7 @@ def _build_fns(
     """Jit-compiled prefill + decode scan, cached so repeated generate()
     calls with the same config/shape hit the jit cache instead of paying
     full XLA recompilation per call."""
-    cfg = dataclasses.replace(
-        config, use_ring_attention=False, use_ulysses_attention=False
-    )  # decode modules never take the sharded-attention paths
-    module = TransformerLM(cfg, mesh=None, decode=True)
+    module = _decode_module(config)
 
     @jax.jit
     def prefill(params, prompt):
@@ -105,6 +119,136 @@ def _build_fns(
     return prefill, pick, decode_steps
 
 
+@functools.lru_cache(maxsize=16)
+def _build_beam_fns(
+    config: TransformerConfig,
+    n_tokens: int,
+    beam_size: int,
+    length_penalty: float,
+    eos_id: Optional[int],
+):
+    """Jit-compiled prefill + beam-scan. Cached per decode signature."""
+    module = _decode_module(config)
+    vocab = config.vocab_size
+    neg = jnp.float32(-1e30)
+
+    def _reorder(cache, flat_idx, rows):
+        """Gather cache rows (leading dim == rows) by flat_idx; leave
+        scalars (cache_index) untouched."""
+        return jax.tree.map(
+            lambda v: v[flat_idx] if (v.ndim >= 1 and v.shape[0] == rows) else v,
+            cache,
+        )
+
+    def _penalize(scores, lengths):
+        # GNMT length penalty ((5+len)/6)^alpha; alpha=0 -> raw scores
+        if length_penalty == 0.0:
+            return scores
+        return scores / (((5.0 + lengths) / 6.0) ** length_penalty)
+
+    @jax.jit
+    def search(params, prompt):
+        b, p = prompt.shape
+        beam = beam_size
+        logits, vars_ = module.apply(params, prompt, mutable=["cache"])
+        logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+        scores, first = jax.lax.top_k(logp0, beam)  # [B, beam]
+        # tile the prefix cache: batch row i serves beams i*beam..i*beam+beam-1
+        tile = jnp.repeat(jnp.arange(b), beam)
+        cache = _reorder(vars_["cache"], tile, b)
+        rows = b * beam
+        seqs = jnp.zeros((rows, n_tokens), jnp.int32)
+        seqs = seqs.at[:, 0].set(first.reshape(rows))
+        flat_scores = scores.reshape(rows)
+        finished = (
+            (first.reshape(rows) == eos_id) if eos_id is not None
+            else jnp.zeros((rows,), bool)
+        )
+        lengths = jnp.ones((rows,), jnp.float32)
+
+        def step(carry, t):
+            cache, seqs, flat_scores, finished, lengths = carry
+            last = jax.lax.dynamic_index_in_dim(seqs.T, t - 1, 0, keepdims=False)
+            logits, vars_ = module.apply(
+                {**params, "cache": cache}, last[:, None], mutable=["cache"]
+            )
+            logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [rows, V]
+            if eos_id is not None:
+                # a finished beam may only repeat eos at zero added score
+                only_eos = jnp.full_like(logp, neg).at[:, eos_id].set(0.0)
+                logp = jnp.where(finished[:, None], only_eos, logp)
+            total = flat_scores[:, None] + logp  # [rows, V] raw cumulative
+            # prune by the SAME objective the final winner is ranked with:
+            # penalize each candidate by its length (finished beams keep
+            # their frozen length, live ones grow by this token)
+            cand_len = lengths + jnp.where(finished, 0.0, 1.0)
+            ranked_view = _penalize(total, cand_len[:, None]).reshape(
+                b, beam * vocab
+            )
+            _, idx = jax.lax.top_k(ranked_view, beam)  # [B, beam]
+            new_scores = jnp.take_along_axis(  # carry RAW scores forward
+                total.reshape(b, beam * vocab), idx, axis=-1
+            )
+            parent = idx // vocab  # beam index within batch row
+            token = (idx % vocab).astype(jnp.int32)
+            flat_parent = (
+                jnp.arange(b)[:, None] * beam + parent
+            ).reshape(rows)
+            cache = _reorder(vars_["cache"], flat_parent, rows)
+            seqs = seqs[flat_parent].at[:, t].set(token.reshape(rows))
+            was_finished = finished[flat_parent]
+            lengths = lengths[flat_parent] + jnp.where(was_finished, 0.0, 1.0)
+            if eos_id is not None:
+                finished = was_finished | (token.reshape(rows) == eos_id)
+            return (cache, seqs, new_scores.reshape(rows), finished, lengths), None
+
+        if n_tokens > 1:
+            (cache, seqs, flat_scores, finished, lengths), _ = jax.lax.scan(
+                step,
+                (cache, seqs, flat_scores, finished, lengths),
+                jnp.arange(1, n_tokens),
+            )
+        ranked = _penalize(flat_scores.reshape(b, beam), lengths.reshape(b, beam))
+        best = jnp.argmax(ranked, axis=-1)  # [B]
+        pick = jnp.arange(b) * beam + best
+        out = jnp.concatenate([prompt, seqs[pick]], axis=1)
+        return out, ranked[jnp.arange(b), best]
+
+    return search
+
+
+def beam_search(
+    config: TransformerConfig,
+    params,
+    prompt: jnp.ndarray,
+    n_tokens: int,
+    beam_size: int = 4,
+    length_penalty: float = 0.0,
+    eos_id: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam-search decode: returns ``(tokens [B, P+n_tokens], scores [B])``.
+
+    The KV cache is tiled to ``B x beam_size`` rows after prefill and
+    re-gathered along the batch axis at every step as beams reorder — the
+    whole search (prefill + ``lax.scan`` over steps) is one jit-compiled
+    program per ``(config, n_tokens, beam_size, ...)`` signature.
+    ``eos_id`` freezes finished beams (they repeat eos at zero added
+    score); ``length_penalty`` is the GNMT ``((5+len)/6)^alpha`` form,
+    only meaningful when beams can finish at different lengths.
+    """
+    b, p = prompt.shape
+    if not 1 <= beam_size <= config.vocab_size:
+        raise ValueError(
+            f"beam_size must be in [1, vocab_size={config.vocab_size}], "
+            f"got {beam_size}"
+        )
+    if n_tokens <= 0:
+        return prompt, jnp.zeros((b,), jnp.float32)
+    _check_fits(p, n_tokens, config)
+    search = _build_beam_fns(config, n_tokens, beam_size, length_penalty, eos_id)
+    return search(params, jnp.asarray(prompt, jnp.int32))
+
+
 def generate(
     config: TransformerConfig,
     params,
@@ -127,11 +271,7 @@ def generate(
     b, p = prompt.shape
     if n_tokens <= 0:
         return prompt
-    if p + n_tokens > config.max_seq:
-        raise ValueError(
-            f"prompt ({p}) + n_tokens ({n_tokens}) exceeds max_seq "
-            f"({config.max_seq}); raise config.max_seq"
-        )
+    _check_fits(p, n_tokens, config)
     if temperature > 0 and rng is None:
         raise ValueError("temperature sampling needs rng=jax.random.PRNGKey(...)")
     if top_k is not None and top_k < 1:

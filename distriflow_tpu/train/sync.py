@@ -124,6 +124,7 @@ class SyncTrainer:
         # observability (reference time()/log wrappers, abstract_server.ts:92-103)
         self.last_step_ms: Optional[float] = None
         self._step_times: List[float] = []  # rolling window
+        self._cost_cache: Dict[Any, Dict[str, float]] = {}  # per batch signature
         # checkpointing (reference saves on every update, server/models.ts:132-138;
         # here save_every is explicit and the write happens off-thread)
         self.store = None
@@ -288,15 +289,12 @@ class SyncTrainer:
             batch,
         )
         key = tuple((s.shape, str(s.dtype)) for s in jax.tree.leaves(structs))
-        cache = getattr(self, "_cost_cache", None)
-        if cache is None:
-            cache = self._cost_cache = {}
-        if key not in cache:
+        if key not in self._cost_cache:
             analysis = self._step_fn.lower(self.state, structs).compile().cost_analysis()
             if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
                 analysis = analysis[0]
-            cache[key] = dict(analysis)
-        return cache[key]
+            self._cost_cache[key] = dict(analysis)
+        return self._cost_cache[key]
 
     def mfu(
         self,

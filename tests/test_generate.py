@@ -203,3 +203,83 @@ def test_sampling_param_validation():
     with pytest.raises(ValueError, match="top_p"):
         generate(CFG, params, prompt, n_tokens=2, temperature=1.0,
                  rng=jax.random.PRNGKey(0), top_p=1.5)
+
+
+def test_beam_size_1_matches_greedy():
+    from distriflow_tpu.models import beam_search
+
+    params = _params(CFG)
+    prompt = jnp.asarray([[1, 2, 3], [7, 8, 9]], jnp.int32)
+    greedy = generate(CFG, params, prompt, n_tokens=7)
+    beams, scores = beam_search(CFG, params, prompt, n_tokens=7, beam_size=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(beams))
+    assert scores.shape == (2,)
+
+
+def test_beam_search_scores_are_true_logprobs():
+    """The returned score equals the teacher-forced log-probability of the
+    returned continuation under the training-mode forward."""
+    from distriflow_tpu.models import beam_search
+    from distriflow_tpu.models.transformer import TransformerLM
+
+    params = _params(CFG)
+    prompt = jnp.asarray([[4, 5, 6, 7]], jnp.int32)
+    n = 6
+    out, scores = beam_search(CFG, params, prompt, n_tokens=n, beam_size=3)
+    assert out.shape == (1, 10)
+    full_logits = TransformerLM(CFG, mesh=None).apply(params, out[:, :-1])
+    logp = jax.nn.log_softmax(full_logits.astype(jnp.float32), axis=-1)
+    p = prompt.shape[1]
+    want = sum(
+        float(logp[0, p - 1 + i, int(out[0, p + i])]) for i in range(n)
+    )
+    np.testing.assert_allclose(float(scores[0]), want, rtol=1e-4)
+
+
+def test_beam_search_beats_or_matches_greedy_logprob():
+    from distriflow_tpu.models import beam_search
+    from distriflow_tpu.models.transformer import TransformerLM
+
+    params = _params(CFG)
+    prompt = jnp.asarray([[2, 3, 4, 5]], jnp.int32)
+    n = 8
+
+    def seq_logprob(tokens):
+        logits = TransformerLM(CFG, mesh=None).apply(params, tokens[:, :-1])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        p = prompt.shape[1]
+        return sum(
+            float(logp[0, p - 1 + i, int(tokens[0, p + i])]) for i in range(n)
+        )
+
+    greedy = generate(CFG, params, prompt, n_tokens=n)
+    _, scores = beam_search(CFG, params, prompt, n_tokens=n, beam_size=4)
+    # beam-4's best is at least as likely as the pure greedy rollout here
+    assert float(scores[0]) >= seq_logprob(greedy) - 1e-4
+
+
+def test_beam_search_eos_freezes_beams():
+    from distriflow_tpu.models import beam_search
+
+    params = _params(CFG)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    eos = 0
+    out, scores = beam_search(
+        CFG, params, prompt, n_tokens=10, beam_size=3, eos_id=eos,
+        length_penalty=0.6,
+    )
+    gen = np.asarray(out[0, 3:])
+    hits = np.where(gen == eos)[0]
+    if len(hits):  # once eos appears, only eos follows
+        assert np.all(gen[hits[0]:] == eos)
+
+
+def test_beam_search_validation():
+    from distriflow_tpu.models import beam_search
+
+    params = _params(CFG)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="beam_size"):
+        beam_search(CFG, params, prompt, n_tokens=2, beam_size=0)
+    with pytest.raises(ValueError, match="max_seq"):
+        beam_search(CFG, params, prompt, n_tokens=CFG.max_seq, beam_size=2)
