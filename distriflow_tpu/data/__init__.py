@@ -7,6 +7,7 @@ from distriflow_tpu.data.dataset import (
     sample_batch,
 )
 from distriflow_tpu.data.prefetch import prefetch_to_device, sampling_iterator
+from distriflow_tpu.data.streaming import StreamingTokenDataset, write_token_file
 
 __all__ = [
     "Batch",
@@ -15,4 +16,6 @@ __all__ = [
     "sample_batch",
     "prefetch_to_device",
     "sampling_iterator",
+    "StreamingTokenDataset",
+    "write_token_file",
 ]

@@ -242,6 +242,12 @@ def beam_search(
             f"beam_size must be in [1, vocab_size={config.vocab_size}], "
             f"got {beam_size}"
         )
+    if eos_id is not None and not 0 <= eos_id < config.vocab_size:
+        # an out-of-range id would silently never freeze any beam (oob
+        # scatter is dropped under jit) — fail loudly instead
+        raise ValueError(
+            f"eos_id {eos_id} out of range for vocab_size {config.vocab_size}"
+        )
     if n_tokens <= 0:
         return prompt, jnp.zeros((b,), jnp.float32)
     _check_fits(p, n_tokens, config)

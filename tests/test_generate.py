@@ -283,3 +283,13 @@ def test_beam_search_validation():
         beam_search(CFG, params, prompt, n_tokens=2, beam_size=0)
     with pytest.raises(ValueError, match="max_seq"):
         beam_search(CFG, params, prompt, n_tokens=CFG.max_seq, beam_size=2)
+
+
+def test_beam_search_rejects_bad_eos():
+    from distriflow_tpu.models import beam_search
+
+    params = _params(CFG)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="eos_id"):
+        beam_search(CFG, params, prompt, n_tokens=2, beam_size=2,
+                    eos_id=CFG.vocab_size)

@@ -1,0 +1,141 @@
+"""StreamingTokenDataset: memmap windows, per-process sharding, resume."""
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.data import StreamingTokenDataset, write_token_file
+
+
+def _corpus(tmp_path, n=10_000, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, vocab, n)
+    return write_token_file(str(tmp_path / "corpus"), tokens), tokens
+
+
+def test_dtype_selection(tmp_path):
+    import json
+
+    p = write_token_file(str(tmp_path / "a"), np.arange(200))
+    assert json.load(open(p + ".json"))["dtype"] == "uint8"
+    p = write_token_file(str(tmp_path / "b"), np.arange(50_000))
+    assert json.load(open(p + ".json"))["dtype"] == "uint16"
+    p = write_token_file(str(tmp_path / "c"), np.arange(70_000))
+    assert json.load(open(p + ".json"))["dtype"] == "int32"
+
+
+def test_windows_are_real_next_token_pairs(tmp_path):
+    path, tokens = _corpus(tmp_path)
+    ds = StreamingTokenDataset(path, seq_len=16, batch_size=4,
+                               process_index=0, process_count=1)
+    x, y = next(ds)
+    assert x.shape == y.shape == (4, 16)
+    np.testing.assert_array_equal(x[:, 1:], y[:, :-1])  # shifted by one
+    # every row is a contiguous slice of the corpus
+    window = 17
+    for row in range(4):
+        starts = [
+            w * window for w in range(len(tokens) // window)
+            if np.array_equal(tokens[w * window : w * window + 16], x[row])
+        ]
+        assert starts, "row is not a corpus window"
+
+
+def test_process_shards_are_disjoint_and_cover(tmp_path):
+    path, _ = _corpus(tmp_path)
+    n_proc = 4
+    seen = []
+    for p in range(n_proc):
+        ds = StreamingTokenDataset(path, seq_len=16, batch_size=8, seed=7,
+                                   process_index=p, process_count=n_proc)
+        rows = set()
+        for x, _ in ds.take(ds.batches_per_epoch):
+            for r in x:
+                rows.add(tuple(r.tolist()))
+        seen.append(rows)
+    for i in range(n_proc):
+        for j in range(i + 1, n_proc):
+            assert not (seen[i] & seen[j]), f"shards {i},{j} overlap"
+
+
+def test_epochs_reshuffle_deterministically(tmp_path):
+    path, _ = _corpus(tmp_path)
+
+    def run():
+        ds = StreamingTokenDataset(path, seq_len=16, batch_size=8, seed=3,
+                                   process_index=0, process_count=1)
+        return [x.copy() for x, _ in ds.take(2 * ds.batches_per_epoch)]
+
+    a, b = run(), run()
+    for xa, xb in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)  # same seed -> same stream
+    n = len(a) // 2
+    assert not all(
+        np.array_equal(a[i], a[n + i]) for i in range(n)
+    ), "epoch 1 must reshuffle"
+
+
+def test_resume_replays_exactly(tmp_path):
+    path, _ = _corpus(tmp_path)
+    kw = dict(seq_len=16, batch_size=8, seed=5, process_index=0, process_count=1)
+    ds = StreamingTokenDataset(path, **kw)
+    for _ in ds.take(5):
+        pass
+    cursor = ds.state()
+    want = [x.copy() for x, _ in ds.take(4)]
+
+    ds2 = StreamingTokenDataset(path, **kw)
+    ds2.restore(cursor)
+    got = [x.copy() for x, _ in ds2.take(4)]
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+
+
+def test_resume_rejects_mismatched_layout(tmp_path):
+    path, _ = _corpus(tmp_path)
+    ds = StreamingTokenDataset(path, seq_len=16, batch_size=8, seed=5,
+                               process_index=0, process_count=1)
+    cursor = ds.state()
+    other = StreamingTokenDataset(path, seq_len=16, batch_size=8, seed=6,
+                                  process_index=0, process_count=1)
+    with pytest.raises(ValueError, match="seed"):
+        other.restore(cursor)
+
+
+def test_too_small_corpus_rejected(tmp_path):
+    path = write_token_file(str(tmp_path / "tiny"), np.arange(40))
+    with pytest.raises(ValueError, match="not enough"):
+        StreamingTokenDataset(path, seq_len=64, batch_size=8,
+                              process_index=0, process_count=1)
+
+
+def test_trains_through_run_chunked(tmp_path, devices):
+    import jax
+
+    from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+    from distriflow_tpu.parallel import data_parallel_mesh
+    from distriflow_tpu.train import run_chunked
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    path, _ = _corpus(tmp_path, n=60_000, vocab=64)
+    ds = StreamingTokenDataset(path, seq_len=32, batch_size=8,
+                               process_index=0, process_count=1)
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=1,
+                            d_ff=64, max_seq=32, use_flash_attention=False)
+    tr = SyncTrainer(transformer_lm(cfg, example_seq=32),
+                     mesh=data_parallel_mesh(devices), learning_rate=1e-2,
+                     optimizer="adam")
+    tr.init(jax.random.PRNGKey(0))
+    res = run_chunked(tr, ds, steps=12, steps_per_dispatch=4)
+    assert res.steps_run == 12
+    assert np.isfinite(res.last_loss)
+
+
+def test_resume_rejects_mismatched_geometry(tmp_path):
+    path, _ = _corpus(tmp_path)
+    ds = StreamingTokenDataset(path, seq_len=16, batch_size=8, seed=5,
+                               process_index=0, process_count=1)
+    cursor = ds.state()
+    other = StreamingTokenDataset(path, seq_len=16, batch_size=16, seed=5,
+                                  process_index=0, process_count=1)
+    with pytest.raises(ValueError, match="batch_size"):
+        other.restore(cursor)
