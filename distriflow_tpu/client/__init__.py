@@ -10,6 +10,7 @@ from distriflow_tpu.client.abstract_client import (
 )
 from distriflow_tpu.client.async_client import AsynchronousSGDClient
 from distriflow_tpu.client.federated_client import FederatedClient
+from distriflow_tpu.client.inference_client import InferenceClient
 
 __all__ = [
     "AbstractClient",
@@ -17,4 +18,5 @@ __all__ = [
     "resolve_client_id",
     "AsynchronousSGDClient",
     "FederatedClient",
+    "InferenceClient",
 ]

@@ -1,0 +1,112 @@
+"""Inference client: remote generate / beam-search over the wire.
+
+Counterpart to :class:`distriflow_tpu.server.InferenceServer`; the same
+connect-then-request lifecycle as the training clients
+(``client/abstract_client.py``), but requests are synchronous
+decode calls whose ack carries the result.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from distriflow_tpu.comm.transport import ClientTransport
+from distriflow_tpu.utils.serialization import (
+    deserialize_array,
+    pack_bytes,
+    serialize_array,
+    unpack_bytes,
+)
+
+DECODE_TIMEOUT_S = 120.0  # first request pays XLA compilation on the server
+
+
+class InferenceClient:
+    """Remote decoding against an :class:`InferenceServer`."""
+
+    def __init__(self, address: str, timeout: float = DECODE_TIMEOUT_S):
+        self.address = address
+        self.timeout = timeout
+        self.transport = ClientTransport(address)
+        self._connected = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def setup(self) -> "InferenceClient":
+        self.transport.connect()
+        self._connected = True
+        return self
+
+    def close(self) -> None:
+        if self._connected:
+            self.transport.close()
+            self._connected = False
+
+    def __enter__(self) -> "InferenceClient":
+        return self.setup()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- API ---------------------------------------------------------------
+
+    def model_info(self) -> Dict[str, Any]:
+        return self._request("model_info", {})
+
+    def generate(
+        self,
+        prompt: np.ndarray,
+        n_tokens: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """Remote :func:`distriflow_tpu.models.generate`; returns
+        ``[B, P + n_tokens]`` int32."""
+        payload = self._prompt_payload(prompt)
+        payload.update(
+            n_tokens=int(n_tokens), temperature=float(temperature),
+            top_k=top_k, top_p=top_p, seed=int(seed),
+        )
+        result = unpack_bytes(self._request("generate", payload)["result"])
+        return deserialize_array(result["tokens"])
+
+    def beam_search(
+        self,
+        prompt: np.ndarray,
+        n_tokens: int,
+        beam_size: int = 4,
+        length_penalty: float = 0.0,
+        eos_id: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Remote :func:`distriflow_tpu.models.beam_search`; returns
+        ``(tokens [B, P + n_tokens], scores [B])``."""
+        payload = self._prompt_payload(prompt)
+        payload.update(
+            n_tokens=int(n_tokens), beam_size=int(beam_size),
+            length_penalty=float(length_penalty), eos_id=eos_id,
+        )
+        result = unpack_bytes(self._request("beam", payload)["result"])
+        return deserialize_array(result["tokens"]), deserialize_array(result["scores"])
+
+    # -- internals ---------------------------------------------------------
+
+    def _request(self, event: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        result = self.transport.request(event, payload, timeout=self.timeout)
+        if result is None:
+            # the transport acks None when the server handler raised
+            raise RuntimeError(
+                f"server failed to handle {event!r} (bad arguments, or see "
+                "server log)"
+            )
+        return result
+
+    @staticmethod
+    def _prompt_payload(prompt: np.ndarray) -> Dict[str, Any]:
+        arr = np.asarray(prompt, np.int32)
+        if arr.ndim != 2:
+            raise ValueError(f"prompt must be [B, P], got shape {arr.shape}")
+        return {"prompt": pack_bytes({"tokens": serialize_array(arr)})}

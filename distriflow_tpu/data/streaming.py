@@ -41,14 +41,20 @@ def write_token_file(path: str, tokens: np.ndarray) -> str:
     tokens = np.asarray(tokens)
     if tokens.ndim != 1:
         raise ValueError(f"tokens must be 1-D, got shape {tokens.shape}")
-    if tokens.size and int(tokens.min()) < 0:
-        dtype = np.int32
-    elif not tokens.size or int(tokens.max()) < 256:
+    lo = int(tokens.min()) if tokens.size else 0
+    hi = int(tokens.max()) if tokens.size else 0
+    if lo < 0:
+        dtype = np.int32 if lo >= -(2**31) and hi < 2**31 else np.int64
+    elif hi < 256:
         dtype = np.uint8
-    elif int(tokens.max()) < 65536:
+    elif hi < 65536:
         dtype = np.uint16
-    else:
+    elif hi < 2**31:
         dtype = np.int32
+    elif hi < 2**32:
+        dtype = np.uint32
+    else:
+        dtype = np.int64
     data = np.ascontiguousarray(tokens.astype(dtype))
     with open(path + ".bin", "wb") as f:
         f.write(data.tobytes())

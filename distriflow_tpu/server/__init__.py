@@ -6,6 +6,7 @@ Re-exports mirror the reference ``src/server/index.ts:1-5``.
 from distriflow_tpu.server.abstract_server import AbstractServer, DistributedServerConfig
 from distriflow_tpu.server.async_server import AsynchronousSGDServer
 from distriflow_tpu.server.federated_server import FederatedServer
+from distriflow_tpu.server.inference_server import InferenceServer
 from distriflow_tpu.server.models import (
     DistributedServerCheckpointedModel,
     DistributedServerInMemoryModel,
@@ -18,6 +19,7 @@ __all__ = [
     "DistributedServerConfig",
     "AsynchronousSGDServer",
     "FederatedServer",
+    "InferenceServer",
     "DistributedServerCheckpointedModel",
     "DistributedServerInMemoryModel",
     "DistributedServerModel",

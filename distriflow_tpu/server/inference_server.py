@@ -1,0 +1,152 @@
+"""Inference server: serve KV-cache decoding over the wire transport.
+
+The reference's architecture is a training hub (server owns the model,
+workers push gradients); this extends the same server/client split to
+inference — a host that owns device-resident params answers generate /
+beam-search requests from remote clients over the framework's native
+transport (length-prefixed binary frames + acks, ``comm/transport.py``),
+reusing ``DownloadMsg``-style dict payloads with packed int32 token
+buffers.
+
+Events:
+
+- ``model_info``  -> {vocab_size, max_seq, d_model, n_layers, name}
+- ``generate``    {tokens: bytes, shape, n_tokens, temperature?, top_k?,
+  top_p?, seed?} -> {tokens: bytes, shape}
+- ``beam``        {tokens, shape, n_tokens, beam_size?, length_penalty?,
+  eos_id?} -> {tokens, shape, scores: bytes}
+
+Decoding runs through the same jit-cached :func:`generate` /
+:func:`beam_search` programs the local API uses; a lock serializes device
+work across concurrent client requests (one TPU program at a time — the
+transport's handler pool would otherwise interleave compilations).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from distriflow_tpu.comm.transport import ServerTransport
+from distriflow_tpu.models.generate import beam_search, generate
+from distriflow_tpu.models.transformer import TransformerConfig
+from distriflow_tpu.utils.logging import VerboseLogger
+from distriflow_tpu.utils.serialization import (
+    deserialize_array,
+    pack_bytes,
+    serialize_array,
+    unpack_bytes,
+)
+
+MAX_PROMPT_BATCH = 64  # refuse absurd wire batches before touching the device
+
+
+def _prompt_from(payload: Dict[str, Any]) -> np.ndarray:
+    arr = deserialize_array(unpack_bytes(payload["prompt"])["tokens"])
+    if arr.ndim != 2:
+        raise ValueError(f"prompt must be [B, P], got shape {arr.shape}")
+    if not 1 <= arr.shape[0] <= MAX_PROMPT_BATCH:
+        raise ValueError(
+            f"prompt batch {arr.shape[0]} outside [1, {MAX_PROMPT_BATCH}]"
+        )
+    if not np.issubdtype(arr.dtype, np.integer):
+        raise ValueError(f"prompt must be integer tokens, got {arr.dtype}")
+    return arr.astype(np.int32)
+
+
+class InferenceServer:
+    """Serve a trained LM's decoding over the native transport."""
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        params: Any,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        verbose: Optional[bool] = None,
+    ):
+        self.config = config
+        self.params = params
+        self.logger = VerboseLogger("InferenceServer", verbose)
+        self._device_lock = threading.Lock()  # one device program at a time
+        self.transport = ServerTransport(host, port)
+        self.transport.on("model_info", self._on_info)
+        self.transport.on("generate", self._on_generate)
+        self.transport.on("beam", self._on_beam)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def setup(self) -> "InferenceServer":
+        self.transport.start()
+        self.logger.log(f"serving on {self.address}")
+        return self
+
+    def stop(self) -> None:
+        self.transport.stop()
+
+    @property
+    def address(self) -> str:
+        return self.transport.address
+
+    def set_params(self, params: Any) -> None:
+        """Swap serving weights (e.g. after a training round); in-flight
+        requests finish on the old params."""
+        with self._device_lock:
+            self.params = params
+
+    # -- handlers (run in the transport's executor; return value = ack) ----
+
+    def _on_info(self, client_id: str, payload: Any) -> Dict[str, Any]:
+        cfg = self.config
+        return {
+            "name": "transformer_lm",
+            "vocab_size": cfg.vocab_size,
+            "max_seq": cfg.max_seq,
+            "d_model": cfg.d_model,
+            "n_layers": cfg.n_layers,
+            "n_heads": cfg.n_heads,
+        }
+
+    def _on_generate(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = _prompt_from(payload)
+        n_tokens = int(payload["n_tokens"])
+        temperature = float(payload.get("temperature", 0.0))
+        top_k = payload.get("top_k")
+        top_p = payload.get("top_p")
+        seed = int(payload.get("seed", 0))
+        with self._device_lock, self.logger.time(
+            f"generate[{prompt.shape[0]}x{prompt.shape[1]}+{n_tokens}]"
+        ):
+            out = generate(
+                self.config, self.params, prompt, n_tokens,
+                temperature=temperature,
+                top_k=int(top_k) if top_k is not None else None,
+                top_p=float(top_p) if top_p is not None else None,
+                rng=jax.random.PRNGKey(seed),
+            )
+        return {"result": pack_bytes({"tokens": serialize_array(out)})}
+
+    def _on_beam(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = _prompt_from(payload)
+        n_tokens = int(payload["n_tokens"])
+        # .get with a default, NOT `or`: an explicit beam_size=0 must reach
+        # beam_search's validation, not silently become the default
+        beam_size = int(payload.get("beam_size", 4))
+        length_penalty = float(payload.get("length_penalty", 0.0))
+        eos_id = payload.get("eos_id")
+        with self._device_lock, self.logger.time(
+            f"beam[{prompt.shape[0]}x{prompt.shape[1]}+{n_tokens} k={beam_size}]"
+        ):
+            out, scores = beam_search(
+                self.config, self.params, prompt, n_tokens,
+                beam_size=beam_size, length_penalty=length_penalty,
+                eos_id=int(eos_id) if eos_id is not None else None,
+            )
+        return {
+            "result": pack_bytes(
+                {"tokens": serialize_array(out), "scores": serialize_array(scores)}
+            )
+        }

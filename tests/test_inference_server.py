@@ -1,0 +1,88 @@
+"""Inference server/client over the real loopback transport."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distriflow_tpu.client import InferenceClient
+from distriflow_tpu.models import beam_search, generate
+from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+from distriflow_tpu.server import InferenceServer
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=32,
+    dtype=jnp.float32, use_flash_attention=False,
+)
+
+
+@pytest.fixture(scope="module")
+def served():
+    spec = transformer_lm(CFG, example_seq=16)
+    params = spec.init(jax.random.PRNGKey(0))
+    server = InferenceServer(CFG, params, port=0).setup()
+    client = InferenceClient(server.address).setup()
+    yield server, client, params
+    client.close()
+    server.stop()
+
+
+def test_model_info(served):
+    _, client, _ = served
+    info = client.model_info()
+    assert info["vocab_size"] == 64 and info["max_seq"] == 32
+
+
+def test_remote_generate_matches_local(served):
+    _, client, params = served
+    prompt = np.asarray([[1, 2, 3], [9, 8, 7]], np.int32)
+    remote = client.generate(prompt, n_tokens=6)
+    local = np.asarray(generate(CFG, params, jnp.asarray(prompt), 6))
+    np.testing.assert_array_equal(remote, local)
+
+
+def test_remote_sampling_deterministic_by_seed(served):
+    _, client, _ = served
+    prompt = np.asarray([[4, 5]], np.int32)
+    a = client.generate(prompt, n_tokens=6, temperature=0.8, top_k=8, seed=3)
+    b = client.generate(prompt, n_tokens=6, temperature=0.8, top_k=8, seed=3)
+    c = client.generate(prompt, n_tokens=6, temperature=0.8, top_k=8, seed=4)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == c.shape == (1, 8)
+
+
+def test_remote_beam_matches_local(served):
+    _, client, params = served
+    prompt = np.asarray([[2, 3, 4]], np.int32)
+    remote_toks, remote_scores = client.beam_search(prompt, n_tokens=5, beam_size=3)
+    local_toks, local_scores = beam_search(
+        CFG, params, jnp.asarray(prompt), 5, beam_size=3
+    )
+    np.testing.assert_array_equal(remote_toks, np.asarray(local_toks))
+    np.testing.assert_allclose(remote_scores, np.asarray(local_scores), rtol=1e-5)
+
+
+def test_bad_request_raises_clean_error(served):
+    _, client, _ = served
+    with pytest.raises(RuntimeError, match="server failed"):
+        # prompt longer than max_seq: server-side validation error
+        client.generate(np.zeros((1, 40), np.int32), n_tokens=10)
+    # the connection survives a failed request
+    out = client.generate(np.asarray([[1, 2]], np.int32), n_tokens=2)
+    assert out.shape == (1, 4)
+
+
+def test_set_params_swaps_serving_weights(served):
+    server, client, params = served
+    prompt = np.asarray([[7, 8, 9]], np.int32)
+    before = client.generate(prompt, n_tokens=6)
+    spec = transformer_lm(CFG, example_seq=16)
+    other = spec.init(jax.random.PRNGKey(123))
+    server.set_params(other)
+    try:
+        after = client.generate(prompt, n_tokens=6)
+        local = np.asarray(generate(CFG, other, jnp.asarray(prompt), 6))
+        np.testing.assert_array_equal(after, local)
+        assert not np.array_equal(before, after)
+    finally:
+        server.set_params(params)

@@ -21,6 +21,13 @@ def test_dtype_selection(tmp_path):
     assert json.load(open(p + ".json"))["dtype"] == "uint16"
     p = write_token_file(str(tmp_path / "c"), np.arange(70_000))
     assert json.load(open(p + ".json"))["dtype"] == "int32"
+    p = write_token_file(str(tmp_path / "d"), np.array([0, 2**31], np.int64))
+    assert json.load(open(p + ".json"))["dtype"] == "uint32"
+    p = write_token_file(str(tmp_path / "e"), np.array([-1, 2**31], np.int64))
+    assert json.load(open(p + ".json"))["dtype"] == "int64"
+    # round-trip exactness at the wide end (no silent wrap)
+    raw = np.memmap(p + ".bin", dtype=np.int64, mode="r")
+    np.testing.assert_array_equal(np.asarray(raw), [-1, 2**31])
 
 
 def test_windows_are_real_next_token_pairs(tmp_path):
