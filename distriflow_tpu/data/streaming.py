@@ -144,11 +144,21 @@ class StreamingTokenDataset:
 
     def _gather(self, window_ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         window = self.seq_len + 1
-        out = np.empty((len(window_ids), window), np.int32)
+        wide = self._tokens.dtype.itemsize > 4 or self._tokens.dtype == np.uint32
+        buf = np.empty((len(window_ids), window), self._tokens.dtype if wide else np.int32)
         for row, w in enumerate(window_ids):
             start = int(w) * window
-            out[row] = self._tokens[start : start + window]
-        return out[:, :-1].copy(), out[:, 1:].copy()
+            buf[row] = self._tokens[start : start + window]
+        if wide:
+            # batches are int32 (the LM trainer contract); a token id past
+            # int32 cannot be an embedding row — fail, never wrap
+            if int(buf.max()) >= 2**31 or int(buf.min()) < -(2**31):
+                raise ValueError(
+                    f"token ids in {self.path!r} exceed int32 range; "
+                    "re-encode the corpus with ids < 2**31"
+                )
+            buf = buf.astype(np.int32)
+        return buf[:, :-1].copy(), buf[:, 1:].copy()
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         return self

@@ -8,13 +8,15 @@ transport (length-prefixed binary frames + acks, ``comm/transport.py``),
 reusing ``DownloadMsg``-style dict payloads with packed int32 token
 buffers.
 
-Events:
+Events (arrays travel as ``pack_bytes``/``SerializedArray`` buffers, the
+same encoding every other message type uses):
 
-- ``model_info``  -> {vocab_size, max_seq, d_model, n_layers, name}
-- ``generate``    {tokens: bytes, shape, n_tokens, temperature?, top_k?,
-  top_p?, seed?} -> {tokens: bytes, shape}
-- ``beam``        {tokens, shape, n_tokens, beam_size?, length_penalty?,
-  eos_id?} -> {tokens, shape, scores: bytes}
+- ``model_info``  {} -> {vocab_size, max_seq, d_model, n_layers, n_heads,
+  name}
+- ``generate``    {prompt: <packed {tokens}>, n_tokens, temperature?,
+  top_k?, top_p?, seed?} -> {result: <packed {tokens}>}
+- ``beam``        {prompt: <packed {tokens}>, n_tokens, beam_size?,
+  length_penalty?, eos_id?} -> {result: <packed {tokens, scores}>}
 
 Decoding runs through the same jit-cached :func:`generate` /
 :func:`beam_search` programs the local API uses; a lock serializes device

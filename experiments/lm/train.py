@@ -69,6 +69,20 @@ def main(argv=None) -> float:
     p.add_argument("--generate", type=int, default=0,
                    help="after training, decode N tokens from a corpus prompt "
                         "and report how many follow the Markov structure")
+    def host_port(value: str):
+        # validate at parse time: a typo must not cost the training run
+        host, _, port = value.rpartition(":")
+        try:
+            return host or "127.0.0.1", int(port or 0)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected HOST:PORT or :0, got {value!r}"
+            )
+
+    p.add_argument("--serve", metavar="HOST:PORT", default=None, type=host_port,
+                   help="after training, serve the model for remote "
+                        "generate/beam-search (InferenceServer) until "
+                        "interrupted; HOST:PORT or :0 for an ephemeral port")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
@@ -159,6 +173,23 @@ def main(argv=None) -> float:
         valid = sum(p in seen for p in pairs) / len(pairs)
         print(f"generated {args.generate} tokens; {valid:.0%} of transitions "
               f"follow the corpus Markov structure", file=sys.stderr)
+    if args.serve is not None:
+        from distriflow_tpu.server import InferenceServer
+
+        host, port = args.serve
+        server = InferenceServer(
+            cfg, trainer.get_params(), host=host, port=port, verbose=True,
+        ).setup()
+        print(f"serving inference on {server.address} — Ctrl-C to stop",
+              file=sys.stderr, flush=True)
+        try:
+            import threading
+
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.stop()
     trainer.close()
     return eval_loss
 

@@ -178,3 +178,59 @@ def test_lm_train_chunked_dispatch_matches():
     loss_1 = lm_train.main(common)
     loss_k = lm_train.main(common + ["--steps-per-dispatch", "8"])
     np.testing.assert_allclose(loss_1, loss_k, rtol=1e-4)
+
+
+def test_lm_train_then_serve():
+    """--serve: train then answer remote inference until interrupted."""
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys as _sys
+    import time
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "experiments.lm.train",
+         "--steps", "4", "--seq", "32", "--batch-size", "4",
+         "--n-layers", "1", "--d-model", "32", "--d-ff", "64",
+         "--corpus-tokens", "20000", "--dtype", "float32",
+         "--serve", "127.0.0.1:0"],
+        stderr=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    import queue
+    import threading
+
+    lines: "queue.Queue[str]" = queue.Queue()
+
+    def _pump():
+        for line in proc.stderr:
+            lines.put(line)
+
+    threading.Thread(target=_pump, daemon=True).start()
+    address = None
+    try:
+        deadline = time.time() + 120
+        while address is None:
+            try:
+                line = lines.get(timeout=max(0.1, deadline - time.time()))
+            except queue.Empty:
+                raise AssertionError("server never came up") from None
+            m = re.search(r"serving inference on (\S+)", line)
+            if m:
+                address = m.group(1)
+            assert time.time() < deadline, "server never came up"
+        from distriflow_tpu.client import InferenceClient
+
+        with InferenceClient(address) as client:
+            info = client.model_info()
+            assert info["vocab_size"] == 256
+            out = client.generate(np.asarray([[1, 2, 3]], np.int32), n_tokens=4)
+            assert out.shape == (1, 7)
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()

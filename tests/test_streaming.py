@@ -146,3 +146,23 @@ def test_resume_rejects_mismatched_geometry(tmp_path):
                                   process_index=0, process_count=1)
     with pytest.raises(ValueError, match="batch_size"):
         other.restore(cursor)
+
+
+def test_wide_token_files_fail_loudly_not_wrap(tmp_path):
+    """uint32/int64 corpora with ids past int32 must raise at read time,
+    never silently wrap into negative ids."""
+    path = write_token_file(
+        str(tmp_path / "wide"), np.arange(2**31, 2**31 + 400, dtype=np.int64)
+    )
+    ds = StreamingTokenDataset(path, seq_len=16, batch_size=4,
+                               process_index=0, process_count=1)
+    with pytest.raises(ValueError, match="int32 range"):
+        next(ds)
+    # wide dtype with SMALL values reads fine as int32
+    path2 = write_token_file(str(tmp_path / "ok"), np.arange(400) % 7)
+    import json
+    meta = json.load(open(path2 + ".json"))
+    ds2 = StreamingTokenDataset(path2, seq_len=16, batch_size=4,
+                                process_index=0, process_count=1)
+    x, _ = next(ds2)
+    assert x.dtype == np.int32 and int(x.max()) < 7
