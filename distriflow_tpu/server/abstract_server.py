@@ -50,6 +50,9 @@ class DistributedServerConfig:
     client_hyperparams: Optional[Dict[str, Any]] = None
     server_hyperparams: Optional[Dict[str, Any]] = None
     save_dir: str = DEFAULT_SAVE_DIR
+    # retention: the reference keeps one checkpoint dir per update forever
+    # (server/models.ts:132-138); None preserves that, N keeps the newest N
+    max_checkpoints: Optional[int] = None
     verbose: Optional[bool] = None
     host: str = "127.0.0.1"
     port: int = 0
@@ -78,7 +81,9 @@ class AbstractServer:
         if is_server_model(model):
             self.model = model
         else:
-            self.model = DistributedServerCheckpointedModel(model, self.config.save_dir)
+            self.model = DistributedServerCheckpointedModel(
+                model, self.config.save_dir, self.config.max_checkpoints
+            )
         self.client_hyperparams: ClientHyperparams = client_hyperparams(
             self.config.client_hyperparams
         )

@@ -15,7 +15,7 @@ of the core model surface.
 
 from __future__ import annotations
 
-from typing import Any, List, Protocol, runtime_checkable
+from typing import Any, List, Optional, Protocol, runtime_checkable
 
 from distriflow_tpu.checkpoint import CheckpointStore
 from distriflow_tpu.checkpoint.store import timestamp_version as _timestamp_version
@@ -99,9 +99,14 @@ class DistributedServerCheckpointedModel(DistributedServerInMemoryModel):
     fresh; ``save()`` writes ``save_dir/<version>/`` and swaps ``current``.
     """
 
-    def __init__(self, model: DistributedModel, save_dir: str):
+    def __init__(
+        self,
+        model: DistributedModel,
+        save_dir: str,
+        max_to_keep: Optional[int] = None,
+    ):
         super().__init__(model)
-        self.store = CheckpointStore(save_dir)
+        self.store = CheckpointStore(save_dir, max_to_keep)
 
     def setup(self) -> None:
         self.model.setup()

@@ -271,3 +271,23 @@ def test_async_client_disconnect_requeues(tmp_path):
         assert not dataset.outstanding_batches  # requeued
     finally:
         server.stop()
+
+
+def test_server_checkpoint_retention(tmp_path):
+    """DistributedServerConfig.max_checkpoints bounds the save-per-update
+    disk growth (the reference keeps every update's dir forever)."""
+    from distriflow_tpu.models import mnist_mlp
+    from distriflow_tpu.models.base import SpecModel
+    from distriflow_tpu.server.abstract_server import DistributedServerConfig
+    from distriflow_tpu.server.federated_server import FederatedServer
+
+    config = DistributedServerConfig(
+        save_dir=str(tmp_path / "srv"), max_checkpoints=3, port=0,
+    )
+    server = FederatedServer(SpecModel(mnist_mlp(hidden=4)), config)
+    # distinct explicit versions: rapid timestamp versions can collide,
+    # which would make a <=3 assertion pass without pruning ever running
+    for i in range(6):
+        server.model.store.save(
+            server.model.model.get_params(), version=str(i))
+    assert server.model.store.list() == ["3", "4", "5"]
