@@ -92,6 +92,14 @@ class InferenceClient:
         result = unpack_bytes(self._request("beam", payload)["result"])
         return deserialize_array(result["tokens"]), deserialize_array(result["scores"])
 
+    def score(self, tokens: np.ndarray, from_pos: int = 1) -> np.ndarray:
+        """Remote :func:`distriflow_tpu.models.sequence_logprob`: teacher-
+        forced ``log P(tokens[:, from_pos:] | prefix)`` per row."""
+        payload = self._prompt_payload(tokens)
+        payload["from_pos"] = int(from_pos)
+        result = unpack_bytes(self._request("score", payload)["result"])
+        return deserialize_array(result["scores"])
+
     # -- internals ---------------------------------------------------------
 
     def _request(self, event: str, payload: Dict[str, Any]) -> Dict[str, Any]:

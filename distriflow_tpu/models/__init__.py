@@ -18,7 +18,7 @@ from distriflow_tpu.models.losses import (
     softmax_cross_entropy,
 )
 from distriflow_tpu.models.base import with_uint8_inputs
-from distriflow_tpu.models.generate import beam_search, generate
+from distriflow_tpu.models.generate import beam_search, generate, sequence_logprob
 from distriflow_tpu.models.keras_import import spec_from_keras_h5, spec_from_keras_json
 from distriflow_tpu.models.mobilenet import MobileNetV2, mobilenet_v2
 from distriflow_tpu.models.zoo import MLP, ConvNet, cifar_convnet, mnist_convnet, mnist_mlp
@@ -47,6 +47,7 @@ __all__ = [
     "mnist_mlp",
     "beam_search",
     "generate",
+    "sequence_logprob",
     "spec_from_keras_h5",
     "spec_from_keras_json",
     "with_uint8_inputs",

@@ -28,6 +28,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distriflow_tpu.models.transformer import TransformerConfig, TransformerLM
 
@@ -253,6 +254,68 @@ def beam_search(
     _check_fits(p, n_tokens, config)
     search = _build_beam_fns(config, n_tokens, beam_size, length_penalty, eos_id)
     return search(params, jnp.asarray(prompt, jnp.int32))
+
+
+@functools.lru_cache(maxsize=16)
+def _build_score_fn(config: TransformerConfig):
+    cfg = dataclasses.replace(
+        config, use_ring_attention=False, use_ulysses_attention=False
+    )
+    module = TransformerLM(cfg, mesh=None)  # training-mode forward
+
+    @jax.jit
+    def score(params, tokens, from_pos):
+        logits = module.apply(params, tokens[:, :-1])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        target = jnp.take_along_axis(
+            logp, tokens[:, 1:, None].astype(jnp.int32), axis=-1
+        )[..., 0]  # [B, S-1]: log P(tokens[t+1] | tokens[:t+1])
+        pos = jnp.arange(tokens.shape[1] - 1)[None, :]
+        mask = pos >= (from_pos[:, None] - 1)  # first scored token = from_pos
+        return jnp.sum(target * mask, axis=-1)
+
+    return score
+
+
+def sequence_logprob(
+    config: TransformerConfig,
+    params,
+    tokens: jnp.ndarray,
+    from_pos: int = 1,
+) -> jnp.ndarray:
+    """Teacher-forced log-probability of ``tokens[:, from_pos:]`` given the
+    prefix — one training-mode forward, jit-cached per config.
+
+    ``tokens``: ``[B, S] int32``. Returns ``[B] float32`` sums of
+    ``log P(tokens[t] | tokens[:t])`` for ``t >= from_pos`` — raw,
+    unpenalized log-probability. With default knobs
+    (``length_penalty=0``, no ``eos_id``) this equals the scores
+    :func:`beam_search` reports at ``from_pos = prompt_len``; a nonzero
+    length penalty (GNMT-scaled) or EOS freezing (post-EOS positions add
+    nothing to a beam's score but are real tokens here) makes the two
+    intentionally differ. Exposed for reranking/perplexity use.
+    """
+    tokens = np.asarray(tokens, dtype=np.int64)
+    b, s = tokens.shape
+    if not 1 <= from_pos < s:
+        raise ValueError(f"from_pos must be in [1, {s - 1}], got {from_pos}")
+    if s > config.max_seq:
+        raise ValueError(
+            f"sequence length {s} exceeds max_seq ({config.max_seq})"
+        )
+    lo, hi = int(tokens.min()), int(tokens.max())
+    if lo < 0 or hi >= config.vocab_size:
+        # take_along_axis clamps out-of-bounds ids under jit — a vocab
+        # mismatch would return plausible-looking scores for the WRONG
+        # token; fail loudly instead (same reasoning as beam_search's
+        # eos_id check)
+        raise ValueError(
+            f"token ids span [{lo}, {hi}] but vocab_size is "
+            f"{config.vocab_size}"
+        )
+    tokens = jnp.asarray(tokens, jnp.int32)
+    fn = _build_score_fn(config)
+    return fn(params, tokens, jnp.full((b,), from_pos, jnp.int32))
 
 
 def generate(

@@ -17,6 +17,8 @@ same encoding every other message type uses):
   top_k?, top_p?, seed?} -> {result: <packed {tokens}>}
 - ``beam``        {prompt: <packed {tokens}>, n_tokens, beam_size?,
   length_penalty?, eos_id?} -> {result: <packed {tokens, scores}>}
+- ``score``       {prompt: <packed {tokens}>, from_pos} ->
+  {result: <packed {scores}>} — teacher-forced log P(tokens[from_pos:])
 
 Decoding runs through the same jit-cached :func:`generate` /
 :func:`beam_search` programs the local API uses; a lock serializes device
@@ -33,7 +35,7 @@ import jax
 import numpy as np
 
 from distriflow_tpu.comm.transport import ServerTransport
-from distriflow_tpu.models.generate import beam_search, generate
+from distriflow_tpu.models.generate import beam_search, generate, sequence_logprob
 from distriflow_tpu.models.transformer import TransformerConfig
 from distriflow_tpu.utils.logging import VerboseLogger
 from distriflow_tpu.utils.serialization import (
@@ -78,6 +80,7 @@ class InferenceServer:
         self.transport.on("model_info", self._on_info)
         self.transport.on("generate", self._on_generate)
         self.transport.on("beam", self._on_beam)
+        self.transport.on("score", self._on_score)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -152,3 +155,12 @@ class InferenceServer:
                 {"tokens": serialize_array(out), "scores": serialize_array(scores)}
             )
         }
+
+    def _on_score(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        tokens = _prompt_from(payload)
+        from_pos = int(payload.get("from_pos", 1))
+        with self._device_lock, self.logger.time(
+            f"score[{tokens.shape[0]}x{tokens.shape[1]} from={from_pos}]"
+        ):
+            scores = sequence_logprob(self.config, self.params, tokens, from_pos)
+        return {"result": pack_bytes({"scores": serialize_array(scores)})}

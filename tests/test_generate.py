@@ -293,3 +293,52 @@ def test_beam_search_rejects_bad_eos():
     with pytest.raises(ValueError, match="eos_id"):
         beam_search(CFG, params, prompt, n_tokens=2, beam_size=2,
                     eos_id=CFG.vocab_size)
+
+
+def test_sequence_logprob_matches_manual_teacher_forcing():
+    from distriflow_tpu.models import sequence_logprob
+
+    params = _params(CFG)
+    rng = np.random.RandomState(2)
+    tokens = jnp.asarray(rng.randint(0, CFG.vocab_size, (3, 12)), jnp.int32)
+    from_pos = 4
+    got = sequence_logprob(CFG, params, tokens, from_pos=from_pos)
+    logits = TransformerLM(CFG, mesh=None).apply(params, tokens[:, :-1])
+    logp = np.asarray(jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1))
+    for b in range(3):
+        want = sum(
+            logp[b, t - 1, int(tokens[b, t])] for t in range(from_pos, 12)
+        )
+        np.testing.assert_allclose(float(got[b]), want, rtol=1e-5)
+
+
+def test_sequence_logprob_agrees_with_beam_scores():
+    from distriflow_tpu.models import beam_search, sequence_logprob
+
+    params = _params(CFG)
+    prompt = jnp.asarray([[4, 5, 6, 7]], jnp.int32)
+    out, scores = beam_search(CFG, params, prompt, n_tokens=6, beam_size=3)
+    rescored = sequence_logprob(CFG, params, out, from_pos=prompt.shape[1])
+    np.testing.assert_allclose(
+        np.asarray(scores), np.asarray(rescored), rtol=1e-4
+    )
+
+
+def test_sequence_logprob_validation():
+    from distriflow_tpu.models import sequence_logprob
+
+    params = _params(CFG)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="from_pos"):
+        sequence_logprob(CFG, params, tokens, from_pos=0)
+    with pytest.raises(ValueError, match="max_seq"):
+        sequence_logprob(CFG, params, jnp.zeros((1, 40), jnp.int32))
+
+
+def test_sequence_logprob_rejects_out_of_vocab():
+    from distriflow_tpu.models import sequence_logprob
+
+    params = _params(CFG)
+    bad = jnp.asarray([[1, 2, CFG.vocab_size, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="vocab_size"):
+        sequence_logprob(CFG, params, bad, from_pos=1)

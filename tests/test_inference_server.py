@@ -86,3 +86,13 @@ def test_set_params_swaps_serving_weights(served):
         assert not np.array_equal(before, after)
     finally:
         server.set_params(params)
+
+
+def test_remote_score_matches_local(served):
+    from distriflow_tpu.models import sequence_logprob
+
+    _, client, params = served
+    tokens = np.asarray([[3, 4, 5, 6, 7, 8]], np.int32)
+    remote = client.score(tokens, from_pos=2)
+    local = np.asarray(sequence_logprob(CFG, params, jnp.asarray(tokens), 2))
+    np.testing.assert_allclose(remote, local, rtol=1e-5)
