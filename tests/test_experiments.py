@@ -211,7 +211,7 @@ def test_lm_train_then_serve():
     threading.Thread(target=_pump, daemon=True).start()
     address = None
     try:
-        deadline = time.time() + 120
+        deadline = time.time() + 420  # model setup + XLA compile; slow under full-suite load
         while address is None:
             try:
                 line = lines.get(timeout=max(0.1, deadline - time.time()))
