@@ -1,0 +1,78 @@
+"""Worker for the real-2-process sharded-checkpoint test.
+
+Each OS process joins the jax.distributed coordination service, builds a
+global 2-device mesh (one CPU device per process), saves a sharded
+checkpoint collectively, restores it, and verifies its local shard.
+
+argv: coordinator_port process_id num_processes save_dir mode
+mode: "ok" — normal collective save + restore;
+      "fail" — process 1 fails its shard write: EVERY process must see the
+      save raise and NO version may commit (all-or-nothing).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+
+def main() -> None:
+    port, pid, nproc, save_dir, mode = sys.argv[1:6]
+    pid, nproc = int(pid), int(nproc)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distriflow_tpu.checkpoint.sharded import ShardedCheckpointStore
+
+    assert jax.process_count() == nproc, jax.process_count()
+    devices = np.array(jax.devices())  # one per process, globally visible
+    assert len(devices) == nproc, devices
+    mesh = Mesh(devices, ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+
+    # globally-sharded param: row i lives on process i
+    local = np.full((1, 4), float(pid), np.float32)
+    w = jax.make_array_from_process_local_data(sharding, local, (nproc, 4))
+    # plus a replicated leaf (every process holds it; one writes it)
+    b = jax.device_put(np.arange(4, dtype=np.float32), NamedSharding(mesh, P()))
+    tree = {"w": w, "b": b}
+
+    store = ShardedCheckpointStore(save_dir)
+    if mode == "fail":
+        if pid == 1:
+            def boom(*a, **k):
+                raise OSError("injected shard-write failure")
+
+            store._write_shards = boom
+        try:
+            store.save(tree, version="v1")
+        except Exception as e:
+            print(f"worker {pid}: save raised as required: {type(e).__name__}",
+                  flush=True)
+            print(f"WORKER-{pid}-RAISED", flush=True)
+            return
+        raise SystemExit(f"worker {pid}: save unexpectedly succeeded")
+
+    version = store.save(tree, version="v1")
+    assert version == "v1"
+    restored = store.load(version, tree)  # templates carry the shardings
+    got = np.asarray(restored["w"].addressable_shards[0].data)
+    np.testing.assert_allclose(got, float(pid))
+    np.testing.assert_allclose(np.asarray(restored["b"]),
+                               np.arange(4, dtype=np.float32))
+    print(f"WORKER-{pid}-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

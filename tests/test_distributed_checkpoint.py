@@ -1,0 +1,79 @@
+"""Real 2-process sharded checkpointing over the jax.distributed service.
+
+The in-process sharded-store tests (tests/test_sharded_checkpoint.py) run
+single-process, where the collective-commit protocol short-circuits. Here
+two OS processes join an actual coordination service, each writes only its
+owned shards, and the commit is genuinely collective — including the
+all-or-nothing guarantee when one process's shard write fails mid-save.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "distributed_ckpt_worker.py")
+TIMEOUT_S = 180
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_workers(tmp_path, mode):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(port), str(pid), "2",
+             str(tmp_path / "ckpt"), mode],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=TIMEOUT_S)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+def test_two_process_collective_save_and_restore(tmp_path):
+    procs, outs = _run_workers(tmp_path, "ok")
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER-{pid}-OK" in out, out
+    d = tmp_path / "ckpt" / "v1"
+    assert d.is_dir()
+    # both processes contributed shard files; meta declares the plan
+    assert (d / "shards.0.bin").exists() and (d / "shards.1.bin").exists()
+    assert (d / "meta.json").exists()
+    assert (tmp_path / "ckpt" / "current").exists()
+
+
+def test_two_process_failed_write_commits_nothing(tmp_path):
+    """One process's shard write fails: every process sees the save raise
+    and no version directory is ever published (all-or-nothing commit)."""
+    procs, outs = _run_workers(tmp_path, "fail")
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out}"
+        assert f"WORKER-{pid}-RAISED" in out, out
+    root = tmp_path / "ckpt"
+    published = [
+        n for n in os.listdir(root)
+        if not n.startswith(".") and n != "current"
+    ] if root.is_dir() else []
+    assert published == [], f"torn commit published: {published}"
