@@ -10,11 +10,15 @@ a pure JAX function, with optional weight loading from the binary shard files
 next to the JSON.
 
 Supported layers (the tfjs-layers subset the reference ecosystem actually
-uses): Conv2D, DepthwiseConv2D, Dense, Activation, ReLU, MaxPooling2D,
-AveragePooling2D, GlobalAveragePooling2D, Flatten, Reshape, ZeroPadding2D,
-Dropout, BatchNormalization, InputLayer; plus the merge layers Add,
-Subtract, Multiply, Average, Maximum, Minimum, Concatenate in graph-form
-models.
+uses): Conv2D, DepthwiseConv2D, Conv1D (valid/same/causal), Dense,
+Activation, ReLU, MaxPooling1D/2D, AveragePooling1D/2D,
+GlobalAveragePooling1D/2D, GlobalMaxPooling1D/2D, Flatten, Reshape,
+ZeroPadding2D, Dropout, SpatialDropout1D, BatchNormalization, InputLayer,
+Embedding, SimpleRNN, LSTM, GRU (both ``reset_after`` variants); plus the
+merge layers Add, Subtract, Multiply, Average, Maximum, Minimum,
+Concatenate in graph-form models. RNNs follow Keras semantics exactly
+(gate order i|f|c|o for LSTM, z|r|h for GRU, ``unit_forget_bias`` init);
+``stateful``/``go_backwards`` raise.
 Both ``Sequential`` and single-input/single-output ``Model``/``Functional``
 (DAG) topologies load; shared layers (a layer called at multiple graph
 nodes) raise with a clear message.
@@ -54,6 +58,9 @@ _ACTIVATIONS: Dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
     "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
     "softmax": jax.nn.softmax,
     "sigmoid": jax.nn.sigmoid,
+    # Keras' hard_sigmoid is clip(0.2x + 0.5, 0, 1) — NOT jax.nn.hard_sigmoid
+    # (relu6(x+3)/6, slope 1/6): old tfjs LSTM/GRU exports default to this
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
     "tanh": jnp.tanh,
     "elu": jax.nn.elu,
     "selu": jax.nn.selu,
@@ -98,6 +105,8 @@ def _initializer(cfg: Optional[Dict[str, Any]]) -> Callable[..., jnp.ndarray]:
                 "untruncated_normal": "normal",
             }[c.get("distribution", "uniform")],
         )
+    if cls == "Orthogonal":
+        return init.orthogonal(scale=c.get("gain", 1.0))
     if cls == "GlorotUniform":
         return init.glorot_uniform()
     if cls == "GlorotNormal":
@@ -119,6 +128,22 @@ def _initializer(cfg: Optional[Dict[str, Any]]) -> Callable[..., jnp.ndarray]:
     raise ValueError(f"unsupported initializer {cls!r}")
 
 
+def _scan_rnn(step, init_carry, x, ret_seq):
+    """Run ``step`` over the time axis of ``x [B, S, C]``."""
+    xs = jnp.swapaxes(x, 0, 1)  # [S, B, C]
+    carry, hs = jax.lax.scan(step, init_carry, xs)
+    return jnp.swapaxes(hs, 0, 1) if ret_seq else carry[0]
+
+
+def _kernel_init(cfg: Dict[str, Any]) -> Callable[..., jnp.ndarray]:
+    """Kernel initializer with the KERAS default (glorot_uniform) when the
+    config omits it — _initializer(None) is zeros, which would cold-init
+    untrainable kernels for hand-written/minimal topologies (real tfjs
+    exports always record the initializer explicitly)."""
+    return _initializer(cfg.get("kernel_initializer")
+                        or {"class_name": "GlorotUniform"})
+
+
 def _pool_padding(cfg: Dict[str, Any]) -> str:
     return {"valid": "VALID", "same": "SAME"}[cfg.get("padding", "valid")]
 
@@ -137,6 +162,8 @@ class _Builder:
         self.fns: List[LayerFn] = []
         self.names: List[str] = []  # resolved layer name per fn (1:1 with fns)
         self.shape: Optional[Tuple[int, ...]] = None  # feature shape, no batch
+        self.integer_input = False  # Embedding-first models take raw tokens
+        self._consumed_input = False  # a non-InputLayer fn has seen the input
 
     # -- helpers -----------------------------------------------------------
 
@@ -163,16 +190,19 @@ class _Builder:
         handler = getattr(self, f"_add_{class_name}", None)
         if handler is None:
             raise ValueError(
-                f"unsupported layer {class_name!r}; supported: Conv2D, "
-                "DepthwiseConv2D, Dense, Activation, ReLU, MaxPooling2D, "
-                "AveragePooling2D, GlobalAveragePooling2D, Flatten, Reshape, "
-                "ZeroPadding2D, Dropout, BatchNormalization, InputLayer "
-                "(+ Add/Subtract/Multiply/Average/Maximum/Minimum/"
-                "Concatenate in Functional graphs)"
+                f"unsupported layer {class_name!r}; supported: Conv1D/2D, "
+                "DepthwiseConv2D, Dense, Embedding, SimpleRNN, LSTM, GRU, "
+                "Activation, ReLU, Max/AveragePooling1D/2D, "
+                "GlobalAverage/MaxPooling1D/2D, Flatten, Reshape, "
+                "ZeroPadding2D, Dropout, SpatialDropout1D, "
+                "BatchNormalization, InputLayer (+ Add/Subtract/Multiply/"
+                "Average/Maximum/Minimum/Concatenate in Functional graphs)"
             )
         handler(name, cfg)
         self.names.append(name)  # every handler appends exactly one fn
         assert len(self.names) == len(self.fns)
+        if class_name != "InputLayer":
+            self._consumed_input = True
 
     def _add_Conv2D(self, name: str, cfg: Dict[str, Any]) -> None:
         h, w, cin = self._need_shape(name)
@@ -183,7 +213,7 @@ class _Builder:
         padding = _pool_padding(cfg)
         use_bias = cfg.get("use_bias", True)
         act = _activation(cfg.get("activation"))
-        weights = {"kernel": ((kh, kw, cin, filters), _initializer(cfg.get("kernel_initializer")))}
+        weights = {"kernel": ((kh, kw, cin, filters), _kernel_init(cfg))}
         if use_bias:
             weights["bias"] = ((filters,), _initializer(cfg.get("bias_initializer")))
         self._register(name, weights)
@@ -221,7 +251,9 @@ class _Builder:
         weights = {
             "depthwise_kernel": (
                 (kh, kw, cin, mult),
-                _initializer(cfg.get("depthwise_initializer") or cfg.get("kernel_initializer")),
+                _initializer(cfg.get("depthwise_initializer")
+                             or cfg.get("kernel_initializer")
+                             or {"class_name": "GlorotUniform"}),
             )
         }
         if use_bias:
@@ -265,7 +297,7 @@ class _Builder:
         units = int(cfg["units"])
         use_bias = cfg.get("use_bias", True)
         act = _activation(cfg.get("activation"))
-        weights = {"kernel": ((fan_in, units), _initializer(cfg.get("kernel_initializer")))}
+        weights = {"kernel": ((fan_in, units), _kernel_init(cfg))}
         if use_bias:
             weights["bias"] = ((units,), _initializer(cfg.get("bias_initializer")))
         self._register(name, weights)
@@ -275,6 +307,290 @@ class _Builder:
     def _add_InputLayer(self, name: str, cfg: Dict[str, Any]) -> None:
         # identity; exists only to carry batch_input_shape (consumed in add())
         self.fns.append(lambda params, x: x)
+
+    def _add_Embedding(self, name: str, cfg: Dict[str, Any]) -> None:
+        shape = self._need_shape(name)
+        if len(shape) != 1:
+            raise ValueError(
+                f"Embedding {name!r} expects [B, S] integer input, got "
+                f"feature shape {shape}"
+            )
+        if cfg.get("mask_zero"):
+            raise ValueError(
+                f"Embedding {name!r} uses mask_zero=True; masking is not "
+                "supported (downstream RNNs would silently run over padded "
+                "timesteps instead of skipping them)"
+            )
+        input_dim = int(cfg["input_dim"])
+        output_dim = int(cfg["output_dim"])
+        self._register(name, {
+            "embeddings": (
+                (input_dim, output_dim),
+                _initializer(cfg.get("embeddings_initializer")
+                             or {"class_name": "RandomUniform"}),
+            )
+        })
+        if not self._consumed_input:
+            # embedding consumes the raw model input (possibly via identity
+            # InputLayers): tokens stay integer — the spec's input cast must
+            # not float them (bf16 would round ids > 256)
+            self.integer_input = True
+
+        def fn(params: Params, x: jnp.ndarray, name=name):
+            return jnp.take(params[name]["embeddings"], x.astype(jnp.int32), axis=0)
+
+        self.fns.append(fn)
+        self.shape = shape + (output_dim,)
+
+    def _add_Conv1D(self, name: str, cfg: Dict[str, Any]) -> None:
+        s, c = self._need_shape(name)
+        ks = cfg["kernel_size"]
+        k = int(ks[0] if isinstance(ks, (list, tuple)) else ks)
+        filters = int(cfg["filters"])
+        st = cfg.get("strides", 1)
+        stride = int(st[0] if isinstance(st, (list, tuple)) else st)
+        dl = cfg.get("dilation_rate", 1)
+        dilation = int(dl[0] if isinstance(dl, (list, tuple)) else dl)
+        pad_mode = cfg.get("padding", "valid")
+        if pad_mode not in ("valid", "same", "causal"):
+            raise ValueError(f"Conv1D padding {pad_mode!r} unsupported")
+        use_bias = cfg.get("use_bias", True)
+        act = _activation(cfg.get("activation"))
+        weights = {"kernel": ((k, c, filters), _kernel_init(cfg))}
+        if use_bias:
+            weights["bias"] = ((filters,), _initializer(cfg.get("bias_initializer")))
+        self._register(name, weights)
+        causal_pad = (k - 1) * dilation
+
+        def fn(params: Params, x: jnp.ndarray, name=name, stride=stride,
+               dilation=dilation, pad_mode=pad_mode, causal_pad=causal_pad,
+               use_bias=use_bias, act=act):
+            p = params[name]
+            if pad_mode == "causal":
+                x = jnp.pad(x, ((0, 0), (causal_pad, 0), (0, 0)))
+                padding = "VALID"
+            else:
+                padding = pad_mode.upper()
+            y = jax.lax.conv_general_dilated(
+                x, p["kernel"].astype(x.dtype), (stride,), padding,
+                rhs_dilation=(dilation,),
+                dimension_numbers=("NWC", "WIO", "NWC"),
+            )
+            if use_bias:
+                y = y + p["bias"].astype(y.dtype)
+            return act(y)
+
+        self.fns.append(fn)
+        ek = (k - 1) * dilation + 1
+        if pad_mode == "causal":
+            out_s = -(-s // stride)  # full length, left-padded
+        else:
+            out_s = _conv_dim(s, ek, stride, pad_mode.upper())
+        self.shape = (out_s, filters)
+
+    def _pool1d(self, name: str, cfg: Dict[str, Any], reducer: str) -> None:
+        s, c = self._need_shape(name)
+        ps = cfg.get("pool_size", 2)
+        p_ = int(ps[0] if isinstance(ps, (list, tuple)) else ps)
+        st = cfg.get("strides") or p_
+        stride = int(st[0] if isinstance(st, (list, tuple)) else st)
+        padding = _pool_padding(cfg)
+
+        def fn(params: Params, x: jnp.ndarray, p_=p_, stride=stride,
+               padding=padding, reducer=reducer):
+            window, strides_ = (1, p_, 1), (1, stride, 1)
+            if reducer == "max":
+                return jax.lax.reduce_window(
+                    x, -jnp.inf, jax.lax.max, window, strides_, padding)
+            summed = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, window, strides_, padding)
+            if padding == "VALID":
+                return summed / p_
+            counts = jax.lax.reduce_window(
+                jnp.ones_like(x), 0.0, jax.lax.add, window, strides_, padding)
+            return summed / counts
+
+        self.fns.append(fn)
+        self.shape = (_conv_dim(s, p_, stride, padding), c)
+
+    def _add_MaxPooling1D(self, name: str, cfg: Dict[str, Any]) -> None:
+        self._pool1d(name, cfg, "max")
+
+    def _add_AveragePooling1D(self, name: str, cfg: Dict[str, Any]) -> None:
+        self._pool1d(name, cfg, "avg")
+
+    def _add_GlobalAveragePooling1D(self, name: str, cfg: Dict[str, Any]) -> None:
+        _, c = self._need_shape(name)
+        self.fns.append(lambda params, x: jnp.mean(x, axis=1))
+        self.shape = (c,)
+
+    def _add_GlobalMaxPooling1D(self, name: str, cfg: Dict[str, Any]) -> None:
+        _, c = self._need_shape(name)
+        self.fns.append(lambda params, x: jnp.max(x, axis=1))
+        self.shape = (c,)
+
+    def _add_GlobalMaxPooling2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        _, _, c = self._need_shape(name)
+        self.fns.append(lambda params, x: jnp.max(x, axis=(1, 2)))
+        self.shape = (c,)
+
+    def _add_SpatialDropout1D(self, name: str, cfg: Dict[str, Any]) -> None:
+        self.fns.append(lambda params, x: x)  # inference mode, like Dropout
+
+    # -- recurrent layers --------------------------------------------------
+
+    def _rnn_common(self, name: str, cfg: Dict[str, Any]):
+        """Shared RNN plumbing: shape bookkeeping, weight registration.
+        Returns (in_features, units, use_bias, return_sequences)."""
+        shape = self._need_shape(name)
+        if len(shape) != 2:
+            raise ValueError(
+                f"{name!r} expects [B, S, C] input, got feature shape {shape}"
+            )
+        if cfg.get("stateful") or cfg.get("go_backwards"):
+            raise ValueError(
+                f"{name!r}: stateful/go_backwards RNNs are not supported"
+            )
+        s, c = shape
+        units = int(cfg["units"])
+        use_bias = cfg.get("use_bias", True)
+        ret_seq = bool(cfg.get("return_sequences", False))
+        self.shape = (s, units) if ret_seq else (units,)
+        return c, units, use_bias, ret_seq
+
+    def _add_SimpleRNN(self, name: str, cfg: Dict[str, Any]) -> None:
+        c, units, use_bias, ret_seq = self._rnn_common(name, cfg)
+        act = _activation(cfg.get("activation", "tanh"))
+        weights = {
+            "kernel": ((c, units), _kernel_init(cfg)),
+            "recurrent_kernel": (
+                (units, units),
+                _initializer(cfg.get("recurrent_initializer")
+                             or {"class_name": "Orthogonal"}),
+            ),
+        }
+        if use_bias:
+            weights["bias"] = ((units,), _initializer(cfg.get("bias_initializer")))
+        self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, units=units,
+               use_bias=use_bias, ret_seq=ret_seq, act=act):
+            p = params[name]
+            k = p["kernel"].astype(jnp.float32)
+            rk = p["recurrent_kernel"].astype(jnp.float32)
+            b = p["bias"].astype(jnp.float32) if use_bias else 0.0
+
+            def step(carry, xt):
+                (h,) = carry
+                h = act(xt.astype(jnp.float32) @ k + h @ rk + b)
+                return (h,), h
+
+            h0 = jnp.zeros((x.shape[0], units), jnp.float32)
+            return _scan_rnn(step, (h0,), x, ret_seq)
+
+        self.fns.append(fn)
+
+    def _add_LSTM(self, name: str, cfg: Dict[str, Any]) -> None:
+        c, units, use_bias, ret_seq = self._rnn_common(name, cfg)
+        act = _activation(cfg.get("activation", "tanh"))
+        rec_act = _activation(cfg.get("recurrent_activation", "hard_sigmoid"))
+        bias_init = _initializer(cfg.get("bias_initializer"))
+        if cfg.get("unit_forget_bias", True):
+            base_init = bias_init
+
+            def bias_init(key, shape, dtype=jnp.float32, units=units,  # noqa: F811
+                          base_init=base_init):
+                # Keras: configured initializer everywhere EXCEPT the
+                # forget-gate block, which gets ones
+                b = base_init(key, shape, dtype)
+                return b.at[units : 2 * units].set(1.0)
+        weights = {
+            "kernel": ((c, 4 * units), _kernel_init(cfg)),
+            "recurrent_kernel": (
+                (units, 4 * units),
+                _initializer(cfg.get("recurrent_initializer")
+                             or {"class_name": "Orthogonal"}),
+            ),
+        }
+        if use_bias:
+            weights["bias"] = ((4 * units,), bias_init)
+        self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, units=units,
+               use_bias=use_bias, ret_seq=ret_seq, act=act, rec_act=rec_act):
+            p = params[name]
+            k = p["kernel"].astype(jnp.float32)
+            rk = p["recurrent_kernel"].astype(jnp.float32)
+            b = p["bias"].astype(jnp.float32) if use_bias else 0.0
+
+            def step(carry, xt):
+                h, cell = carry
+                z = xt.astype(jnp.float32) @ k + h @ rk + b  # [B, 4U]
+                i, f, g, o = (z[:, n * units : (n + 1) * units] for n in range(4))
+                cell = rec_act(f) * cell + rec_act(i) * act(g)  # gate order i|f|c|o
+                h = rec_act(o) * act(cell)
+                return (h, cell), h
+
+            h0 = jnp.zeros((x.shape[0], units), jnp.float32)
+            return _scan_rnn(step, (h0, h0), x, ret_seq)
+
+        self.fns.append(fn)
+
+    def _add_GRU(self, name: str, cfg: Dict[str, Any]) -> None:
+        c, units, use_bias, ret_seq = self._rnn_common(name, cfg)
+        act = _activation(cfg.get("activation", "tanh"))
+        rec_act = _activation(cfg.get("recurrent_activation", "hard_sigmoid"))
+        reset_after = bool(cfg.get("reset_after", False))
+        weights = {
+            "kernel": ((c, 3 * units), _kernel_init(cfg)),
+            "recurrent_kernel": (
+                (units, 3 * units),
+                _initializer(cfg.get("recurrent_initializer")
+                             or {"class_name": "Orthogonal"}),
+            ),
+        }
+        if use_bias:
+            bias_shape = (2, 3 * units) if reset_after else (3 * units,)
+            weights["bias"] = (bias_shape, _initializer(cfg.get("bias_initializer")))
+        self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, units=units,
+               use_bias=use_bias, ret_seq=ret_seq, act=act, rec_act=rec_act,
+               reset_after=reset_after):
+            p = params[name]
+            k = p["kernel"].astype(jnp.float32)
+            rk = p["recurrent_kernel"].astype(jnp.float32)
+            if use_bias:
+                b = p["bias"].astype(jnp.float32)
+                bi, br = (b[0], b[1]) if reset_after else (b, jnp.zeros_like(b))
+            else:
+                bi = br = jnp.zeros((3 * units,), jnp.float32)
+
+            def split3(v):
+                return (v[..., :units], v[..., units : 2 * units],
+                        v[..., 2 * units :])
+
+            def step(carry, xt):
+                (h,) = carry
+                xz, xr, xh = split3(xt.astype(jnp.float32) @ k + bi)
+                if reset_after:
+                    hz, hr, hh = split3(h @ rk + br)
+                    z = rec_act(xz + hz)
+                    r = rec_act(xr + hr)
+                    cand = act(xh + r * hh)
+                else:
+                    rz, rr, rh = (rk[:, :units], rk[:, units : 2 * units],
+                                  rk[:, 2 * units :])
+                    z = rec_act(xz + h @ rz)
+                    r = rec_act(xr + h @ rr)
+                    cand = act(xh + (r * h) @ rh)
+                h = z * h + (1.0 - z) * cand  # Keras update convention
+                return (h,), h
+
+            h0 = jnp.zeros((x.shape[0], units), jnp.float32)
+            return _scan_rnn(step, (h0,), x, ret_seq)
+
+        self.fns.append(fn)
 
     def _add_Activation(self, name: str, cfg: Dict[str, Any]) -> None:
         act = _activation(cfg.get("activation"))
@@ -752,10 +1068,11 @@ def _load_h5_weights(mw: Any) -> Params:
         group = mw[lname]
         for wpath in _names(group.attrs, "weight_names"):
             arr = np.asarray(group[wpath])
-            head, _, leaf = wpath.rpartition("/")
-            leaf = leaf.split(":")[0]
-            layer = head.split("/")[-1] if head else lname
-            params.setdefault(layer, {})[leaf] = jnp.asarray(arr)
+            leaf = wpath.rpartition("/")[2].split(":")[0]
+            # the enclosing group IS the layer; TF2 nests RNN weights one
+            # scope deeper ('lstm/lstm_cell/kernel:0') but they still
+            # belong to this group's layer
+            params.setdefault(lname, {})[leaf] = jnp.asarray(arr)
     return params
 
 
@@ -829,8 +1146,12 @@ def _spec_from_topology(
             }
         return params
 
+    integer_input = builder.integer_input
+
     def apply(params: Params, x: jnp.ndarray) -> jnp.ndarray:
-        return run(params, x.astype(dtype))
+        # Embedding-first models take raw token ids; floating them would
+        # corrupt the lookup
+        return run(params, x if integer_input else x.astype(dtype))
 
     return ModelSpec(
         init=init,

@@ -1,0 +1,310 @@
+"""Keras importer: Embedding / Conv1D / pooling-1D / RNN layers.
+
+Every recurrent cell is checked against a hand-rolled numpy reference
+implementing the exact Keras equations (gate order i|f|c|o for LSTM,
+z|r|h for GRU in both reset_after variants, hard_sigmoid = 0.2x+0.5).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distriflow_tpu.models import spec_from_keras_json
+
+
+def _write(tmp_path, layers, weights=None):
+    topo = {"model_config": {"class_name": "Sequential", "config": layers}}
+    if weights is not None:
+        manifest, buf = [], b""
+        for name, arr in weights:
+            manifest.append({"name": name, "shape": list(arr.shape),
+                             "dtype": str(arr.dtype)})
+            buf += np.ascontiguousarray(arr).tobytes()
+        topo["weightsManifest"] = [{"paths": ["g1"], "weights": manifest}]
+        (tmp_path / "g1").write_bytes(buf)
+    path = tmp_path / "model.json"
+    path.write_text(json.dumps(topo))
+    return str(path)
+
+
+def _layer(cls, name, batch_input=None, **cfg):
+    cfg["name"] = name
+    if batch_input is not None:
+        cfg["batch_input_shape"] = batch_input
+    return {"class_name": cls, "config": cfg}
+
+
+def hard_sigmoid(x):
+    return np.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+# -- embedding / conv1d / pooling -----------------------------------------
+
+
+def test_embedding_lookup_and_integer_input(tmp_path):
+    emb = np.arange(12, dtype=np.float32).reshape(6, 2)
+    path = _write(
+        tmp_path,
+        [_layer("Embedding", "emb_1", batch_input=[None, 4],
+                input_dim=6, output_dim=2)],
+        weights=[("emb_1/embeddings", emb)],
+    )
+    spec = spec_from_keras_json(path)
+    assert spec.output_shape == (4, 2)
+    params = spec.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray([[0, 5, 2, 2]], jnp.int32)
+    out = np.asarray(spec.apply(params, tokens))
+    np.testing.assert_array_equal(out[0], emb[[0, 5, 2, 2]])
+
+
+def test_conv1d_causal_matches_manual(tmp_path):
+    kernel = np.asarray([[[1.0]], [[2.0]]], np.float32)  # [k=2, c=1, f=1]
+    bias = np.asarray([0.5], np.float32)
+    path = _write(
+        tmp_path,
+        [_layer("Conv1D", "c1", batch_input=[None, 4, 1], filters=1,
+                kernel_size=[2], padding="causal", activation="linear",
+                use_bias=True)],
+        weights=[("c1/kernel", kernel), ("c1/bias", bias)],
+    )
+    spec = spec_from_keras_json(path)
+    assert spec.output_shape == (4, 1)  # causal keeps length
+    params = spec.init(jax.random.PRNGKey(0))
+    x = np.asarray([[[1.0], [2.0], [3.0], [4.0]]], np.float32)
+    out = np.asarray(spec.apply(params, jnp.asarray(x)))[0, :, 0]
+    # y_t = 1*x_{t-1} + 2*x_t + 0.5 (x_{-1}=0)
+    np.testing.assert_allclose(out, [2.5, 5.5, 8.5, 11.5])
+
+
+def test_pool1d_and_global_max(tmp_path):
+    path = _write(
+        tmp_path,
+        [
+            _layer("MaxPooling1D", "p1", batch_input=[None, 6, 2],
+                   pool_size=[2], strides=[2], padding="valid"),
+            _layer("GlobalMaxPooling1D", "g1"),
+        ],
+    )
+    spec = spec_from_keras_json(path)
+    assert spec.output_shape == (2,)
+    x = np.arange(12, dtype=np.float32).reshape(1, 6, 2)
+    out = np.asarray(spec.apply(spec.init(jax.random.PRNGKey(0)), jnp.asarray(x)))
+    np.testing.assert_array_equal(out[0], [10.0, 11.0])
+
+
+# -- recurrent cells vs numpy references -----------------------------------
+
+
+def _rnn_weights(rng, c, units, gates):
+    k = rng.randn(c, gates * units).astype(np.float32) * 0.5
+    rk = rng.randn(units, gates * units).astype(np.float32) * 0.5
+    b = rng.randn(gates * units).astype(np.float32) * 0.1
+    return k, rk, b
+
+
+def test_simple_rnn_matches_manual(tmp_path):
+    rng = np.random.RandomState(0)
+    c, units, s = 3, 2, 5
+    k, rk, b = _rnn_weights(rng, c, units, 1)
+    path = _write(
+        tmp_path,
+        [_layer("SimpleRNN", "rnn_1", batch_input=[None, s, c], units=units,
+                activation="tanh", return_sequences=True)],
+        weights=[("rnn_1/kernel", k), ("rnn_1/recurrent_kernel", rk),
+                 ("rnn_1/bias", b)],
+    )
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = rng.randn(2, s, c).astype(np.float32)
+    got = np.asarray(spec.apply(params, jnp.asarray(x)))
+
+    h = np.zeros((2, units), np.float32)
+    want = []
+    for t in range(s):
+        h = np.tanh(x[:, t] @ k + h @ rk + b)
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want, 1), rtol=2e-5)
+
+
+def test_lstm_matches_manual(tmp_path):
+    rng = np.random.RandomState(1)
+    c, units, s = 3, 2, 6
+    k, rk, b = _rnn_weights(rng, c, units, 4)
+    path = _write(
+        tmp_path,
+        [_layer("LSTM", "lstm_1", batch_input=[None, s, c], units=units,
+                activation="tanh", recurrent_activation="hard_sigmoid")],
+        weights=[("lstm_1/kernel", k), ("lstm_1/recurrent_kernel", rk),
+                 ("lstm_1/bias", b)],
+    )
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = rng.randn(2, s, c).astype(np.float32)
+    got = np.asarray(spec.apply(params, jnp.asarray(x)))  # [2, units] last h
+
+    h = cell = np.zeros((2, units), np.float32)
+    for t in range(s):
+        z = x[:, t] @ k + h @ rk + b
+        i, f, g, o = (z[:, n * units:(n + 1) * units] for n in range(4))
+        cell = hard_sigmoid(f) * cell + hard_sigmoid(i) * np.tanh(g)
+        h = hard_sigmoid(o) * np.tanh(cell)
+    np.testing.assert_allclose(got, h, rtol=2e-5)
+
+
+@pytest.mark.parametrize("reset_after", [False, True])
+def test_gru_matches_manual(tmp_path, reset_after):
+    rng = np.random.RandomState(2)
+    c, units, s = 3, 2, 5
+    k, rk, _ = _rnn_weights(rng, c, units, 3)
+    if reset_after:
+        b = rng.randn(2, 3 * units).astype(np.float32) * 0.1
+    else:
+        b = rng.randn(3 * units).astype(np.float32) * 0.1
+    path = _write(
+        tmp_path,
+        [_layer("GRU", "gru_1", batch_input=[None, s, c], units=units,
+                activation="tanh", recurrent_activation="hard_sigmoid",
+                reset_after=reset_after)],
+        weights=[("gru_1/kernel", k), ("gru_1/recurrent_kernel", rk),
+                 ("gru_1/bias", b)],
+    )
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = rng.randn(2, s, c).astype(np.float32)
+    got = np.asarray(spec.apply(params, jnp.asarray(x)))
+
+    def split3(v):
+        return v[..., :units], v[..., units:2 * units], v[..., 2 * units:]
+
+    h = np.zeros((2, units), np.float32)
+    for t in range(s):
+        bi = b[0] if reset_after else b
+        xz, xr, xh = split3(x[:, t] @ k + bi)
+        if reset_after:
+            hz, hr, hh = split3(h @ rk + b[1])
+            z = hard_sigmoid(xz + hz)
+            r = hard_sigmoid(xr + hr)
+            cand = np.tanh(xh + r * hh)
+        else:
+            rz, rr, rh = rk[:, :units], rk[:, units:2 * units], rk[:, 2 * units:]
+            z = hard_sigmoid(xz + h @ rz)
+            r = hard_sigmoid(xr + h @ rr)
+            cand = np.tanh(xh + (r * h) @ rh)
+        h = z * h + (1 - z) * cand
+    np.testing.assert_allclose(got, h, rtol=2e-5)
+
+
+def test_lstm_unit_forget_bias_cold_init(tmp_path):
+    path = _write(
+        tmp_path,
+        [_layer("LSTM", "lstm_1", batch_input=[None, 4, 3], units=2,
+                unit_forget_bias=True)],
+    )
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(
+        np.asarray(params["lstm_1"]["bias"]),
+        [0, 0, 1, 1, 0, 0, 0, 0],  # forget-gate block = ones
+    )
+
+
+def test_stateful_rnn_rejected(tmp_path):
+    path = _write(
+        tmp_path,
+        [_layer("LSTM", "lstm_1", batch_input=[None, 4, 3], units=2,
+                stateful=True)],
+    )
+    with pytest.raises(ValueError, match="stateful"):
+        spec_from_keras_json(path)
+
+
+def test_text_model_end_to_end_trains(tmp_path, devices):
+    """The classic tfjs text stack — Embedding -> LSTM -> Dense(softmax) —
+    imports and trains (sparse CE over integer tokens)."""
+    import dataclasses
+
+    from distriflow_tpu.parallel import data_parallel_mesh
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    layers = [
+        _layer("Embedding", "emb", batch_input=[None, 8], input_dim=16,
+               output_dim=4),
+        _layer("LSTM", "lstm", units=8, return_sequences=False),
+        _layer("Dense", "head", units=16, activation="softmax", use_bias=True),
+    ]
+    path = _write(tmp_path, layers)
+    spec = spec_from_keras_json(path)  # softmax folded into the loss
+    spec = dataclasses.replace(spec, loss="sparse_softmax_cross_entropy")
+    tr = SyncTrainer(spec, mesh=data_parallel_mesh(devices), learning_rate=0.1)
+    tr.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 16, (32, 8)).astype(np.int32)
+    y = x[:, -1]  # predict the last token: learnable from the sequence
+    l0 = float(tr.step((x, y)))
+    for _ in range(20):
+        ln = float(tr.step((x, y)))
+    assert ln < l0
+
+
+def test_inputlayer_then_embedding_keeps_integer_input(tmp_path):
+    """TF2 saves emit an explicit InputLayer before Embedding; token ids
+    must still bypass the float input cast."""
+    layers = [
+        _layer("InputLayer", "input_1", batch_input=[None, 4]),
+        _layer("Embedding", "emb", input_dim=1000, output_dim=2),
+    ]
+    emb = np.zeros((1000, 2), np.float32)
+    emb[999] = [7.0, 7.0]
+    path = _write(tmp_path, layers, weights=[("emb/embeddings", emb)])
+    spec = spec_from_keras_json(path, dtype=jnp.bfloat16)
+    params = spec.init(jax.random.PRNGKey(0))
+    # id 999 is not bf16-representable (would round to 1000): the lookup
+    # only works if ints never pass through the float cast
+    out = np.asarray(spec.apply(params, jnp.asarray([[999, 0, 0, 0]], jnp.int32)))
+    np.testing.assert_array_equal(out[0, 0].astype(np.float32), [7.0, 7.0])
+
+
+def test_embedding_mask_zero_rejected(tmp_path):
+    path = _write(
+        tmp_path,
+        [_layer("Embedding", "emb", batch_input=[None, 4], input_dim=8,
+                output_dim=2, mask_zero=True)],
+    )
+    with pytest.raises(ValueError, match="mask_zero"):
+        spec_from_keras_json(path)
+
+
+def test_h5_tf2_nested_rnn_weight_names(tmp_path):
+    """TF2 .h5 nests RNN weights under the cell scope
+    ('lstm/lstm_cell/kernel:0'); they must key to the layer group."""
+    import h5py
+
+    from distriflow_tpu.models import spec_from_keras_h5
+
+    rng = np.random.RandomState(5)
+    c, units = 3, 2
+    k = rng.randn(c, 4 * units).astype(np.float32)
+    rk = rng.randn(units, 4 * units).astype(np.float32)
+    b = rng.randn(4 * units).astype(np.float32)
+    mc = {"class_name": "Sequential", "config": [
+        _layer("LSTM", "lstm", batch_input=[None, 5, c], units=units),
+    ]}
+    path = str(tmp_path / "m.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(mc)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"lstm"]
+        g = mw.create_group("lstm")
+        names = ["lstm/lstm_cell/kernel:0", "lstm/lstm_cell/recurrent_kernel:0",
+                 "lstm/lstm_cell/bias:0"]
+        g.attrs["weight_names"] = [n.encode() for n in names]
+        for n, arr in zip(names, (k, rk, b)):
+            g.create_dataset(n, data=arr)
+    spec = spec_from_keras_h5(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(params["lstm"]["kernel"]), k)
+    out = spec.apply(params, jnp.asarray(rng.randn(2, 5, c), jnp.float32))
+    assert out.shape == (2, units)
