@@ -14,7 +14,8 @@ uses): Conv2D, DepthwiseConv2D, Conv1D (valid/same/causal), Dense,
 Activation, ReLU, MaxPooling1D/2D, AveragePooling1D/2D,
 GlobalAveragePooling1D/2D, GlobalMaxPooling1D/2D, Flatten, Reshape,
 ZeroPadding2D, Dropout, SpatialDropout1D, BatchNormalization, InputLayer,
-Embedding, SimpleRNN, LSTM, GRU (both ``reset_after`` variants); plus the
+Embedding, SimpleRNN, LSTM, GRU (both ``reset_after`` variants),
+Bidirectional (concat/sum/ave/mul merges); plus the
 merge layers Add, Subtract, Multiply, Average, Maximum, Minimum,
 Concatenate in graph-form models. RNNs follow Keras semantics exactly
 (gate order i|f|c|o for LSTM, z|r|h for GRU, ``unit_forget_bias`` init);
@@ -144,6 +145,19 @@ def _kernel_init(cfg: Dict[str, Any]) -> Callable[..., jnp.ndarray]:
                         or {"class_name": "GlorotUniform"})
 
 
+def _feature_shape(batch_input_shape, where: str) -> Tuple[int, ...]:
+    """batch_input_shape -> feature shape; dynamic (null) dims get the same
+    actionable error as a missing shape instead of a raw TypeError."""
+    dims = batch_input_shape[1:]
+    if any(d is None for d in dims):
+        raise ValueError(
+            f"{where}: batch_input_shape {batch_input_shape} has dynamic "
+            "(null) dimensions; this importer builds static-shape programs "
+            "— pass input_shape= with concrete sizes"
+        )
+    return tuple(int(d) for d in dims)
+
+
 def _pool_padding(cfg: Dict[str, Any]) -> str:
     return {"valid": "VALID", "same": "SAME"}[cfg.get("padding", "valid")]
 
@@ -186,7 +200,7 @@ class _Builder:
     def add(self, class_name: str, cfg: Dict[str, Any]) -> None:
         name = cfg.get("name", f"{class_name.lower()}_{len(self.fns)}")
         if self.shape is None and "batch_input_shape" in cfg:
-            self.shape = tuple(int(d) for d in cfg["batch_input_shape"][1:])
+            self.shape = _feature_shape(cfg["batch_input_shape"], name)
         handler = getattr(self, f"_add_{class_name}", None)
         if handler is None:
             raise ValueError(
@@ -287,13 +301,11 @@ class _Builder:
         self.shape = (oh, ow, cin * mult)
 
     def _add_Dense(self, name: str, cfg: Dict[str, Any]) -> None:
+        # Keras Dense applies along the LAST axis of any-rank input (e.g.
+        # a per-timestep head after return_sequences=True) — no Flatten
+        # needed; x @ kernel broadcasts the leading dims
         shape = self._need_shape(name)
-        if len(shape) != 1:
-            raise ValueError(
-                f"Dense layer {name!r} expects flat input, got feature shape "
-                f"{shape}; insert a Flatten layer first (Keras would too)"
-            )
-        (fan_in,) = shape
+        fan_in = shape[-1]
         units = int(cfg["units"])
         use_bias = cfg.get("use_bias", True)
         act = _activation(cfg.get("activation"))
@@ -302,7 +314,7 @@ class _Builder:
             weights["bias"] = ((units,), _initializer(cfg.get("bias_initializer")))
         self._register(name, weights)
         self.fns.append(_dense_fn(name, use_bias, act))
-        self.shape = (units,)
+        self.shape = shape[:-1] + (units,)
 
     def _add_InputLayer(self, name: str, cfg: Dict[str, Any]) -> None:
         # identity; exists only to carry batch_input_shape (consumed in add())
@@ -458,6 +470,60 @@ class _Builder:
         self.shape = (s, units) if ret_seq else (units,)
         return c, units, use_bias, ret_seq
 
+    def _add_Bidirectional(self, name: str, cfg: Dict[str, Any]) -> None:
+        """Forward + time-reversed copies of the wrapped RNN, merged.
+
+        Param keys follow the Keras/tfjs convention
+        ``<bidi_name>/forward_<inner_name>`` / ``backward_<inner_name>`` so
+        exported weight manifests resolve directly.
+        """
+        inner = cfg.get("layer")
+        if not inner:
+            raise ValueError(f"Bidirectional {name!r} has no wrapped layer")
+        icls = inner["class_name"]
+        if icls not in ("SimpleRNN", "LSTM", "GRU"):
+            raise ValueError(
+                f"Bidirectional wraps {icls!r}; only SimpleRNN/LSTM/GRU "
+                "are supported"
+            )
+        merge = cfg.get("merge_mode", "concat")
+        if merge not in ("concat", "sum", "ave", "mul"):
+            raise ValueError(f"Bidirectional merge_mode {merge!r} unsupported")
+        icfg = dict(inner.get("config", {}))
+        inner_name = icfg.get("name", icls.lower())
+        ret_seq = bool(icfg.get("return_sequences", False))
+        in_shape = self._need_shape(name)
+        handler = getattr(self, f"_add_{icls}")
+        fns = {}
+        for direction in ("forward", "backward"):
+            sub = dict(icfg)
+            sub["name"] = f"{name}/{direction}_{inner_name}"
+            self.shape = in_shape  # both copies see the wrapper's input
+            handler(sub["name"], sub)
+            fns[direction] = self.fns.pop()  # wrapper emits ONE combined fn
+        out_shape = self.shape  # one direction's output shape
+        fwd, bwd = fns["forward"], fns["backward"]
+
+        def fn(params: Params, x: jnp.ndarray, fwd=fwd, bwd=bwd,
+               merge=merge, ret_seq=ret_seq):
+            f = fwd(params, x)
+            b = bwd(params, x[:, ::-1])
+            if ret_seq:
+                b = b[:, ::-1]  # re-align to forward time order
+            if merge == "concat":
+                return jnp.concatenate([f, b], axis=-1)
+            if merge == "sum":
+                return f + b
+            if merge == "ave":
+                return (f + b) / 2.0
+            return f * b  # mul
+
+        self.fns.append(fn)
+        if merge == "concat":
+            self.shape = out_shape[:-1] + (2 * out_shape[-1],)
+        else:
+            self.shape = out_shape
+
     def _add_SimpleRNN(self, name: str, cfg: Dict[str, Any]) -> None:
         c, units, use_bias, ret_seq = self._rnn_common(name, cfg)
         act = _activation(cfg.get("activation", "tanh"))
@@ -486,7 +552,7 @@ class _Builder:
                 return (h,), h
 
             h0 = jnp.zeros((x.shape[0], units), jnp.float32)
-            return _scan_rnn(step, (h0,), x, ret_seq)
+            return _scan_rnn(step, (h0,), x, ret_seq).astype(x.dtype)
 
         self.fns.append(fn)
 
@@ -532,7 +598,7 @@ class _Builder:
                 return (h, cell), h
 
             h0 = jnp.zeros((x.shape[0], units), jnp.float32)
-            return _scan_rnn(step, (h0, h0), x, ret_seq)
+            return _scan_rnn(step, (h0, h0), x, ret_seq).astype(x.dtype)
 
         self.fns.append(fn)
 
@@ -588,7 +654,7 @@ class _Builder:
                 return (h,), h
 
             h0 = jnp.zeros((x.shape[0], units), jnp.float32)
-            return _scan_rnn(step, (h0,), x, ret_seq)
+            return _scan_rnn(step, (h0,), x, ret_seq).astype(x.dtype)
 
         self.fns.append(fn)
 
@@ -851,7 +917,7 @@ def _build_graph(
                         "graphs are not supported"
                     )
                 shape = cfg.get("batch_input_shape")
-                shape = tuple(int(d) for d in shape[1:]) if shape else input_shape
+                shape = _feature_shape(shape, name) if shape else input_shape
                 if shape is None:
                     raise ValueError(
                         f"input layer {name!r} has no batch_input_shape; "
@@ -1071,8 +1137,17 @@ def _load_h5_weights(mw: Any) -> Params:
             leaf = wpath.rpartition("/")[2].split(":")[0]
             # the enclosing group IS the layer; TF2 nests RNN weights one
             # scope deeper ('lstm/lstm_cell/kernel:0') but they still
-            # belong to this group's layer
-            params.setdefault(lname, {})[leaf] = jnp.asarray(arr)
+            # belong to this group's layer. Bidirectional wrappers are the
+            # exception: forward_/backward_ scopes are distinct param sets
+            # ('bidi/forward_lstm/.../kernel:0' -> key 'bidi/forward_lstm')
+            key = lname
+            for seg in wpath.split("/")[:2]:  # scope may or may not repeat lname
+                if seg == lname:
+                    continue  # the layer's own name, even if 'forward_*'
+                if seg.startswith(("forward_", "backward_")):
+                    key = f"{lname}/{seg}"
+                break  # only the segment right after the (optional) lname
+            params.setdefault(key, {})[leaf] = jnp.asarray(arr)
     return params
 
 
@@ -1141,7 +1216,10 @@ def _spec_from_topology(
         for key, (lname, weights) in zip(keys, sorted(inits.items())):
             subkeys = jax.random.split(key, max(1, len(weights)))
             params[lname] = {
-                wname: initf(k, shape, dtype)
+                # init in f32 then cast: some initializers (Orthogonal's
+                # QR) have no low-precision kernels, and f32 init is the
+                # numerically faithful Keras behavior anyway
+                wname: initf(k, shape, jnp.float32).astype(dtype)
                 for k, (wname, (shape, initf)) in zip(subkeys, sorted(weights.items()))
             }
         return params
@@ -1167,7 +1245,8 @@ def _input_shape_from(layers: List[Dict[str, Any]]) -> Tuple[int, ...]:
     for layer in layers:
         cfg = layer.get("config", {})
         if "batch_input_shape" in cfg:
-            return tuple(int(d) for d in cfg["batch_input_shape"][1:])
+            return _feature_shape(cfg["batch_input_shape"],
+                                  cfg.get("name", "input"))
     raise ValueError("no batch_input_shape found; pass input_shape=")
 
 

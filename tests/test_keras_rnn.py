@@ -308,3 +308,168 @@ def test_h5_tf2_nested_rnn_weight_names(tmp_path):
     np.testing.assert_array_equal(np.asarray(params["lstm"]["kernel"]), k)
     out = spec.apply(params, jnp.asarray(rng.randn(2, 5, c), jnp.float32))
     assert out.shape == (2, units)
+
+
+# -- bidirectional ---------------------------------------------------------
+
+
+def test_bidirectional_lstm_matches_manual(tmp_path):
+    """Bidirectional(LSTM, concat): forward pass + time-reversed pass,
+    weights loaded under the Keras/tfjs forward_/backward_ naming."""
+    rng = np.random.RandomState(3)
+    c, units, s = 3, 2, 4
+    kf, rkf, bf = _rnn_weights(rng, c, units, 4)
+    kb, rkb, bb = _rnn_weights(rng, c, units, 4)
+    layers = [{
+        "class_name": "Bidirectional",
+        "config": {
+            "name": "bidi",
+            "merge_mode": "concat",
+            "batch_input_shape": [None, s, c],
+            "layer": {"class_name": "LSTM",
+                      "config": {"name": "lstm_1", "units": units,
+                                 "recurrent_activation": "hard_sigmoid",
+                                 "return_sequences": True}},
+        },
+    }]
+    path = _write(tmp_path, layers, weights=[
+        ("bidi/forward_lstm_1/kernel", kf),
+        ("bidi/forward_lstm_1/recurrent_kernel", rkf),
+        ("bidi/forward_lstm_1/bias", bf),
+        ("bidi/backward_lstm_1/kernel", kb),
+        ("bidi/backward_lstm_1/recurrent_kernel", rkb),
+        ("bidi/backward_lstm_1/bias", bb),
+    ])
+    spec = spec_from_keras_json(path)
+    assert spec.output_shape == (s, 2 * units)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = rng.randn(2, s, c).astype(np.float32)
+    got = np.asarray(spec.apply(params, jnp.asarray(x)))
+
+    def lstm(x_, k, rk, b):
+        h = cell = np.zeros((x_.shape[0], units), np.float32)
+        out = []
+        for t in range(x_.shape[1]):
+            z = x_[:, t] @ k + h @ rk + b
+            i, f, g, o = (z[:, n * units:(n + 1) * units] for n in range(4))
+            cell = hard_sigmoid(f) * cell + hard_sigmoid(i) * np.tanh(g)
+            h = hard_sigmoid(o) * np.tanh(cell)
+            out.append(h)
+        return np.stack(out, 1)
+
+    fwd = lstm(x, kf, rkf, bf)
+    bwd = lstm(x[:, ::-1], kb, rkb, bb)[:, ::-1]
+    np.testing.assert_allclose(got, np.concatenate([fwd, bwd], -1), rtol=2e-5)
+
+
+def test_bidirectional_last_state_and_merge_sum(tmp_path):
+    layers = [{
+        "class_name": "Bidirectional",
+        "config": {
+            "name": "bidi", "merge_mode": "sum",
+            "batch_input_shape": [None, 5, 3],
+            "layer": {"class_name": "GRU",
+                      "config": {"name": "gru_1", "units": 4}},
+        },
+    }]
+    path = _write(tmp_path, layers)
+    spec = spec_from_keras_json(path)
+    assert spec.output_shape == (4,)  # return_sequences=False, sum merge
+    params = spec.init(jax.random.PRNGKey(0))
+    assert set(params) == {"bidi/forward_gru_1", "bidi/backward_gru_1"}
+    out = spec.apply(params, jnp.ones((2, 5, 3)))
+    assert out.shape == (2, 4)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_h5_bidirectional_scoped_weights(tmp_path):
+    """TF2 .h5 bidirectional scopes ('forward_lstm/lstm_cell/kernel:0')
+    resolve to the per-direction param sets."""
+    import h5py
+
+    from distriflow_tpu.models import spec_from_keras_h5
+
+    rng = np.random.RandomState(7)
+    c, units = 3, 2
+    mk = lambda g: _rnn_weights(rng, c, units, 4)
+    wf, wb = mk(0), mk(1)
+    mc = {"class_name": "Sequential", "config": [{
+        "class_name": "Bidirectional",
+        "config": {"name": "bidi", "batch_input_shape": [None, 4, c],
+                   "layer": {"class_name": "LSTM",
+                             "config": {"name": "lstm", "units": units}}},
+    }]}
+    path = str(tmp_path / "m.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(mc)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"bidi"]
+        g = mw.create_group("bidi")
+        names, arrs = [], []
+        for d, (k, rk, b) in (("forward_lstm", wf), ("backward_lstm", wb)):
+            for leaf, arr in (("kernel", k), ("recurrent_kernel", rk), ("bias", b)):
+                names.append(f"{d}/lstm_cell/{leaf}:0")
+                arrs.append(arr)
+        g.attrs["weight_names"] = [n.encode() for n in names]
+        for n, a in zip(names, arrs):
+            g.create_dataset(n, data=a)
+    spec = spec_from_keras_h5(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(
+        np.asarray(params["bidi/forward_lstm"]["kernel"]), wf[0])
+    np.testing.assert_array_equal(
+        np.asarray(params["bidi/backward_lstm"]["kernel"]), wb[0])
+
+
+def test_dense_over_sequences_and_bf16_dtype(tmp_path):
+    """LSTM(return_sequences) -> Dense applies per timestep (no Flatten),
+    and a bfloat16 import keeps the RNN tail in bfloat16."""
+    layers = [
+        _layer("LSTM", "lstm", batch_input=[None, 5, 3], units=4,
+               return_sequences=True),
+        _layer("Dense", "head", units=7, activation="linear"),
+    ]
+    path = _write(tmp_path, layers)
+    spec = spec_from_keras_json(path, dtype=jnp.bfloat16)
+    assert spec.output_shape == (5, 7)
+    params = spec.init(jax.random.PRNGKey(0))
+    out = spec.apply(params, jnp.ones((2, 5, 3)))
+    assert out.shape == (2, 5, 7)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_dynamic_sequence_dim_actionable_error(tmp_path):
+    path = _write(
+        tmp_path,
+        [_layer("Embedding", "emb", batch_input=[None, None], input_dim=8,
+                output_dim=2)],
+    )
+    with pytest.raises(ValueError, match="input_shape="):
+        spec_from_keras_json(path)
+    # the documented workaround works
+    spec = spec_from_keras_json(path, input_shape=(6,))
+    assert spec.output_shape == (6, 2)
+
+
+def test_h5_layer_named_forward_not_treated_as_scope(tmp_path):
+    import h5py
+
+    from distriflow_tpu.models import spec_from_keras_h5
+
+    kernel = np.ones((3, 2), np.float32)
+    mc = {"class_name": "Sequential", "config": [
+        _layer("Dense", "forward_head", batch_input=[None, 3], units=2,
+               activation="linear", use_bias=False),
+    ]}
+    path = str(tmp_path / "m.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(mc)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"forward_head"]
+        g = mw.create_group("forward_head")
+        g.attrs["weight_names"] = [b"forward_head/kernel:0"]
+        g.create_dataset("forward_head/kernel:0", data=kernel)
+    spec = spec_from_keras_h5(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(
+        np.asarray(params["forward_head"]["kernel"]), kernel)
