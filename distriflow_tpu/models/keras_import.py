@@ -13,7 +13,8 @@ Supported layers (the tfjs-layers subset the reference ecosystem actually
 uses): Conv2D, DepthwiseConv2D, Conv1D (valid/same/causal), Dense,
 Activation, ReLU, MaxPooling1D/2D, AveragePooling1D/2D,
 GlobalAveragePooling1D/2D, GlobalMaxPooling1D/2D, Flatten, Reshape,
-ZeroPadding2D, Dropout, SpatialDropout1D, BatchNormalization, InputLayer,
+ZeroPadding2D, UpSampling2D, Conv2DTranspose, Dropout,
+SpatialDropout1D, BatchNormalization, LayerNormalization, InputLayer,
 Embedding, SimpleRNN, LSTM, GRU (both ``reset_after`` variants),
 Bidirectional (concat/sum/ave/mul merges); plus the
 merge layers Add, Subtract, Multiply, Average, Maximum, Minimum,
@@ -205,12 +206,13 @@ class _Builder:
         if handler is None:
             raise ValueError(
                 f"unsupported layer {class_name!r}; supported: Conv1D/2D, "
-                "DepthwiseConv2D, Dense, Embedding, SimpleRNN, LSTM, GRU, "
-                "Activation, ReLU, Max/AveragePooling1D/2D, "
-                "GlobalAverage/MaxPooling1D/2D, Flatten, Reshape, "
-                "ZeroPadding2D, Dropout, SpatialDropout1D, "
-                "BatchNormalization, InputLayer (+ Add/Subtract/Multiply/"
-                "Average/Maximum/Minimum/Concatenate in Functional graphs)"
+                "DepthwiseConv2D, Conv2DTranspose, UpSampling2D, Dense, "
+                "Embedding, SimpleRNN, LSTM, GRU, Bidirectional, Activation, "
+                "ReLU, Max/AveragePooling1D/2D, GlobalAverage/MaxPooling1D/2D, "
+                "Flatten, Reshape, ZeroPadding2D, Dropout, SpatialDropout1D, "
+                "BatchNormalization, LayerNormalization, InputLayer "
+                "(+ Add/Subtract/Multiply/Average/Maximum/Minimum/"
+                "Concatenate in Functional graphs)"
             )
         handler(name, cfg)
         self.names.append(name)  # every handler appends exactly one fn
@@ -299,6 +301,115 @@ class _Builder:
         oh = _conv_dim(h, ek_h, strides[0], padding)
         ow = _conv_dim(w, ek_w, strides[1], padding)
         self.shape = (oh, ow, cin * mult)
+
+    def _add_UpSampling2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        h, w, c = self._need_shape(name)
+        size = cfg.get("size", (2, 2))
+        sh, sw = (int(size), int(size)) if isinstance(size, int) else (
+            int(size[0]), int(size[1]))
+        interp = cfg.get("interpolation", "nearest")
+        if interp != "nearest":
+            raise ValueError(
+                f"UpSampling2D {name!r}: only 'nearest' interpolation is "
+                f"supported, got {interp!r}"
+            )
+
+        def fn(params: Params, x: jnp.ndarray, sh=sh, sw=sw):
+            return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+
+        self.fns.append(fn)
+        self.shape = (h * sh, w * sw, c)
+
+    def _add_Conv2DTranspose(self, name: str, cfg: Dict[str, Any]) -> None:
+        h, w, cin = self._need_shape(name)
+        kh, kw = (int(d) for d in cfg["kernel_size"])
+        filters = int(cfg["filters"])
+        strides = tuple(int(s) for s in cfg.get("strides", (1, 1)))
+        dl = cfg.get("dilation_rate", (1, 1))
+        if tuple(int(d) for d in (dl if isinstance(dl, (list, tuple)) else (dl, dl))) != (1, 1):
+            raise ValueError(
+                f"Conv2DTranspose {name!r}: dilation_rate != 1 is not supported"
+            )
+        if cfg.get("output_padding") is not None:
+            raise ValueError(
+                f"Conv2DTranspose {name!r}: output_padding is not supported"
+            )
+        padding = _pool_padding(cfg)
+        use_bias = cfg.get("use_bias", True)
+        act = _activation(cfg.get("activation"))
+        # Keras stores the transpose kernel as (kh, kw, OUT, IN)
+        weights = {"kernel": ((kh, kw, filters, cin), _kernel_init(cfg))}
+        if use_bias:
+            weights["bias"] = ((filters,), _initializer(cfg.get("bias_initializer")))
+        self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, strides=strides,
+               padding=padding, use_bias=use_bias, act=act):
+            p = params[name]
+            # transpose_kernel=True consumes the (kh, kw, OUT, IN) layout
+            # directly AND applies the spatial flip — the gradient-of-conv
+            # semantics Keras/TF implement (a plain channel swap without the
+            # flip is wrong for any kernel larger than 1x1)
+            y = jax.lax.conv_transpose(
+                x, p["kernel"].astype(x.dtype), strides, padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                transpose_kernel=True,
+            )
+            if use_bias:
+                y = y + p["bias"].astype(y.dtype)
+            return act(y)
+
+        self.fns.append(fn)
+        if padding == "SAME":
+            oh, ow = h * strides[0], w * strides[1]
+        else:  # VALID: Keras formula
+            oh = h * strides[0] + max(kh - strides[0], 0)
+            ow = w * strides[1] + max(kw - strides[1], 0)
+        self.shape = (oh, ow, filters)
+
+    def _add_LayerNormalization(self, name: str, cfg: Dict[str, Any]) -> None:
+        shape = self._need_shape(name)
+        axis = cfg.get("axis", -1)
+        if isinstance(axis, (list, tuple)):
+            if len(axis) != 1:
+                raise ValueError(
+                    f"LayerNormalization {name!r}: multi-axis normalization "
+                    "is not supported"
+                )
+            axis = axis[0]
+        full_rank = len(shape) + 1
+        if axis % full_rank != full_rank - 1:
+            raise ValueError(
+                f"LayerNormalization {name!r}: only last-axis normalization "
+                f"is supported, got axis={axis}"
+            )
+        c = shape[-1]
+        eps = float(cfg.get("epsilon", 1e-3))
+        scale = cfg.get("scale", True)
+        center = cfg.get("center", True)
+        weights = {}
+        if scale:
+            weights["gamma"] = ((c,), _initializer(
+                cfg.get("gamma_initializer") or {"class_name": "Ones"}))
+        if center:
+            weights["beta"] = ((c,), _initializer(
+                cfg.get("beta_initializer") or {"class_name": "Zeros"}))
+        if weights:
+            self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, eps=eps,
+               scale=scale, center=center):
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+            y = (xf - mean) * jax.lax.rsqrt(var + eps)
+            if scale:
+                y = y * params[name]["gamma"].astype(jnp.float32)
+            if center:
+                y = y + params[name]["beta"].astype(jnp.float32)
+            return y.astype(x.dtype)
+
+        self.fns.append(fn)
 
     def _add_Dense(self, name: str, cfg: Dict[str, Any]) -> None:
         # Keras Dense applies along the LAST axis of any-rank input (e.g.

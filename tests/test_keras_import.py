@@ -616,3 +616,155 @@ def test_h5_unparseable_weights_rejected(tmp_path):
         spec_from_keras_h5(path)
     spec = spec_from_keras_h5(path, load_weights=False)  # explicit cold init
     assert spec.init(jax.random.PRNGKey(0))["dense_1"]["kernel"].shape == (4, 5)
+
+
+def test_upsampling_and_conv_transpose(tmp_path):
+    """Decoder-style stack: UpSampling2D doubles spatially; Conv2DTranspose
+    SAME/stride-2 doubles again with Keras' (kh, kw, out, in) kernel layout."""
+    topo = {
+        "model_config": {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "UpSampling2D",
+                 "config": {"name": "up_1", "size": [2, 2],
+                            "batch_input_shape": [None, 2, 2, 3]}},
+                {"class_name": "Conv2DTranspose",
+                 "config": {"name": "dc_1", "filters": 5, "kernel_size": [3, 3],
+                            "strides": [2, 2], "padding": "same",
+                            "activation": "linear", "use_bias": True}},
+            ],
+        }
+    }
+    path = _write_model(tmp_path, topo)
+    spec = spec_from_keras_json(path)
+    assert spec.output_shape == (8, 8, 5)
+    params = spec.init(jax.random.PRNGKey(0))
+    assert params["dc_1"]["kernel"].shape == (3, 3, 5, 3)  # (kh, kw, OUT, IN)
+    x = np.arange(12, dtype=np.float32).reshape(1, 2, 2, 3)
+    out = spec.apply(params, jnp.asarray(x))
+    assert out.shape == (1, 8, 8, 5)
+
+
+def test_conv_transpose_identity_kernel(tmp_path):
+    """1x1 stride-1 transpose conv with identity kernel == identity map
+    (validates the Keras (out, in) -> HWIO (in, out) kernel swap)."""
+    kernel = np.zeros((1, 1, 2, 2), np.float32)  # (kh, kw, out, in)
+    kernel[0, 0, 0, 0] = 1.0  # out0 <- in0
+    kernel[0, 0, 1, 1] = 1.0  # out1 <- in1
+    topo = {
+        "model_config": {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Conv2DTranspose",
+                 "config": {"name": "dc", "filters": 2, "kernel_size": [1, 1],
+                            "padding": "valid", "activation": "linear",
+                            "use_bias": False,
+                            "batch_input_shape": [None, 3, 3, 2]}},
+            ],
+        }
+    }
+    path = _write_model(tmp_path, topo, weights=[("dc/kernel", kernel)])
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randn(2, 3, 3, 2).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.apply(params, jnp.asarray(x))), x, rtol=1e-6)
+
+
+def test_layernorm_matches_manual(tmp_path):
+    gamma = np.asarray([2.0, 3.0], np.float32)
+    beta = np.asarray([0.5, -0.5], np.float32)
+    topo = {
+        "model_config": {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "LayerNormalization",
+                 "config": {"name": "ln", "epsilon": 1e-5,
+                            "batch_input_shape": [None, 4, 2]}},
+            ],
+        }
+    }
+    path = _write_model(tmp_path, topo,
+                        weights=[("ln/gamma", gamma), ("ln/beta", beta)])
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(1).randn(2, 4, 2).astype(np.float32)
+    got = np.asarray(spec.apply(params, jnp.asarray(x)))
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5) * gamma + beta
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_upsampling_output_is_nearest_neighbor(tmp_path):
+    topo = {
+        "model_config": {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "UpSampling2D",
+                 "config": {"name": "up", "size": [2, 3],
+                            "batch_input_shape": [None, 2, 2, 1]}},
+            ],
+        }
+    }
+    path = _write_model(tmp_path, topo)
+    spec = spec_from_keras_json(path)
+    assert spec.output_shape == (4, 6, 1)
+    x = np.arange(4, dtype=np.float32).reshape(1, 2, 2, 1)
+    out = np.asarray(spec.apply(spec.init(jax.random.PRNGKey(0)), jnp.asarray(x)))
+    want = np.repeat(np.repeat(x, 2, axis=1), 3, axis=2)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_conv_transpose_matches_scatter_reference(tmp_path):
+    """3x3 stride-2 VALID transpose conv vs the scatter-add definition:
+    out[i*s+p, j*s+q, o] += x[i, j, c] * K[p, q, o, c] (Keras semantics)."""
+    rng = np.random.RandomState(4)
+    kh = kw = 3
+    stride = 2
+    h = w = 3
+    cin, cout = 2, 4
+    kernel = rng.randn(kh, kw, cout, cin).astype(np.float32)
+    topo = {
+        "model_config": {
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Conv2DTranspose",
+                 "config": {"name": "dc", "filters": cout,
+                            "kernel_size": [kh, kw], "strides": [stride, stride],
+                            "padding": "valid", "activation": "linear",
+                            "use_bias": False,
+                            "batch_input_shape": [None, h, w, cin]}},
+            ],
+        }
+    }
+    path = _write_model(tmp_path, topo, weights=[("dc/kernel", kernel)])
+    spec = spec_from_keras_json(path)
+    oh = h * stride + max(kh - stride, 0)
+    assert spec.output_shape == (oh, oh, cout)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = rng.randn(1, h, w, cin).astype(np.float32)
+    got = np.asarray(spec.apply(params, jnp.asarray(x)))
+
+    want = np.zeros((1, oh, oh, cout), np.float32)
+    for i in range(h):
+        for j in range(w):
+            for p in range(kh):
+                for q in range(kw):
+                    want[0, i * stride + p, j * stride + q] += (
+                        x[0, i, j] @ kernel[p, q].T
+                    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose_unsupported_options_raise(tmp_path):
+    base = {"name": "dc", "filters": 2, "kernel_size": [3, 3],
+            "padding": "same", "batch_input_shape": [None, 4, 4, 2]}
+    for extra, match in (({"dilation_rate": [2, 2]}, "dilation_rate"),
+                         ({"output_padding": [1, 1]}, "output_padding")):
+        topo = {"model_config": {"class_name": "Sequential", "config": [
+            {"class_name": "Conv2DTranspose", "config": {**base, **extra}}]}}
+        path = tmp_path / f"m_{match}.json"
+        path.write_text(json.dumps(topo))
+        with pytest.raises(ValueError, match=match):
+            spec_from_keras_json(str(path))
