@@ -19,7 +19,11 @@ from distriflow_tpu.models.losses import (
 )
 from distriflow_tpu.models.base import with_uint8_inputs
 from distriflow_tpu.models.generate import beam_search, generate, sequence_logprob
-from distriflow_tpu.models.keras_import import spec_from_keras_h5, spec_from_keras_json
+from distriflow_tpu.models.keras_import import (
+    export_keras_weights,
+    spec_from_keras_h5,
+    spec_from_keras_json,
+)
 from distriflow_tpu.models.mobilenet import MobileNetV2, mobilenet_v2
 from distriflow_tpu.models.zoo import MLP, ConvNet, cifar_convnet, mnist_convnet, mnist_mlp
 
@@ -48,6 +52,7 @@ __all__ = [
     "beam_search",
     "generate",
     "sequence_logprob",
+    "export_keras_weights",
     "spec_from_keras_h5",
     "spec_from_keras_json",
     "with_uint8_inputs",

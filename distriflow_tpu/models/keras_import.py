@@ -1352,6 +1352,50 @@ def _spec_from_topology(
     )
 
 
+def export_keras_weights(
+    topology_path: str,
+    params: Params,
+    out_dir: str,
+    shard_name: str = "group1-shard1of1",
+) -> str:
+    """Write a tfjs-layers model.json + weight shard from trained params.
+
+    The round-trip back to the reference's ecosystem: import a model.json
+    (or start from any topology file), train the params here, then export —
+    the output directory holds a ``model.json`` whose ``weightsManifest``
+    points at a single binary shard, loadable by ``tf.loadLayersModel``
+    (and by :func:`spec_from_keras_json`). Weight entries follow the param
+    tree's ``<layer>/<weight>`` naming; values are written float32.
+
+    Returns the path of the written model.json.
+    """
+    with open(topology_path) as f:
+        topology = json.load(f)
+    mt = topology.get("modelTopology", topology)
+    os.makedirs(out_dir, exist_ok=True)
+    manifest_weights: List[Dict[str, Any]] = []
+    blob = b""
+    for lname in sorted(params):
+        for wname in sorted(params[lname]):
+            arr = np.asarray(params[lname][wname], np.float32)
+            manifest_weights.append({
+                "name": f"{lname}/{wname}",
+                "shape": list(arr.shape),
+                "dtype": "float32",
+            })
+            blob += np.ascontiguousarray(arr).tobytes()
+    with open(os.path.join(out_dir, shard_name), "wb") as f:
+        f.write(blob)
+    out = {
+        "modelTopology": mt,
+        "weightsManifest": [{"paths": [shard_name], "weights": manifest_weights}],
+    }
+    out_path = os.path.join(out_dir, "model.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+    return out_path
+
+
 def _input_shape_from(layers: List[Dict[str, Any]]) -> Tuple[int, ...]:
     for layer in layers:
         cfg = layer.get("config", {})
