@@ -22,6 +22,7 @@ import json
 import os
 import shutil
 import tempfile
+import threading
 import time
 import warnings
 from typing import Any, Dict, List, Optional, Tuple
@@ -42,14 +43,28 @@ DATA_BIN = "data.bin"
 META_JSON = "meta.json"
 
 
+_version_lock = threading.Lock()
+_last_version = 0
+
+
 def timestamp_version() -> str:
-    """Millisecond timestamp version (reference ``Date.now()`` dirs).
+    """Millisecond timestamp version (reference ``Date.now()`` dirs),
+    strictly monotonic within the process.
 
     The single source of the version-string format: it doubles as the wire
     coherence token AND the checkpoint directory name, so there must be
-    exactly one producer.
+    exactly one producer. The reference's raw ``Date.now()`` collides when
+    two aggregations land in the same millisecond — a collision corrupts
+    staleness tracking (two distinct model states share a token) and reuses
+    a checkpoint directory, so same-ms calls bump by one instead.
     """
-    return str(int(time.time() * 1000))
+    global _last_version
+    with _version_lock:
+        now = int(time.time() * 1000)
+        if now <= _last_version:
+            now = _last_version + 1
+        _last_version = now
+        return str(now)
 
 
 _timestamp_version = timestamp_version  # internal alias
@@ -144,7 +159,15 @@ class CheckpointStore:
 
     def _prune(self) -> None:
         """Delete versions beyond the newest ``max_to_keep`` (runs on the
-        publishing process only — multi-host safe for the sharded store)."""
+        publishing process only — multi-host safe for the sharded store).
+
+        Retention races with concurrent readers of *non-current* versions:
+        a reader mid-``load`` on an old version string can lose files under
+        it (the trash-then-delete move narrows but does not close the
+        window). Readers should resolve via the ``current`` pointer — whose
+        target prune never deletes — rather than pinning old version
+        strings; pin an old version only with ``max_to_keep=None``.
+        """
         if self.max_to_keep is None:
             return
         versions = self.list()

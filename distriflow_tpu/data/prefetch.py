@@ -70,7 +70,27 @@ def sampling_iterator(
 def to_uint8_wire(imgs, labels):
     """Cast an image split to the wire-efficient form: uint8 pixels +
     int32 labels (4x + one-hot-factor fewer host->device bytes). Pair with
-    ``distriflow_tpu.models.with_uint8_inputs`` and a sparse loss."""
+    ``distriflow_tpu.models.with_uint8_inputs`` and a sparse loss.
+
+    Expects raw [0, 255] pixels. Already-normalized float inputs are
+    rejected: ``astype(uint8)`` would silently truncate [0, 1] floats to
+    zeros (and wrap values > 255), and the float guard downstream in
+    ``with_uint8_inputs`` cannot catch it — the data is uint8 by then.
+    """
     import numpy as np
 
-    return imgs.astype(np.uint8), labels.astype(np.int32)
+    imgs = np.asarray(imgs)
+    if np.issubdtype(imgs.dtype, np.floating):
+        lo, hi = float(imgs.min()), float(imgs.max())
+        if hi <= 1.0 + 1e-6:
+            raise ValueError(
+                f"to_uint8_wire got float images in [{lo:.3g}, {hi:.3g}] — "
+                "looks normalized; casting to uint8 would zero them. Pass "
+                "raw [0, 255] pixels (or multiply by 255 first)."
+            )
+        if lo < 0 or hi > 255:
+            raise ValueError(
+                f"to_uint8_wire got float images in [{lo:.3g}, {hi:.3g}] — "
+                "outside [0, 255]; uint8 cast would wrap. Rescale first."
+            )
+    return imgs.astype(np.uint8), np.asarray(labels).astype(np.int32)

@@ -14,10 +14,13 @@ to the top-k logits and/or a top-p (nucleus) cumulative-probability mass.
 The cache holds ``max_seq`` positions per layer; ``prompt_len + n_tokens``
 must fit.
 
-Caveat: capacity-based MoE routes per decode step group, so expert-overflow
-behavior can differ from the training-time grouping; dense-FFN configs
-decode exactly (teacher-forcing logits match the training forward,
-see tests/test_generate.py).
+MoE configs decode with **dense dispatch** (see :func:`_decode_module`):
+every token goes to its true top-1 expert, no capacity drops — decode is
+group-independent and matches the dense-dispatch training forward exactly.
+Divergence from a *capacity-routed* training forward is bounded by the
+tokens training itself dropped: zero with ample ``capacity_factor``,
+quantified in tests/test_generate.py for tight capacity. Dense-FFN configs
+decode exactly (teacher-forcing logits match the training forward).
 """
 
 from __future__ import annotations
@@ -62,9 +65,25 @@ def _truncate_logits(
 
 def _decode_module(config: TransformerConfig) -> TransformerLM:
     """The decode-mode module all decoding paths share: sharded-attention
-    variants never apply to incremental decoding."""
+    variants never apply to incremental decoding.
+
+    MoE configs switch to **dense dispatch** for decoding: capacity-based
+    routing groups tokens and drops over-capacity ones, so its output for a
+    given token depends on which tokens happen to share its group — at
+    decode time the "group" is one position's batch slice, nothing like the
+    training grouping, and with a small decode batch the per-expert
+    capacity rounds down to ~1, dropping most tokens. Dense dispatch routes
+    every token to its true top-1 expert with no capacity limit: decode
+    output is group-independent and matches the dense-dispatch training
+    forward exactly (tests/test_generate.py); divergence from a
+    capacity-routed training forward is bounded by the tokens that training
+    itself dropped (zero when capacity_factor is ample). The extra cost —
+    every expert runs on the decode step's B tokens — is negligible at
+    decode batch sizes.
+    """
     cfg = dataclasses.replace(
-        config, use_ring_attention=False, use_ulysses_attention=False
+        config, use_ring_attention=False, use_ulysses_attention=False,
+        moe_dense_dispatch=config.n_experts > 0 or config.moe_dense_dispatch,
     )
     return TransformerLM(cfg, mesh=None, decode=True)
 

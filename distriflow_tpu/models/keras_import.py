@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import json
 import os
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -179,6 +180,7 @@ class _Builder:
         self.shape: Optional[Tuple[int, ...]] = None  # feature shape, no batch
         self.integer_input = False  # Embedding-first models take raw tokens
         self._consumed_input = False  # a non-InputLayer fn has seen the input
+        self.allow_shared = False  # graph mode: shared-layer re-lowering OK
 
     # -- helpers -----------------------------------------------------------
 
@@ -193,7 +195,21 @@ class _Builder:
 
     def _register(self, name: str, weights: Dict[str, Tuple[Tuple[int, ...], Callable]]):
         if name in self.inits:
-            raise ValueError(f"duplicate layer name {name!r}")
+            # Graph mode only (allow_shared): a shared layer — one layer
+            # object called at several nodes — re-lowers under its one name;
+            # ONE weight set, legal iff the shapes agree. In Sequential
+            # models there are no multi-node layers, so a name clash is
+            # always two distinct layers: keep the hard error (silently
+            # tying their weights would corrupt numerics).
+            old = {w: s for w, (s, _) in self.inits[name].items()}
+            new = {w: s for w, (s, _) in weights.items()}
+            if self.allow_shared and old == new:
+                return
+            raise ValueError(
+                f"duplicate layer name {name!r}"
+                + (f": shared-layer weight shapes disagree: {old} vs {new}"
+                   if self.allow_shared else "")
+            )
         self.inits[name] = weights
 
     # -- layer lowerings ---------------------------------------------------
@@ -667,9 +683,25 @@ class _Builder:
 
         self.fns.append(fn)
 
+    def _warn_rnn_default(self, name: str, cfg: Dict[str, Any],
+                          field: str, tfjs_default: str, tfkeras_default: str) -> None:
+        """Absent RNN config fields default to the tfjs/legacy-Keras
+        conventions (this importer's source format); tf.keras uses different
+        defaults, so a hand-written minimal config would silently diverge
+        numerically — say so once per layer."""
+        if field not in cfg:
+            warnings.warn(
+                f"{name}: config omits {field!r}; using the tfjs/legacy-Keras "
+                f"default {tfjs_default} (tf.keras would default to "
+                f"{tfkeras_default}) — set the field explicitly to silence",
+                stacklevel=3,
+            )
+
     def _add_LSTM(self, name: str, cfg: Dict[str, Any]) -> None:
         c, units, use_bias, ret_seq = self._rnn_common(name, cfg)
         act = _activation(cfg.get("activation", "tanh"))
+        self._warn_rnn_default(name, cfg, "recurrent_activation",
+                               "'hard_sigmoid'", "'sigmoid'")
         rec_act = _activation(cfg.get("recurrent_activation", "hard_sigmoid"))
         bias_init = _initializer(cfg.get("bias_initializer"))
         if cfg.get("unit_forget_bias", True):
@@ -716,7 +748,10 @@ class _Builder:
     def _add_GRU(self, name: str, cfg: Dict[str, Any]) -> None:
         c, units, use_bias, ret_seq = self._rnn_common(name, cfg)
         act = _activation(cfg.get("activation", "tanh"))
+        self._warn_rnn_default(name, cfg, "recurrent_activation",
+                               "'hard_sigmoid'", "'sigmoid'")
         rec_act = _activation(cfg.get("recurrent_activation", "hard_sigmoid"))
+        self._warn_rnn_default(name, cfg, "reset_after", "False", "True")
         reset_after = bool(cfg.get("reset_after", False))
         weights = {
             "kernel": ((c, 3 * units), _kernel_init(cfg)),
@@ -846,6 +881,21 @@ class _Builder:
 
     def _add_Reshape(self, name: str, cfg: Dict[str, Any]) -> None:
         target = tuple(int(d) for d in cfg["target_shape"])
+        if target.count(-1) > 1:
+            raise ValueError(
+                f"{name}: target_shape {target} has more than one -1"
+            )
+        if -1 in target:
+            # resolve the wildcard NOW from the known element count, so
+            # downstream layers register correct (never negative) fan-ins
+            known = int(np.prod(self._need_shape(name)))
+            rest = int(np.prod([d for d in target if d != -1]))
+            if rest <= 0 or known % rest:
+                raise ValueError(
+                    f"{name}: cannot infer -1 in target_shape {target} from "
+                    f"{known} elements"
+                )
+            target = tuple(known // rest if d == -1 else d for d in target)
         self.fns.append(lambda params, x, target=target: x.reshape((x.shape[0],) + target))
         self.shape = target
 
@@ -989,107 +1039,160 @@ def _merge_lowering(
 
 GraphStep = Tuple[str, List[str], Callable[[Params, List[jnp.ndarray]], jnp.ndarray]]
 
+# layer classes that consume raw integer ids: a model input feeding one of
+# these must NOT be float-cast by apply()
+_INTEGER_INPUT_LAYERS = ("Embedding",)
+
+
+def _node_key(name: str, node_idx: int) -> str:
+    """Env key of one layer invocation. Shared layers are called at several
+    graph nodes; each call is a distinct tensor, keyed ``name@node``."""
+    return f"{name}@{node_idx}"
+
+
+def _ref_key(ref: Any, where: str) -> str:
+    """(layer_name, node_index, tensor_index[, kwargs]) ref -> env key."""
+    if not isinstance(ref, (list, tuple)) or not ref or not isinstance(ref[0], str):
+        raise ValueError(f"unrecognized tensor reference in {where}: {ref!r}")
+    if len(ref) > 2 and int(ref[2]) != 0:
+        raise ValueError(
+            f"{where}: tensor_index {ref[2]} != 0 — multi-tensor layer "
+            "outputs (e.g. return_state) are not supported"
+        )
+    return _node_key(ref[0], int(ref[1]) if len(ref) > 1 else 0)
+
 
 def _build_graph(
     gconfig: Dict[str, Any],
     builder: _Builder,
-    input_shape: Optional[Tuple[int, ...]],
-) -> Tuple[List[GraphStep], str, Tuple[int, ...], Tuple[int, ...]]:
-    """Lower a single-input/single-output layer DAG.
+    input_shape: Optional[Sequence],
+) -> Tuple[List[GraphStep], List[str], List[str],
+           List[Tuple[int, ...]], List[Tuple[int, ...]], List[str]]:
+    """Lower a Functional layer DAG — multi-input, multi-output, and shared
+    layers included (parity with the reference's ``tf.loadLayersModel``
+    arbitrary-graph path, ``src/common/utils.ts:236-244``).
 
-    Returns (steps in topological order, output layer name, model input
-    feature shape, output feature shape). Layer params register in
-    ``builder.inits`` under each layer's graph name.
+    Every (layer, call-node) pair lowers to one step; a layer called at
+    several nodes registers its weights ONCE (see ``_Builder._register``)
+    while each node gets its own fn closure — weight sharing falls out of
+    the shared param key. Returns ``(steps in topological order, input env
+    keys, output env keys, input feature shapes, output feature shapes,
+    integer input keys)``; the last lists which model inputs feed
+    integer-consuming layers (Embedding) and must not be float-cast.
     """
     layers = gconfig["layers"]
-    if len(gconfig.get("input_layers", ())) != 1 or len(gconfig.get("output_layers", ())) != 1:
-        raise ValueError(
-            "only single-input/single-output Functional graphs are supported"
-        )
-    in_name = gconfig["input_layers"][0][0]
-    out_name = gconfig["output_layers"][0][0]
+    builder.allow_shared = True  # graphs may call one layer at many nodes
+    input_refs = list(gconfig.get("input_layers", ()))
+    output_refs = list(gconfig.get("output_layers", ()))
+    if not input_refs or not output_refs:
+        raise ValueError("Functional graph missing input_layers/output_layers")
+    input_keys = [_ref_key(r, "input_layers") for r in input_refs]
+    output_keys = [_ref_key(r, "output_layers") for r in output_refs]
+
+    # normalize the optional caller-supplied input shape(s) per input
+    if input_shape is not None and len(input_keys) > 1:
+        if len(input_shape) != len(input_keys) or not all(
+            isinstance(s, (tuple, list)) for s in input_shape
+        ):
+            raise ValueError(
+                f"model has {len(input_keys)} inputs; input_shape must be a "
+                f"sequence of {len(input_keys)} shapes, got {input_shape!r}"
+            )
+        given = {k: tuple(int(d) for d in s)
+                 for k, s in zip(input_keys, input_shape)}
+    elif input_shape is not None:
+        given = {input_keys[0]: tuple(int(d) for d in input_shape)}
+    else:
+        given = {}
+
     shapes: Dict[str, Tuple[int, ...]] = {}
     steps: List[GraphStep] = []
-    pending: Dict[str, Dict[str, Any]] = {l["name"]: l for l in layers}
+    integer_inputs: List[str] = []
+    pending: List[Tuple[Dict[str, Any], int, List[str]]] = []
+
+    for layer in layers:
+        name = layer["name"]
+        nodes = layer.get("inbound_nodes", [])
+        if layer["class_name"] == "InputLayer" or not nodes:
+            key = _node_key(name, 0)
+            if key not in input_keys:
+                raise ValueError(
+                    f"layer {name!r} has no inbound nodes but is not a "
+                    "declared input layer"
+                )
+            cfg = dict(layer.get("config", {}))
+            shape = cfg.get("batch_input_shape")
+            shape = _feature_shape(shape, name) if shape else given.get(key)
+            if shape is None:
+                raise ValueError(
+                    f"input layer {name!r} has no batch_input_shape; "
+                    "pass input_shape="
+                )
+            shapes[key] = tuple(shape)
+            continue
+        for j, node in enumerate(nodes):
+            parents = [_ref_key(p, f"layer {name!r} node {j}") for p in node]
+            pending.append((layer, j, parents))
 
     while pending:
         progressed = False
-        for name in list(pending):
-            layer = pending[name]
+        for item in list(pending):
+            layer, j, parents = item
+            if not all(p in shapes for p in parents):
+                continue  # parents not lowered yet
+            name = layer["name"]
             cls = layer["class_name"]
             cfg = dict(layer.get("config", {}))
             cfg.setdefault("name", name)  # graph name IS the param key
-            nodes = layer.get("inbound_nodes", [])
-            if cls == "InputLayer" or not nodes:
-                if name != in_name:
-                    raise ValueError(
-                        f"layer {name!r} has no inbound nodes but is not the "
-                        f"declared input layer {in_name!r}; multi-source "
-                        "graphs are not supported"
-                    )
-                shape = cfg.get("batch_input_shape")
-                shape = _feature_shape(shape, name) if shape else input_shape
-                if shape is None:
-                    raise ValueError(
-                        f"input layer {name!r} has no batch_input_shape; "
-                        "pass input_shape="
-                    )
-                shapes[name] = tuple(shape)
-                del pending[name]
-                progressed = True
-                continue
-            if len(nodes) > 1:
-                raise ValueError(
-                    f"layer {name!r} is called at {len(nodes)} graph nodes; "
-                    "shared layers are not supported"
-                )
-            parents = []
-            for p in nodes[0]:
-                if not isinstance(p, (list, tuple)) or not isinstance(p[0], str):
-                    raise ValueError(
-                        f"unrecognized inbound node format on {name!r}: {p!r}"
-                    )
-                parents.append(p[0])
-            if not all(p in shapes for p in parents):
-                continue  # parents not lowered yet
+            key = _node_key(name, j)
+            in_shapes = [shapes[p] for p in parents]
             if cls in _MERGE_LAYERS:
-                fn, out_shape = _merge_lowering(cls, cfg, [shapes[p] for p in parents])
-                steps.append((name, parents, fn))
+                fn, out_shape = _merge_lowering(cls, cfg, in_shapes)
+                steps.append((key, parents, fn))
             else:
-                builder.shape = shapes[parents[0]]
-                builder.add(cls, cfg)
+                builder.shape = in_shapes[0]
+                builder.add(cls, cfg)  # registers params once per layer name
                 single = builder.fns[-1]
                 steps.append(
-                    (name, parents, lambda params, xs, f=single: f(params, xs[0]))
+                    (key, parents, lambda params, xs, f=single: f(params, xs[0]))
                 )
                 out_shape = builder.shape
-            shapes[name] = tuple(out_shape)
-            del pending[name]
+                if cls in _INTEGER_INPUT_LAYERS:
+                    integer_inputs.extend(p for p in parents if p in input_keys)
+            shapes[key] = tuple(out_shape)
+            pending.remove(item)
             progressed = True
         if pending and not progressed:
+            unresolved = sorted(_node_key(l["name"], j) for l, j, _ in pending)
             raise ValueError(
-                f"graph has a cycle or dangling inputs; unresolved: {sorted(pending)}"
+                f"graph has a cycle or dangling inputs; unresolved: {unresolved}"
             )
-    if in_name not in shapes or out_name not in shapes:
-        raise ValueError(f"input/output layer {in_name!r}/{out_name!r} not in graph")
-    return steps, out_name, shapes[in_name], shapes[out_name]
+    missing = [k for k in input_keys + output_keys if k not in shapes]
+    if missing:
+        raise ValueError(f"input/output tensors not in graph: {missing}")
+    return (steps, input_keys, output_keys,
+            [shapes[k] for k in input_keys],
+            [shapes[k] for k in output_keys],
+            integer_inputs)
 
 
 def _strip_graph_softmax(
-    layers: List[Dict[str, Any]], steps: List[GraphStep], out_name: str
+    layers: List[Dict[str, Any]], steps: List[GraphStep], out_key: str
 ) -> bool:
     """Graph-mode analog of :func:`_strip_trailing_softmax`: rewrite the
-    output node's fn if it ends in softmax. Returns True if stripped."""
+    output node's fn if it ends in softmax. Returns True if stripped.
+    (Single-output graphs only — callers skip it for multi-output models.)"""
+    out_name = out_key.rsplit("@", 1)[0]
     layer = next(l for l in layers if l["name"] == out_name)
     cfg = layer.get("config", {})
-    idx = next(i for i, (n, _, _) in enumerate(steps) if n == out_name)
-    name, parents, _ = steps[idx]
+    idx = next(i for i, (n, _, _) in enumerate(steps) if n == out_key)
+    key, parents, _ = steps[idx]
     if layer["class_name"] == "Activation" and cfg.get("activation") == "softmax":
-        steps[idx] = (name, parents, lambda params, xs: xs[0])
+        steps[idx] = (key, parents, lambda params, xs: xs[0])
         return True
     if layer["class_name"] == "Dense" and cfg.get("activation") == "softmax":
-        f = _dense_fn(name, cfg.get("use_bias", True))
-        steps[idx] = (name, parents, lambda params, xs, f=f: f(params, xs[0]))
+        f = _dense_fn(out_name, cfg.get("use_bias", True))
+        steps[idx] = (key, parents, lambda params, xs, f=f: f(params, xs[0]))
         return True
     return False
 
@@ -1161,8 +1264,20 @@ def spec_from_keras_json(
     if load_weights and manifest:
         try:
             loaded = load_keras_weights(path, manifest)
-        except FileNotFoundError:
-            loaded = None  # topology-only json (shards not exported): cold init
+        except FileNotFoundError as e:
+            # A manifest that names shard files which are missing on disk is
+            # ambiguous: a topology-only export (fine to cold-init) or a
+            # deployment typo (NOT fine — an untrained model would silently
+            # masquerade as trained). Warn loudly with the missing path; the
+            # h5 path raises outright because .h5 always embeds its weights.
+            warnings.warn(
+                f"{path!r} has a weightsManifest but a shard file is missing "
+                f"({e.filename or e}); initializing UNTRAINED weights from "
+                "the recorded layer initializers. Pass load_weights=False if "
+                "cold init is intended.",
+                stacklevel=2,
+            )
+            loaded = None
     return _spec_from_topology(
         topology,
         name=os.path.splitext(os.path.basename(path))[0],
@@ -1295,25 +1410,50 @@ def _spec_from_topology(
         stripped = False
         if logits_output and fns:
             stripped = _strip_trailing_softmax(layers, fns, builder.names)
+        multi_in = False
+        float_mask: List[bool] = []
 
         def run(params: Params, y: jnp.ndarray) -> jnp.ndarray:
             for fn in fns:
                 y = fn(params, y)
             return y
 
-    else:  # Functional DAG
-        steps, out_name, in_shape, out_shape = _build_graph(
-            config, builder, input_shape
-        )
+    else:  # Functional DAG (multi-input/multi-output/shared layers OK)
+        (steps, in_keys, out_keys, in_shapes, out_shapes,
+         integer_keys) = _build_graph(config, builder, input_shape)
         stripped = False
         if logits_output and steps:
-            stripped = _strip_graph_softmax(config["layers"], steps, out_name)
+            # strip EVERY output head's trailing softmax (a multi-head
+            # classifier ends in one softmax per head; leaving any in place
+            # would silently double-softmax under the default CE loss)
+            stripped = any([
+                _strip_graph_softmax(config["layers"], steps, k)
+                for k in out_keys
+            ])
+        multi_in = len(in_keys) > 1
+        multi_out = len(out_keys) > 1
+        in_shape = tuple(in_shapes) if multi_in else in_shapes[0]
+        out_shape = tuple(out_shapes) if multi_out else out_shapes[0]
+        if integer_keys:
+            # inputs that feed Embedding lookups must stay integer
+            builder.integer_input = not multi_in or set(in_keys) <= set(integer_keys)
+        float_mask = [k not in integer_keys for k in in_keys]
 
-        def run(params: Params, y: jnp.ndarray) -> jnp.ndarray:
-            env: Dict[str, jnp.ndarray] = {config["input_layers"][0][0]: y}
+        def run(params: Params, y: Any) -> Any:
+            if multi_in:
+                if not isinstance(y, (tuple, list)) or len(y) != len(in_keys):
+                    raise ValueError(
+                        f"model takes {len(in_keys)} inputs ({in_keys}); "
+                        f"got {type(y).__name__}"
+                    )
+                env = dict(zip(in_keys, y))
+            else:
+                env = {in_keys[0]: y}
             for sname, parents, fn in steps:
                 env[sname] = fn(params, [env[p] for p in parents])
-            return env[out_name]
+            if multi_out:
+                return tuple(env[k] for k in out_keys)
+            return env[out_keys[0]]
 
     inits = builder.inits
     if loaded is not None:
@@ -1337,9 +1477,20 @@ def _spec_from_topology(
 
     integer_input = builder.integer_input
 
-    def apply(params: Params, x: jnp.ndarray) -> jnp.ndarray:
-        # Embedding-first models take raw token ids; floating them would
-        # corrupt the lookup
+    def apply(params: Params, x: Any) -> Any:
+        # Embedding-fed inputs take raw token ids; floating them would
+        # corrupt the lookup. Multi-input models cast per input.
+        if multi_in:
+            if not isinstance(x, (tuple, list)) or len(x) != len(float_mask):
+                raise ValueError(
+                    f"model takes {len(float_mask)} inputs; pass a "
+                    f"{len(float_mask)}-tuple of arrays, got {type(x).__name__}"
+                )
+            xs = tuple(
+                jnp.asarray(xi).astype(dtype) if fm else jnp.asarray(xi)
+                for xi, fm in zip(x, float_mask)
+            )
+            return run(params, xs)
         return run(params, x if integer_input else x.astype(dtype))
 
     return ModelSpec(

@@ -296,9 +296,15 @@ class MoEFFN(nn.Module):
         probs = jax.nn.softmax(gates, axis=-1)  # [B, S, E] f32
 
         if cfg.moe_dense_dispatch:
-            # exact all-experts path (straight-through top-1 gate)
+            # exact all-experts path: every token's true top-1 expert,
+            # combined with the chosen router prob — the SAME gate scaling
+            # as the capacity path below, so dense dispatch is exactly its
+            # no-drop limit (capacity output == dense output wherever no
+            # token overflowed; the decode path relies on this). Router
+            # gradients flow through the prob factor, as in the capacity
+            # path's combine tensor.
             top = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype)
-            dispatch = top + probs - jax.lax.stop_gradient(probs)
+            dispatch = top * probs  # [B, S, E]: p_argmax on the chosen expert
             h = jnp.einsum("bsd,edf->bsef", x, wi)
             h = nn.gelu(h)
             out = jnp.einsum("bsef,efd->bsed", h, wo)
@@ -435,7 +441,7 @@ def pipelined_transformer_lm(
     Shard params with ``PIPELINED_TRANSFORMER_RULES``
     (``distriflow_tpu/parallel/sharding.py``).
     """
-    from distriflow_tpu.parallel.pipeline import gpipe  # lazy: layer order
+    from distriflow_tpu.parallel.pipeline import gpipe, gpipe_remat  # lazy: layer order
 
     if config is None:
         config = TransformerConfig(**overrides)
@@ -443,19 +449,13 @@ def pipelined_transformer_lm(
         config = dataclasses.replace(config, **overrides)
     if mesh is None or "pipe" not in mesh.shape or mesh.shape["pipe"] < 2:
         raise ValueError("pipelined_transformer_lm needs a mesh with pipe >= 2")
-    if config.remat:
-        import warnings
-
-        # jax.checkpoint residuals (the auto-sharded stage params) become
-        # shard_map AD outputs needing specs over auto axes — unsupported
-        # through gpipe's hybrid manual/auto shard_map, even when the
-        # checkpoint is applied inside the body
-        warnings.warn(
-            "remat=True is ignored by pipelined_transformer_lm (checkpoint "
-            "residuals cannot cross the pipeline's hybrid shard_map); use "
-            "more pipeline stages or smaller microbatches for memory instead",
-            stacklevel=2,
-        )
+    # remat=True routes through gpipe_remat: an input-only-residual custom
+    # backward that recomputes each stage under jax.vjp inside the backward
+    # shard_map. (jax.checkpoint inside the stage body does NOT compose with
+    # the hybrid manual/auto shard_map — checkpoint residuals of auto-sharded
+    # stage params would need specs over auto axes — so rematerialization is
+    # built into the pipeline schedule itself instead.)
+    pipeline_fn = gpipe_remat if config.remat else gpipe
     n_stages = mesh.shape["pipe"]
     if config.n_layers % n_stages:
         raise ValueError(
@@ -487,7 +487,7 @@ def pipelined_transformer_lm(
 
     def apply(params: Any, tokens: jnp.ndarray) -> jnp.ndarray:
         h = embed_mod.apply(params["embed"], tokens)
-        h = gpipe(stage_mod.apply, params["stages"], h, mesh, m)
+        h = pipeline_fn(stage_mod.apply, params["stages"], h, mesh, m)
         return head_mod.apply(params["head"], h)
 
     return ModelSpec(

@@ -14,6 +14,23 @@ is the SPMD collective-permute pipeline:
   zeros — the standard SPMD trade for lockstep scheduling;
 - activations must keep one shape through stages (true for transformer
   blocks), which is what lets a single jitted program express the schedule.
+
+Two backward strategies:
+
+- :func:`gpipe` — plain autodiff through the schedule. JAX saves every
+  tick's stage *internals* (attention scores, FFN intermediates, ...) as
+  scan residuals: per-device activation memory is
+  O(ticks x microbatch x per-stage internals) — the deep/long-context
+  memory wall.
+- :func:`gpipe_remat` — a custom-VJP schedule that saves ONLY each tick's
+  stage *input* ([mb, ...] activations, one tensor per tick) and re-runs
+  the stage under ``jax.vjp`` during a mirrored reverse schedule. This is
+  per-stage rematerialization that *composes with the pipeline by
+  construction*: the recompute happens inside the backward shard_map, so no
+  ``jax.checkpoint`` residuals ever cross the hybrid manual/auto boundary
+  (the round-1 failure mode). Cost: one extra stage forward per
+  microbatch-stage (the standard remat trade); memory: internals shrink to
+  one live microbatch per device regardless of pipeline depth.
 """
 
 from __future__ import annotations
@@ -26,6 +43,34 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distriflow_tpu.parallel.collectives import pvary
+
+
+def _pipeline_setup(stacked_params, x, mesh, num_microbatches, axis, data_axis):
+    """Shared validation + schedule constants for both pipeline variants:
+    (p, m, mb, d, xs, batch_spec, manual_axes, perm_down)."""
+    p = mesh.shape[axis]
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_stages != p:
+        raise ValueError(
+            f"stacked_params has {n_stages} stages but the {axis!r} axis has "
+            f"{p} devices — shard_map would silently drop stages"
+        )
+    mb = b // m
+    d = mesh.shape.get(data_axis, 1) if data_axis else 1
+    if mb % max(d, 1):
+        raise ValueError(
+            f"microbatch size {mb} not divisible by the {data_axis!r} axis ({d})"
+        )
+    xs = x.reshape((m, mb) + x.shape[1:])
+    batch_spec = P(None, data_axis) if d > 1 else P()
+    manual = {axis} | ({data_axis} if d > 1 else set())
+    perm_down = [(i, (i + 1) % p) for i in range(p)]
+    return p, m, mb, d, xs, batch_spec, manual, perm_down
+
 
 
 def gpipe(
@@ -49,27 +94,9 @@ def gpipe(
     activations within each data slice), so the per-device activation is
     ``[mb / data, ...]``, not the full microbatch.
     """
-    p = mesh.shape[axis]
-    m = num_microbatches
     b = x.shape[0]
-    if b % m:
-        raise ValueError(f"batch {b} not divisible by microbatches {m}")
-    n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
-    if n_stages != p:
-        raise ValueError(
-            f"stacked_params has {n_stages} stages but the {axis!r} axis has "
-            f"{p} devices — shard_map would silently drop stages"
-        )
-    mb = b // m
-    d = mesh.shape.get(data_axis, 1) if data_axis else 1
-    if mb % max(d, 1):
-        raise ValueError(
-            f"microbatch size {mb} not divisible by the {data_axis!r} axis ({d})"
-        )
-    xs = x.reshape((m, mb) + x.shape[1:])
-    batch_spec = P(None, data_axis) if d > 1 else P()
-
-    perm = [(i, (i + 1) % p) for i in range(p)]
+    p, m, mb, d, xs, batch_spec, manual, perm = _pipeline_setup(
+        stacked_params, x, mesh, num_microbatches, axis, data_axis)
 
     def local(params, xs):
         params = jax.tree.map(lambda v: v[0], params)  # my stage's slice
@@ -114,7 +141,6 @@ def gpipe(
     # sharding on stage weights is preserved through the pipeline — XLA
     # partitions the in-stage einsums and inserts the TP collectives itself
     # instead of all-gathering the weights at the shard_map boundary.
-    manual = {axis} | ({data_axis} if d > 1 else set())
     fn = shard_map(
         local,
         mesh=mesh,
@@ -124,4 +150,146 @@ def gpipe(
         check_vma=False,  # outputs are made uniform by the final psum
     )
     out = fn(stacked_params, xs)
+    return out.reshape((b,) + x.shape[1:])
+
+
+def gpipe_remat(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pipe",
+    data_axis: str = "data",
+) -> jnp.ndarray:
+    """:func:`gpipe` with an input-only-residual custom backward.
+
+    Forward is the same M+P-1-tick schedule; the only residual kept per
+    tick is the stage *input* activation. Backward runs the mirrored
+    schedule in reverse: each tick re-linearizes the stage at its saved
+    input (``jax.vjp`` = recompute + transpose), consumes the output
+    cotangent arriving from downstream (or the loss cotangent at the last
+    stage's banked slots), accumulates parameter gradients locally, and
+    ``ppermute``s the input cotangent one hop UP the ring. Gradients are
+    exact — bubbles carry zero cotangents, so masked ticks contribute
+    nothing.
+
+    Memory per device: O(ticks x microbatch) activations saved vs
+    autodiff-:func:`gpipe`'s O(ticks x microbatch x stage internals) scan
+    residuals; stage internals exist only for the one microbatch being
+    recomputed. Cost: one extra stage forward per tick (standard remat).
+    Composes with the hybrid manual/auto shard_map exactly like the
+    forward — in-stage Megatron TP stays on the automatic ``model`` axis
+    in both directions.
+    """
+    b = x.shape[0]
+    p, m, mb, d, xs, batch_spec, manual, perm_down = _pipeline_setup(
+        stacked_params, x, mesh, num_microbatches, axis, data_axis)
+    saved_spec = P(None, axis, data_axis) if d > 1 else P(None, axis)
+    ticks = m + p - 1
+    perm_up = [(i, (i - 1) % p) for i in range(p)]
+
+    def fwd_local(params, xs):
+        params = jax.tree.map(lambda v: v[0], params)  # my stage's slice
+        idx = lax.axis_index(axis)
+        state0 = pvary(jnp.zeros_like(xs[0]), axis)
+        outputs0 = pvary(jnp.zeros_like(xs), axis)
+
+        def tick(carry, t):
+            state, outputs = carry
+            x_in = lax.dynamic_index_in_dim(xs, jnp.minimum(t, m - 1), 0,
+                                            keepdims=False)
+            state = jnp.where((idx == 0) & (t < m), x_in, state)
+            saved = state  # the ONLY residual: this tick's stage input
+            out = stage_fn(params, state)
+            out_slot = t - (p - 1)
+            bank = (idx == p - 1) & (out_slot >= 0)
+            outputs = lax.cond(
+                bank,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, out, jnp.maximum(out_slot, 0), 0),
+                lambda o: o,
+                outputs,
+            )
+            state = lax.ppermute(out, axis, perm_down)
+            return (state, outputs), saved
+
+        (_, outputs), saved = lax.scan(tick, (state0, outputs0),
+                                       jnp.arange(ticks))
+        outputs = lax.psum(
+            jnp.where(idx == p - 1, outputs, jnp.zeros_like(outputs)), axis
+        )
+        return outputs, saved[:, None]  # [ticks, 1(stage), mb_local, ...]
+
+    def bwd_local(params, saved, dys):
+        params = jax.tree.map(lambda v: v[0], params)
+        saved = saved[:, 0]  # [ticks, mb_local, ...]
+        idx = lax.axis_index(axis)
+        cot0 = pvary(jnp.zeros_like(dys[0]), axis)
+        grads0 = jax.tree.map(jnp.zeros_like, params)
+        dxs0 = pvary(jnp.zeros_like(dys), axis)
+
+        def rtick(carry, t):
+            cot_in, grads, dxs = carry
+            slot = t - (p - 1)
+            dy_t = lax.dynamic_index_in_dim(dys, jnp.maximum(slot, 0), 0,
+                                            keepdims=False)
+            # my tick-t output's cotangent: the banked slot's loss cotangent
+            # on the last stage, else whatever downstream sent up the ring
+            cot_out = jnp.where((idx == p - 1) & (slot >= 0), dy_t, cot_in)
+            state_t = lax.dynamic_index_in_dim(saved, t, 0, keepdims=False)
+            _, vjp_fn = jax.vjp(stage_fn, params, state_t)
+            dp, dstate = vjp_fn(cot_out)
+            grads = jax.tree.map(jnp.add, grads, dp)
+            inject = (idx == 0) & (t < m)
+            # bank dx for the microbatch stage 0 injected at tick t; the
+            # pre-injection state's cotangent is zero (it was overwritten),
+            # so nothing continues up the ring from an inject tick
+            dxs = lax.cond(
+                inject,
+                lambda a: lax.dynamic_update_index_in_dim(
+                    a, dstate, jnp.minimum(t, m - 1), 0),
+                lambda a: a,
+                dxs,
+            )
+            dstate_pass = jnp.where(inject, jnp.zeros_like(dstate), dstate)
+            cot_next = lax.ppermute(dstate_pass, axis, perm_up)
+            return (cot_next, grads, dxs), None
+
+        (_, grads, dxs), _ = lax.scan(
+            rtick, (cot0, grads0, dxs0), jnp.arange(ticks - 1, -1, -1))
+        if d > 1:
+            # microbatch rows are sharded over data: partial param grads
+            grads = jax.tree.map(lambda g: lax.psum(g, data_axis), grads)
+        dxs = lax.psum(jnp.where(idx == 0, dxs, jnp.zeros_like(dxs)), axis)
+        return jax.tree.map(lambda g: g[None], grads), dxs
+
+    fwd_sm = shard_map(
+        fwd_local, mesh=mesh,
+        in_specs=(P(axis), batch_spec),
+        out_specs=(batch_spec, saved_spec),
+        axis_names=manual, check_vma=False,
+    )
+    bwd_sm = shard_map(
+        bwd_local, mesh=mesh,
+        in_specs=(P(axis), saved_spec, batch_spec),
+        out_specs=(P(axis), batch_spec),
+        axis_names=manual, check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def run(params, xs):
+        y, _ = fwd_sm(params, xs)  # saved is dead here: XLA DCEs it
+        return y
+
+    def run_fwd(params, xs):
+        y, saved = fwd_sm(params, xs)
+        return y, (params, saved)
+
+    def run_bwd(res, dy):
+        params, saved = res
+        return bwd_sm(params, saved, dy)
+
+    run.defvjp(run_fwd, run_bwd)
+    out = run(stacked_params, xs)
     return out.reshape((b,) + x.shape[1:])

@@ -102,6 +102,9 @@ class AbstractServer:
         self.num_clients = 0
         self.num_updates = 0
         self.updates: List[Dict[str, SerializedArray]] = []  # reference :41
+        # per-buffered-update aggregation weight (staleness decay); always
+        # kept in lockstep with ``updates`` and consumed by mean_serialized
+        self._update_decays: List[float] = []
         self.updating = False  # re-entrancy flag, reference :42
         self._lock = threading.Lock()
         self.download_msg: Optional[DownloadMsg] = None

@@ -21,19 +21,6 @@ from distriflow_tpu.utils.messages import Events, UploadMsg
 from distriflow_tpu.utils.serialization import SerializedArray, mean_serialized
 
 
-def _scale_serialized(
-    vars_: Dict[str, SerializedArray], scale: float
-) -> Dict[str, SerializedArray]:
-    """Scale serialized gradients (staleness decay) without changing dtype."""
-    from distriflow_tpu.utils.serialization import deserialize_array, serialize_array
-
-    out = {}
-    for k, s in vars_.items():
-        arr = deserialize_array(s)
-        out[k] = serialize_array((arr * scale).astype(arr.dtype))
-    return out
-
-
 class FederatedServer(AbstractServer):
     def handle_connection(self, client_id: str) -> None:
         # send current weights (reference :69)
@@ -64,9 +51,11 @@ class FederatedServer(AbstractServer):
             if not self._well_formed(vars_):
                 self.log(f"dropping malformed upload from {msg.client_id}")
                 return False
-            if decay != 1.0:
-                vars_ = _scale_serialized(vars_, decay)
+            # decay folds into aggregation as a per-contribution weight
+            # (mean_serialized(weights=...)) — no deserialize/re-serialize
+            # round trip per decayed upload
             self.updates.append(vars_)
+            self._update_decays.append(decay)
             self.num_updates += 1
             should_aggregate = len(self.updates) >= self.hyperparams.min_updates_per_version
             if should_aggregate:
@@ -123,9 +112,12 @@ class FederatedServer(AbstractServer):
         with self.time("computing new weights"):
             with self._lock:
                 updates, self.updates = self.updates, []
+                decays, self._update_decays = self._update_decays, []
             # host-side mean over zero-copy buffer views (C++ kernel when
-            # built) — replaces the reference's byte-stack + device mean(0)
-            mean_grads = mean_serialized(updates, self.model.get_params())
+            # built) — replaces the reference's byte-stack + device mean(0);
+            # staleness decay rides in as per-contribution weights
+            mean_grads = mean_serialized(updates, self.model.get_params(),
+                                         weights=decays)
             self.model.update(mean_grads)
             self.model.save()
             self.download_msg = self.compute_download_msg()

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import optax
 
 from distriflow_tpu.data.dataset import DistributedDataset
-from distriflow_tpu.models.base import ModelSpec, _optimizer
+from distriflow_tpu.models.base import ModelSpec, _optimizer, init_params
 from distriflow_tpu.utils.config import ServerHyperparams, async_server_hyperparams
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 
@@ -90,7 +90,7 @@ class AsyncSGDTrainer:
 
     def init(self, rng: Optional[jax.Array] = None) -> Params:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        params = self.spec.init(rng)
+        params = init_params(self.spec, rng)
         self.params = jax.device_put(params, self.devices[0])
         self._opt_state = self.optimizer.init(self.params)
         return self.params

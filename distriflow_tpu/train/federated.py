@@ -30,7 +30,7 @@ import optax
 from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distriflow_tpu.models.base import ModelSpec, _optimizer
+from distriflow_tpu.models.base import ModelSpec, _optimizer, init_params
 from distriflow_tpu.parallel.collectives import pvary
 from distriflow_tpu.parallel.mesh import data_parallel_mesh
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
@@ -65,7 +65,7 @@ class FederatedAveragingTrainer:
 
     def init(self, rng: Optional[jax.Array] = None) -> Params:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        params = self.spec.init(rng)
+        params = init_params(self.spec, rng)
         self.params = jax.device_put(params, NamedSharding(self.mesh, P()))
         return self.params
 

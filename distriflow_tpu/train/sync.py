@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distriflow_tpu.models.base import ModelSpec, _optimizer
+from distriflow_tpu.models.base import ModelSpec, _optimizer, init_params
 from distriflow_tpu.parallel.mesh import batch_sharding, data_parallel_mesh
 from distriflow_tpu.parallel.sharding import (
     REPLICATED_RULES,
@@ -156,7 +156,7 @@ class SyncTrainer:
         """
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         with self.logger.time("model setup"):
-            params = self.spec.init(rng)
+            params = init_params(self.spec, rng)
             param_sh = tree_shardings(params, self.mesh, self.param_rules)
             params = jax.tree.map(jax.device_put, params, param_sh)
             opt_shape = jax.eval_shape(self.optimizer.init, params)

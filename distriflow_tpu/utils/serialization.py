@@ -24,7 +24,7 @@ import io
 import json
 import struct
 from dataclasses import dataclass
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -122,7 +122,11 @@ def deserialize_tree(
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def mean_serialized(updates: Sequence[Dict[str, SerializedArray]], like: Any) -> Any:
+def mean_serialized(
+    updates: Sequence[Dict[str, SerializedArray]],
+    like: Any,
+    weights: Optional[Sequence[float]] = None,
+) -> Any:
     """Mean of N clients' serialized gradient trees -> pytree shaped ``like``.
 
     The federated aggregation hot loop (reference stacks bytes then
@@ -131,6 +135,12 @@ def mean_serialized(updates: Sequence[Dict[str, SerializedArray]], like: Any) ->
     kernel when ``distriflow_tpu.native`` is built, numpy otherwise — with
     no N-times-larger staging concat on the float paths.
 
+    ``weights`` (optional, one float per update) scales each contribution
+    *inside* the accumulation: result = sum(w_i * g_i) / N. This is how
+    staleness decay folds into aggregation — equivalent to pre-scaling the
+    update by ``w_i`` and taking a plain mean, without the per-upload
+    deserialize/re-serialize round trip that pre-scaling costs.
+
     Updates may mix dtypes per leaf (clients choose ``gradient_compression``
     independently): each update is decoded with its own dtype. Float leaves
     at <=32-bit accumulate in float32; float64/integer leaves accumulate in
@@ -138,6 +148,14 @@ def mean_serialized(updates: Sequence[Dict[str, SerializedArray]], like: Any) ->
     """
     if not updates:
         raise ValueError("mean_serialized needs at least one update")
+    if weights is not None:
+        if len(weights) != len(updates):
+            raise ValueError(
+                f"weights length {len(weights)} != updates length {len(updates)}"
+            )
+        weights = [float(w) for w in weights]
+        if all(w == 1.0 for w in weights):
+            weights = None  # plain mean: keep the C++ fast path eligible
     _validate_matching_leaves(updates, check_dtype=False)
     from distriflow_tpu import native  # lazy: optional build at import
 
@@ -158,16 +176,24 @@ def mean_serialized(updates: Sequence[Dict[str, SerializedArray]], like: Any) ->
             for u in updates
         ]
         t_dtype = np.dtype(getattr(template, "dtype", views[0].dtype))
-        if all(v.dtype.kind == "f" and v.dtype.itemsize <= 4 for v in views):
+        all_f32 = all(v.dtype.kind == "f" and v.dtype.itemsize <= 4 for v in views)
+        if weights is None and all_f32:
             # fp32/16-bit floats: the C kernel casts each view to fp32
             # individually (leaf-sized copies, no stacked staging tensor)
             mean = native.mean_buffers(views)
+        elif all_f32:
+            # weighted fp32 accumulation (same precision as the C kernel)
+            acc = np.zeros(first.shape, np.float32)
+            for w, v in zip(weights, views):
+                acc += np.float32(w) * v.astype(np.float32)
+            mean = acc / np.float32(len(views))
         else:
             # float64 / integer leaves: float64 accumulation keeps the full
             # mantissa (int means are exact below 2^53)
             acc = np.zeros(first.shape, np.float64)
-            for v in views:
-                acc += v.astype(np.float64)
+            for i, v in enumerate(views):
+                w = 1.0 if weights is None else weights[i]
+                acc += w * v.astype(np.float64)
             mean = acc / len(views)
         if t_dtype.kind in "iu":
             mean = np.rint(mean)

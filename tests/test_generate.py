@@ -342,3 +342,90 @@ def test_sequence_logprob_rejects_out_of_vocab():
     bad = jnp.asarray([[1, 2, CFG.vocab_size, 3]], jnp.int32)
     with pytest.raises(ValueError, match="vocab_size"):
         sequence_logprob(CFG, params, bad, from_pos=1)
+
+
+# -- MoE train/decode routing consistency (VERDICT r1 item #7) -------------
+
+
+def _moe_cfg(capacity_factor):
+    return dataclasses.replace(
+        CFG, n_experts=4, capacity_factor=capacity_factor, moe_group_size=64,
+        router_aux_weight=0.0,
+    )
+
+
+def test_moe_decode_matches_training_forward_ample_capacity():
+    """With ample capacity nothing is dropped at train time, so capacity
+    routing == dense routing == decode: teacher-forced cached decode must
+    reproduce the training logits exactly (the dense-FFN guarantee extends
+    to MoE)."""
+    cfg = _moe_cfg(capacity_factor=8.0)
+    params = _params(cfg)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 12)), jnp.int32)
+
+    full_logits = TransformerLM(cfg, mesh=None).apply(params, x)
+
+    from distriflow_tpu.models.generate import _decode_module
+    decode_mod = _decode_module(cfg)
+    logits0, vars_ = decode_mod.apply(params, x[:, :5], mutable=["cache"])
+    got = [np.asarray(logits0)]
+    cache = vars_["cache"]
+    for t in range(5, 12):
+        lt, vars_ = decode_mod.apply(
+            {**params, "cache": cache}, x[:, t : t + 1], mutable=["cache"]
+        )
+        cache = vars_["cache"]
+        got.append(np.asarray(lt))
+    got = np.concatenate(got, axis=1)
+    np.testing.assert_allclose(got, np.asarray(full_logits), rtol=2e-4, atol=2e-4)
+
+
+def test_moe_decode_divergence_quantified_tight_capacity():
+    """With tight capacity the *training* forward drops tokens; decode
+    (dense dispatch) never does. The divergence bound: decode logits match
+    the dense-dispatch training forward EXACTLY, so decode-vs-capacity
+    drift is at most capacity-vs-dense drift — i.e. exactly the tokens
+    training dropped, measured here to be a strict subset of positions."""
+    cfg = _moe_cfg(capacity_factor=0.3)  # force overflow drops in training
+    params = _params(cfg)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 12)), jnp.int32)
+
+    capacity_logits = np.asarray(TransformerLM(cfg, mesh=None).apply(params, x))
+    dense_cfg = dataclasses.replace(cfg, moe_dense_dispatch=True)
+    dense_logits = np.asarray(TransformerLM(dense_cfg, mesh=None).apply(params, x))
+
+    # tight capacity really dropped something: the two training forwards
+    # must differ somewhere...
+    diff = np.max(np.abs(capacity_logits - dense_logits), axis=-1)  # [B, S]
+    assert np.any(diff > 1e-4), "capacity_factor=0.3 dropped nothing?"
+    # ...but not everywhere (drops are per-token, not global)
+    assert np.any(diff < 1e-5), "every position diverged; bound is vacuous"
+
+    # the invariant of the fix: cached decode == dense training forward,
+    # bit-for-bit the same routing, at every position
+    from distriflow_tpu.models.generate import _decode_module
+    decode_mod = _decode_module(cfg)
+    logits0, vars_ = decode_mod.apply(params, x[:, :5], mutable=["cache"])
+    got = [np.asarray(logits0)]
+    cache = vars_["cache"]
+    for t in range(5, 12):
+        lt, vars_ = decode_mod.apply(
+            {**params, "cache": cache}, x[:, t : t + 1], mutable=["cache"]
+        )
+        cache = vars_["cache"]
+        got.append(np.asarray(lt))
+    got = np.concatenate(got, axis=1)
+    np.testing.assert_allclose(got, dense_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_generate_runs_greedy():
+    """End-to-end generate() on an MoE config (dense-dispatch decode path)."""
+    cfg = _moe_cfg(capacity_factor=1.0)
+    params = _params(cfg)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = generate(cfg, params, prompt, n_tokens=5)
+    assert out.shape == (1, 9)
+    out2 = generate(cfg, params, prompt, n_tokens=5)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))

@@ -404,10 +404,181 @@ def test_functional_graph_weight_loading_and_softmax_strip(tmp_path):
     )
 
 
-def test_functional_shared_layer_rejected(tmp_path):
+def test_functional_shared_layer_second_node(tmp_path):
+    """A layer called at two graph nodes imports: one weight set, one step
+    per node (round-1 rejected this; reference ``tf.loadLayersModel``
+    handles arbitrary graphs, ``src/common/utils.ts:236-244``)."""
     path = _write_model(tmp_path, _graph_topology("Add", shared_output=True))
-    with pytest.raises(ValueError, match="shared layers"):
-        spec_from_keras_json(path)
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    assert set(params) == {"conv_1", "dense_out"}  # dense registered ONCE
+    out = spec.apply(params, jnp.ones((2, 4, 4, 2)))
+    assert out.shape == (2, 3)
+
+
+def _two_input_topology():
+    """Two inputs -> Dense(3) each -> Concatenate -> Dense(2)."""
+    def dense(name, units, parent):
+        return {
+            "name": name,
+            "class_name": "Dense",
+            "config": {
+                "name": name, "units": units, "activation": "linear",
+                "use_bias": True,
+                "kernel_initializer": {"class_name": "GlorotUniform", "config": {}},
+                "bias_initializer": {"class_name": "Zeros", "config": {}},
+            },
+            "inbound_nodes": [[[parent, 0, 0, {}]]],
+        }
+
+    layers = [
+        {"name": "in_a", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 4], "name": "in_a"},
+         "inbound_nodes": []},
+        {"name": "in_b", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 5], "name": "in_b"},
+         "inbound_nodes": []},
+        dense("da", 3, "in_a"),
+        dense("db", 3, "in_b"),
+        {"name": "cat", "class_name": "Concatenate",
+         "config": {"name": "cat", "axis": -1},
+         "inbound_nodes": [[["da", 0, 0, {}], ["db", 0, 0, {}]]]},
+        dense("head", 2, "cat"),
+    ]
+    return {
+        "modelTopology": {"model_config": {"class_name": "Model", "config": {
+            "name": "two_in", "layers": layers,
+            "input_layers": [["in_a", 0, 0], ["in_b", 0, 0]],
+            "output_layers": [["head", 0, 0]],
+        }}}
+    }
+
+
+def test_functional_two_input_model(tmp_path):
+    """VERDICT r1 item #4 'done' criterion: import a two-input Keras model,
+    numpy-verified."""
+    path = _write_model(tmp_path, _two_input_topology())
+    spec = spec_from_keras_json(path)
+    assert spec.input_shape == ((4,), (5,))
+    assert spec.output_shape == (2,)
+    params = spec.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    a = rng.randn(6, 4).astype(np.float32)
+    b = rng.randn(6, 5).astype(np.float32)
+    got = np.asarray(spec.apply(params, (jnp.asarray(a), jnp.asarray(b))))
+
+    def np_dense(p, x):
+        return x @ np.asarray(p["kernel"]) + np.asarray(p["bias"])
+
+    cat = np.concatenate([np_dense(params["da"], a), np_dense(params["db"], b)], -1)
+    want = np_dense(params["head"], cat)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # wrong arity is a loud error
+    with pytest.raises(ValueError, match="2 inputs"):
+        spec.apply(params, jnp.asarray(a))
+
+
+def _shared_embedding_topology():
+    """One Embedding applied to two int inputs -> Add -> Flatten -> Dense."""
+    layers = [
+        {"name": "in_a", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 3], "name": "in_a"},
+         "inbound_nodes": []},
+        {"name": "in_b", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 3], "name": "in_b"},
+         "inbound_nodes": []},
+        {"name": "emb", "class_name": "Embedding",
+         "config": {"name": "emb", "input_dim": 11, "output_dim": 4,
+                    "embeddings_initializer":
+                        {"class_name": "RandomNormal",
+                         "config": {"mean": 0.0, "stddev": 1.0}}},
+         "inbound_nodes": [[["in_a", 0, 0, {}]], [["in_b", 0, 0, {}]]]},
+        {"name": "add", "class_name": "Add", "config": {"name": "add"},
+         "inbound_nodes": [[["emb", 0, 0, {}], ["emb", 1, 0, {}]]]},
+        {"name": "flat", "class_name": "Flatten", "config": {"name": "flat"},
+         "inbound_nodes": [[["add", 0, 0, {}]]]},
+        {"name": "head", "class_name": "Dense",
+         "config": {"name": "head", "units": 2, "activation": "linear",
+                    "use_bias": False,
+                    "kernel_initializer": {"class_name": "GlorotUniform",
+                                           "config": {}}},
+         "inbound_nodes": [[["flat", 0, 0, {}]]]},
+    ]
+    return {
+        "modelTopology": {"model_config": {"class_name": "Model", "config": {
+            "name": "shared_emb", "layers": layers,
+            "input_layers": [["in_a", 0, 0], ["in_b", 0, 0]],
+            "output_layers": [["head", 0, 0]],
+        }}}
+    }
+
+
+def test_functional_shared_embedding_model(tmp_path):
+    """VERDICT r1 item #4 'done' criterion: a shared-embedding model —
+    the SAME table serves both inputs (one param entry), numpy-verified;
+    integer inputs are not float-cast."""
+    path = _write_model(tmp_path, _shared_embedding_topology())
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    assert set(params) == {"emb", "head"}  # ONE embedding table
+    table = np.asarray(params["emb"]["embeddings"])
+    rng = np.random.RandomState(1)
+    a = rng.randint(0, 11, (5, 3)).astype(np.int32)
+    b = rng.randint(0, 11, (5, 3)).astype(np.int32)
+    got = np.asarray(spec.apply(params, (jnp.asarray(a), jnp.asarray(b))))
+    want = (table[a] + table[b]).reshape(5, -1) @ np.asarray(params["head"]["kernel"])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_functional_multi_output_model(tmp_path):
+    """Two heads off one trunk: apply returns a tuple, loss_fn sums the
+    per-output losses (Keras's default reduction)."""
+    layers = [
+        {"name": "in_a", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 4], "name": "in_a"},
+         "inbound_nodes": []},
+        {"name": "trunk", "class_name": "Dense",
+         "config": {"name": "trunk", "units": 6, "activation": "relu",
+                    "use_bias": True,
+                    "kernel_initializer": {"class_name": "GlorotUniform",
+                                           "config": {}},
+                    "bias_initializer": {"class_name": "Zeros", "config": {}}},
+         "inbound_nodes": [[["in_a", 0, 0, {}]]]},
+        {"name": "head1", "class_name": "Dense",
+         "config": {"name": "head1", "units": 3, "activation": "linear",
+                    "use_bias": False,
+                    "kernel_initializer": {"class_name": "GlorotUniform",
+                                           "config": {}}},
+         "inbound_nodes": [[["trunk", 0, 0, {}]]]},
+        {"name": "head2", "class_name": "Dense",
+         "config": {"name": "head2", "units": 2, "activation": "linear",
+                    "use_bias": False,
+                    "kernel_initializer": {"class_name": "GlorotUniform",
+                                           "config": {}}},
+         "inbound_nodes": [[["trunk", 0, 0, {}]]]},
+    ]
+    topo = {
+        "modelTopology": {"model_config": {"class_name": "Model", "config": {
+            "name": "two_out", "layers": layers,
+            "input_layers": [["in_a", 0, 0]],
+            "output_layers": [["head1", 0, 0], ["head2", 0, 0]],
+        }}}
+    }
+    path = _write_model(tmp_path, topo)
+    spec = spec_from_keras_json(path, loss="mean_squared_error")
+    assert spec.output_shape == ((3,), (2,))
+    params = spec.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(2)
+    x = rng.randn(6, 4).astype(np.float32)
+    o1, o2 = spec.apply(params, jnp.asarray(x))
+    assert o1.shape == (6, 3) and o2.shape == (6, 2)
+    y1 = rng.randn(6, 3).astype(np.float32)
+    y2 = rng.randn(6, 2).astype(np.float32)
+    total = float(spec.loss_fn(params, jnp.asarray(x), (jnp.asarray(y1), jnp.asarray(y2))))
+    want = float(np.mean((np.asarray(o1) - y1) ** 2) + np.mean((np.asarray(o2) - y2) ** 2))
+    np.testing.assert_allclose(total, want, rtol=1e-5)
+    with pytest.raises(ValueError, match="2 outputs"):
+        spec.loss_fn(params, jnp.asarray(x), jnp.asarray(y1))
 
 
 def test_depthwise_multiplier_channel_order(tmp_path):
@@ -798,3 +969,55 @@ def test_export_roundtrip_preserves_predictions(tmp_path):
         np.asarray(re_spec.apply(re_params, jnp.asarray(x))),
         rtol=1e-5,
     )
+
+
+def test_multi_output_softmax_heads_stripped(tmp_path):
+    """Every output head's trailing softmax strips under logits_output
+    (leaving any would silently double-softmax the default CE loss)."""
+    def head(name, parent):
+        return {"name": name, "class_name": "Dense",
+                "config": {"name": name, "units": 3, "activation": "softmax",
+                           "use_bias": False,
+                           "kernel_initializer": {"class_name": "Ones",
+                                                  "config": {}}},
+                "inbound_nodes": [[[parent, 0, 0, {}]]]}
+
+    layers = [
+        {"name": "in_a", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 2], "name": "in_a"},
+         "inbound_nodes": []},
+        head("h1", "in_a"),
+        head("h2", "in_a"),
+    ]
+    topo = {"modelTopology": {"model_config": {"class_name": "Model", "config": {
+        "name": "two_softmax_heads", "layers": layers,
+        "input_layers": [["in_a", 0, 0]],
+        "output_layers": [["h1", 0, 0], ["h2", 0, 0]],
+    }}}}
+    path = _write_model(tmp_path, topo)
+    spec = spec_from_keras_json(path)  # logits_output default
+    params = spec.init(jax.random.PRNGKey(0))
+    x = jnp.asarray([[1.0, 2.0]])
+    o1, o2 = spec.apply(params, x)
+    # ones-kernel logits are 3.0 each; softmaxed heads would be 1/3 each
+    np.testing.assert_allclose(np.asarray(o1), 3.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o2), 3.0, rtol=1e-6)
+    assert ":logits" in spec.name
+
+
+def test_sequential_duplicate_layer_name_still_rejected(tmp_path):
+    """The shared-layer leniency is graph-only: two distinct Sequential
+    layers sharing a name (+shapes) must still be a hard error, not silent
+    weight tying."""
+    layers = [
+        {"class_name": "Dense",
+         "config": {"name": "dense", "units": 4, "activation": "linear",
+                    "batch_input_shape": [None, 4], "use_bias": False}},
+        {"class_name": "Dense",
+         "config": {"name": "dense", "units": 4, "activation": "linear",
+                    "use_bias": False}},
+    ]
+    path = _write_model(tmp_path, {"modelTopology": {"model_config": {
+        "class_name": "Sequential", "config": {"layers": layers}}}})
+    with pytest.raises(ValueError, match="duplicate layer name"):
+        spec_from_keras_json(path)

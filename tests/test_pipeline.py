@@ -70,3 +70,90 @@ def test_grads_flow_through_pipeline(devices):
     g_pipe = jax.jit(jax.grad(loss_pipe))(ws)
     g_seq = jax.grad(loss_seq)(ws)
     np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_seq), rtol=1e-4, atol=1e-5)
+
+
+# -- gpipe_remat: input-only-residual custom backward ----------------------
+
+
+def _mlp_stage(p, a):
+    return jnp.tanh(a @ p["w"]) + p["b"]
+
+
+def _stack_params(rng, stages, d):
+    return {
+        "w": jnp.asarray(rng.randn(stages, d, d).astype(np.float32) * 0.4),
+        "b": jnp.asarray(rng.randn(stages, d).astype(np.float32) * 0.1),
+    }
+
+
+def test_gpipe_remat_forward_matches_gpipe(devices):
+    from distriflow_tpu.parallel.pipeline import gpipe_remat
+
+    mesh = create_mesh(MeshConfig(pipe=4, data=2), devices)
+    rng = np.random.RandomState(0)
+    params = _stack_params(rng, 4, 8)
+    x = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    base = jax.jit(lambda pp, xx: gpipe(_mlp_stage, pp, xx, mesh, 8))(params, x)
+    remat = jax.jit(lambda pp, xx: gpipe_remat(_mlp_stage, pp, xx, mesh, 8))(params, x)
+    np.testing.assert_allclose(np.asarray(remat), np.asarray(base),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("data_axis_size", [1, 2])
+def test_gpipe_remat_grads_match_autodiff_gpipe(devices, data_axis_size):
+    """VERDICT r1 item #3 'done' criterion: equivalence vs GPipe grads —
+    param grads AND input cotangents, with and without a data axis."""
+    from distriflow_tpu.parallel.pipeline import gpipe_remat
+
+    mesh = create_mesh(
+        MeshConfig(pipe=4, data=data_axis_size),
+        devices[: 4 * data_axis_size])
+    rng = np.random.RandomState(1)
+    params = _stack_params(rng, 4, 8)
+    x = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    y = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+
+    def loss(pipeline_fn, pp, xx):
+        out = pipeline_fn(_mlp_stage, pp, xx, mesh, 4)
+        return jnp.mean((out - y) ** 2)
+
+    g_base = jax.jit(jax.grad(lambda pp, xx: loss(gpipe, pp, xx),
+                              argnums=(0, 1)))(params, x)
+    g_remat = jax.jit(jax.grad(lambda pp, xx: loss(gpipe_remat, pp, xx),
+                               argnums=(0, 1)))(params, x)
+    for a, b in zip(jax.tree.leaves(g_base), jax.tree.leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_gpipe_remat_activation_memory_drop(devices):
+    """VERDICT r1 item #3 'done' criterion: a measured activation-memory
+    drop. Compile both train steps and compare XLA's temp-buffer
+    allocation — the scan-residual memory lives there. The stage is made
+    wide (big FFN intermediate) so autodiff's per-tick internals dominate
+    its residuals while gpipe_remat saves only the [mb, d] stage inputs."""
+    from distriflow_tpu.parallel.pipeline import gpipe_remat
+
+    mesh = create_mesh(MeshConfig(pipe=4, data=2), devices)
+    rng = np.random.RandomState(2)
+    d, ff, stages = 16, 256, 4
+    params = {
+        "w_in": jnp.asarray(rng.randn(stages, d, ff).astype(np.float32) * 0.1),
+        "w_out": jnp.asarray(rng.randn(stages, ff, d).astype(np.float32) * 0.1),
+    }
+    x = jnp.asarray(rng.randn(32, d).astype(np.float32))
+
+    def wide_stage(p, a):
+        return a + jnp.tanh(jnp.tanh(a @ p["w_in"]) @ p["w_out"])
+
+    def temp_bytes(pipeline_fn):
+        def loss(pp, xx):
+            return jnp.mean(pipeline_fn(wide_stage, pp, xx, mesh, 16) ** 2)
+
+        compiled = jax.jit(jax.grad(loss)).lower(params, x).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    base, remat = temp_bytes(gpipe), temp_bytes(gpipe_remat)
+    # the drop must be structural (internals no longer scale with ticks),
+    # not noise: require at least 2x on this wide-FFN configuration
+    assert remat * 2 <= base, f"no memory drop: gpipe={base} remat={remat}"

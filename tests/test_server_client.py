@@ -291,3 +291,53 @@ def test_server_checkpoint_retention(tmp_path):
         server.model.store.save(
             server.model.model.get_params(), version=str(i))
     assert server.model.store.list() == ["3", "4", "5"]
+
+
+def test_stale_upload_decays_into_aggregation(tmp_path):
+    """A within-bound stale gradient contributes scaled by
+    staleness_decay**staleness — folded into mean_serialized as a
+    per-contribution weight (no per-upload re-serialization)."""
+    from distriflow_tpu.utils.messages import GradientMsg, UploadMsg
+    from distriflow_tpu.utils.serialization import serialize_tree
+
+    server = FederatedServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(
+            server_hyperparams={
+                "min_updates_per_version": 2,
+                "maximum_staleness": 1,
+                "staleness_decay": 0.5,
+            },
+            save_dir=str(tmp_path / "models"),
+        ),
+    )
+    server.setup()
+    try:
+        lr = server.model.model.lr
+        v0 = server.model.version
+        g1 = {"w": np.full((4,), 2.0, np.float32), "b": np.full((2,), 4.0, np.float32)}
+        g2 = {"w": np.full((4,), 6.0, np.float32), "b": np.full((2,), 8.0, np.float32)}
+
+        def upload(grads, version):
+            return server.handle_upload(
+                "c", UploadMsg(client_id="c",
+                               gradients=GradientMsg(version=version,
+                                                     vars=serialize_tree(grads))))
+
+        # round 1: two fresh uploads -> aggregate -> version advances
+        assert upload(g1, v0) and upload(g2, v0)
+        v1 = server.model.version
+        assert v1 != v0
+        before = {k: v.copy() for k, v in server.model.get_params().items()}
+        # round 2: one stale-by-1 upload (weight 0.5) + one fresh
+        assert upload(g1, v0)  # staleness 1 <= maximum_staleness
+        assert upload(g2, v1)
+        after = server.model.get_params()
+        for k in g1:
+            want = lr * (0.5 * g1[k] + g2[k]) / 2
+            np.testing.assert_allclose(
+                np.asarray(before[k]) - np.asarray(after[k]), want, rtol=1e-5)
+        # over-bound staleness is rejected outright
+        assert not upload(g1, v0)  # staleness now 2 > 1
+    finally:
+        server.stop()

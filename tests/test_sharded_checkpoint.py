@@ -163,3 +163,83 @@ def test_trainer_integration_sharded(mesh, tmp_path):
                     jax.tree.leaves(params_before)):
         np.testing.assert_array_equal(a, b)
     t2.close()
+
+
+@pytest.mark.parametrize("n_target,mesh_shape", [(4, (2, 2)), (2, (2, 1))])
+def test_restore_onto_smaller_mesh(mesh, devices, tmp_path, n_target, mesh_shape):
+    """VERDICT r1 item #5: a checkpoint saved on the 8-device mesh restores
+    onto 4- and 2-device meshes (scale-down boundary) through the reshard
+    path, bit-exact, with the target shardings honored."""
+    store = ShardedCheckpointStore(str(tmp_path))
+    state = _state(mesh, seed=11)
+    store.save(state, version="9")
+    small = Mesh(np.array(devices[:n_target]).reshape(mesh_shape),
+                 ("data", "model"))
+
+    def relike(k, v):
+        if not isinstance(v, jax.Array) or np.asarray(v).ndim == 0:
+            return v
+        spec = {"w": P("data", "model"), "b": P("model"), "scale": P()}[k]
+        return jax.device_put(np.zeros_like(np.asarray(v)),
+                              NamedSharding(small, spec))
+
+    like = {k: relike(k, v) for k, v in state.items()}
+    out = store.load("9", like)
+    _assert_trees_equal(out, state)
+    assert set(out["w"].sharding.device_set) == set(devices[:n_target])
+    assert out["w"].sharding.spec == P("data", "model")
+
+
+@pytest.mark.parametrize("n_target", [4, 2])
+def test_trainer_zero1_restore_across_mesh_sizes(devices, tmp_path, n_target):
+    """VERDICT r1 item #5: ZeRO-1-sharded adam state saved on an 8-way data
+    mesh round-trips onto 4- and 2-way meshes through the trainer restore
+    path; moments stay data-sharded on the smaller mesh and training
+    continues."""
+    from distriflow_tpu.models import mnist_mlp
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    def make(n):
+        mesh_n = Mesh(np.array(devices[:n]), ("data",))
+        t = SyncTrainer(
+            mnist_mlp(hidden=8),
+            mesh=mesh_n,
+            learning_rate=1e-3,
+            optimizer="adam",
+            zero_optimizer_sharding=True,
+            checkpoint_dir=str(tmp_path),
+            sharded_checkpoints=True,
+        )
+        t.init(jax.random.PRNGKey(0))
+        return t
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+
+    t1 = make(8)
+    t1.step((x, y))
+    t1.step((x, y))
+    version = t1.save(wait=True)
+    params_before = jax.device_get(t1.state.params)
+    opt_before = jax.device_get(t1.state.opt_state)
+    t1.close()
+
+    t2 = make(n_target)
+    assert t2.restore(version)
+    assert int(t2.version) == 2
+    _assert_trees_equal(jax.device_get(t2.state.params), params_before)
+    _assert_trees_equal(jax.device_get(t2.state.opt_state), opt_before)
+    # the restored moments still live ZeRO-sharded on the SMALLER mesh
+    axes = set()
+    for leaf in jax.tree.leaves(t2.state.opt_state):
+        if hasattr(leaf, "sharding"):
+            assert set(leaf.sharding.device_set) <= set(devices[:n_target])
+            for part in leaf.sharding.spec or ():
+                if isinstance(part, (tuple, list)):
+                    axes.update(part)
+                elif part is not None:
+                    axes.add(part)
+    assert "data" in axes
+    assert np.isfinite(t2.step((x, y)))
+    t2.close()
