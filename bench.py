@@ -369,8 +369,8 @@ def bench_mobilenet(n_chips):
         y = np.eye(classes, dtype=np.float32)[rng.randint(0, classes, (k, B))]
         return x, y
 
-    r = _timed_chunked(trainer, make_chunk, steps=5 if FAST else 8,
-                       rounds=2 if FAST else 2, batch=B)
+    # only runs in the non-FAST bench, so no FAST branch here
+    r = _timed_chunked(trainer, make_chunk, steps=8, rounds=2, batch=B)
     x1 = rng.randn(B, size, size, 3).astype(np.float32)
     y1 = np.eye(classes, dtype=np.float32)[rng.randint(0, classes, B)]
     mfu = _mfu_or_none(trainer, (x1, y1), r["step_ms"] / 1e3)

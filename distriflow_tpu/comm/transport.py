@@ -308,15 +308,28 @@ class ClientTransport:
         self._endpoint: Optional[_Endpoint] = None
         self._handlers: Dict[str, Callable[[Any], None]] = {}
         self._connected = threading.Event()
+        self._connect_error: Optional[BaseException] = None
         self._stopped = False
 
     def on(self, event: str, handler: Callable[[Any], None]) -> None:
         self._handlers[event] = handler
 
     def connect(self, timeout: float = CONNECT_TIMEOUT_S) -> "ClientTransport":
+        # reset per attempt: a failed connect must not poison a retry on
+        # the same object (the failed attempt's loop thread has exited)
+        self._connect_error = None
+        self._connected.clear()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
-        if not self._connected.wait(timeout):
+        ok = self._connected.wait(timeout)
+        if self._connect_error is not None:
+            # fail fast with the real error (e.g. ConnectionRefusedError)
+            # instead of burning the whole timeout; the loop thread has
+            # already exited cleanly
+            err = self._connect_error
+            self._thread.join(timeout=1)
+            raise err
+        if not ok:
             raise TimeoutError(f"could not connect to {self.host}:{self.port}")
         return self
 
@@ -388,6 +401,15 @@ class ClientTransport:
 
         try:
             self._loop.run_until_complete(main())
+        except Exception as e:
+            if not self._connected.is_set():
+                # connection never came up (refused/unreachable): hand the
+                # error to the waiting connect() instead of dying unhandled
+                # on this thread
+                self._connect_error = e
+                self._connected.set()
+            elif not self._stopped:
+                raise  # established-connection failure: keep it loud
         finally:
             self._loop.close()
 

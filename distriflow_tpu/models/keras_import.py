@@ -1425,10 +1425,14 @@ def _spec_from_topology(
         if logits_output and steps:
             # strip EVERY output head's trailing softmax (a multi-head
             # classifier ends in one softmax per head; leaving any in place
-            # would silently double-softmax under the default CE loss)
+            # would silently double-softmax under the default CE loss) —
+            # EXCEPT heads some other node also consumes: rewriting those
+            # in place would feed raw logits to the downstream layer
+            consumed = {p for _, parents, _ in steps for p in parents}
             stripped = any([
                 _strip_graph_softmax(config["layers"], steps, k)
                 for k in out_keys
+                if k not in consumed
             ])
         multi_in = len(in_keys) > 1
         multi_out = len(out_keys) > 1

@@ -1,0 +1,96 @@
+"""Worker for the kill-one-process failover test (mesh-mode recovery).
+
+Documents-by-test the SPMD failure semantics in docs/MULTIHOST.md §7: when
+a host dies mid-run, the surviving host cannot make progress (collectives
+and collective commits need every participant) and a torn save publishes
+nothing; recovery is a fresh job that resumes from the last *committed*
+version.
+
+argv: coordinator_port process_id num_processes save_dir mode
+mode: "die"    — both processes collectively commit v1; process 1 then
+                 exits hard (simulated host death); process 0 attempts the
+                 v2 save, which must either block at the collective commit
+                 (the harness kills it) or fail loudly — either way v2
+                 never publishes.
+      "resume" — fresh 2-process job on the same save_dir: the last
+                 committed version must be v1 with v1's exact contents;
+                 training state moves on and v2 commits collectively.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+
+def main() -> None:
+    port, pid, nproc, save_dir, mode = sys.argv[1:6]
+    pid, nproc = int(pid), int(nproc)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    jax.config.update("jax_platforms", "cpu")
+
+    import time
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distriflow_tpu.checkpoint.sharded import ShardedCheckpointStore
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+
+    def tree_at_step(step: int):
+        # "training state": row i holds process i's shard, values encode
+        # (process, step) so a restore can prove WHICH commit it came from
+        local = np.full((1, 4), 10.0 * pid + step, np.float32)
+        w = jax.make_array_from_process_local_data(sharding, local, (nproc, 4))
+        s = jax.device_put(np.int32(step), NamedSharding(mesh, P()))
+        return {"w": w, "step": s}
+
+    store = ShardedCheckpointStore(save_dir)
+
+    if mode == "die":
+        store.save(tree_at_step(1), version="v1")
+        print(f"WORKER-{pid}-COMMITTED-v1", flush=True)
+        if pid == 1:
+            time.sleep(1.0)  # let process 0 fully finish v1's commit
+            os._exit(1)  # simulated host death: no cleanup, no goodbye
+        time.sleep(2.0)  # ensure the peer is really gone first
+        print("WORKER-0-SAVING-v2", flush=True)
+        try:
+            store.save(tree_at_step(2), version="v2")
+            print("WORKER-0-UNEXPECTED-COMMIT-v2", flush=True)
+        except Exception as e:
+            # coordination service noticed the dead peer: loud failure is
+            # as acceptable as blocking — v2 must not have published
+            print(f"WORKER-0-SAVE-V2-FAILED {type(e).__name__}", flush=True)
+        return
+
+    assert mode == "resume", mode
+    last = store.last()
+    assert last == "v1", f"expected last committed v1, got {last!r}"
+    like = tree_at_step(0)
+    out = store.load("v1", like)
+    got = np.asarray(
+        out["w"].addressable_shards[0].data
+    ).reshape(-1)
+    want = 10.0 * pid + 1  # process pid's shard as committed at step 1
+    assert np.allclose(got, want), (got, want)
+    assert int(out["step"]) == 1
+    # recovery complete: training continues and the next commit lands
+    store.save(tree_at_step(2), version="v2")
+    assert store.last() == "v2"
+    print(f"WORKER-{pid}-RESUMED-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

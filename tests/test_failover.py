@@ -1,0 +1,79 @@
+"""Mesh-mode failure semantics: kill one of 2 real processes, resume from
+the last collective commit (VERDICT r1 item #9; documented in
+docs/MULTIHOST.md §7).
+
+Phase 1 ("die"): two OS processes join a real jax.distributed service and
+collectively commit v1; process 1 then dies hard. Process 0's next save
+must NOT publish — it either blocks at the collective commit (we kill it)
+or fails loudly once the coordination service notices the dead peer.
+
+Phase 2 ("resume"): a fresh 2-process job on the same directory restores
+v1 exactly and commits v2 — the checkpoint-restart recovery recipe.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "failover_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(port, pid, save_dir, mode):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(port), str(pid), "2", save_dir, mode],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO,
+    )
+
+
+def test_kill_one_process_then_resume_from_last_commit(tmp_path):
+    save_dir = str(tmp_path / "ckpt")
+
+    # -- phase 1: one host dies between commits ---------------------------
+    port = _free_port()
+    p0 = _spawn(port, 0, save_dir, "die")
+    p1 = _spawn(port, 1, save_dir, "die")
+    out1, _ = p1.communicate(timeout=120)
+    assert p1.returncode == 1, out1  # died hard, as scripted
+    assert "WORKER-1-COMMITTED-v1" in out1, out1
+    try:
+        # survivor either fails the v2 save loudly or blocks at the
+        # collective commit; both are the documented no-progress semantics
+        out0, _ = p0.communicate(timeout=45)
+        assert "WORKER-0-UNEXPECTED-COMMIT-v2" not in out0, out0
+    except subprocess.TimeoutExpired:
+        p0.kill()
+        out0, _ = p0.communicate()
+    assert "WORKER-0-COMMITTED-v1" in out0, out0
+
+    # v1 is the last (and only) published version; the torn v2 is invisible
+    published = sorted(
+        n for n in os.listdir(save_dir)
+        if not n.startswith(".") and n != "current"
+    )
+    assert published == ["v1"], published
+    assert os.path.exists(os.path.join(save_dir, "current"))
+
+    # -- phase 2: fresh job resumes from the last collective commit -------
+    port = _free_port()
+    procs = [_spawn(port, pid, save_dir, "resume") for pid in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"resume worker {pid}:\n{out}"
+        assert f"WORKER-{pid}-RESUMED-OK" in out, out
+    published = sorted(
+        n for n in os.listdir(save_dir)
+        if not n.startswith(".") and n != "current"
+    )
+    assert published == ["v1", "v2"], published

@@ -1021,3 +1021,36 @@ def test_sequential_duplicate_layer_name_still_rejected(tmp_path):
         "class_name": "Sequential", "config": {"layers": layers}}}})
     with pytest.raises(ValueError, match="duplicate layer name"):
         spec_from_keras_json(path)
+
+
+def test_multi_output_softmax_kept_when_head_feeds_forward(tmp_path):
+    """An output head that ANOTHER layer also consumes keeps its softmax:
+    stripping it in place would feed raw logits downstream."""
+    layers = [
+        {"name": "in_a", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 2], "name": "in_a"},
+         "inbound_nodes": []},
+        {"name": "h1", "class_name": "Dense",
+         "config": {"name": "h1", "units": 3, "activation": "softmax",
+                    "use_bias": False,
+                    "kernel_initializer": {"class_name": "Ones", "config": {}}},
+         "inbound_nodes": [[["in_a", 0, 0, {}]]]},
+        {"name": "h2", "class_name": "Dense",
+         "config": {"name": "h2", "units": 2, "activation": "linear",
+                    "use_bias": False,
+                    "kernel_initializer": {"class_name": "Ones", "config": {}}},
+         "inbound_nodes": [[["h1", 0, 0, {}]]]},
+    ]
+    topo = {"modelTopology": {"model_config": {"class_name": "Model", "config": {
+        "name": "aux_head", "layers": layers,
+        "input_layers": [["in_a", 0, 0]],
+        "output_layers": [["h1", 0, 0], ["h2", 0, 0]],
+    }}}}
+    path = _write_model(tmp_path, topo)
+    spec = spec_from_keras_json(path)  # logits_output default
+    params = spec.init(jax.random.PRNGKey(0))
+    o1, o2 = spec.apply(params, jnp.asarray([[1.0, 2.0]]))
+    # h1 keeps its softmax (it feeds h2): a probability simplex...
+    np.testing.assert_allclose(np.asarray(o1).sum(-1), 1.0, rtol=1e-5)
+    # ...and h2 consumed the probabilities (ones-kernel sums them -> 1.0)
+    np.testing.assert_allclose(np.asarray(o2), 1.0, rtol=1e-5)

@@ -157,3 +157,17 @@ def test_concurrent_uploads(server):
     assert len(seen) == 40
     for c in clients:
         c.close()
+
+
+def test_connect_retry_after_refused(server):
+    """A refused connect() must not poison a retry on the same object:
+    the second attempt (server now up) connects cleanly."""
+    client = ClientTransport("127.0.0.1:1")
+    with pytest.raises((TimeoutError, OSError)):
+        client.connect(timeout=1.0)
+    client.host, client.port = server.address.split(":")[0], int(server.address.split(":")[1])
+    try:
+        client.connect(timeout=5.0)
+        assert client._endpoint is not None
+    finally:
+        client.close()
