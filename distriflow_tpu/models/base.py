@@ -80,7 +80,19 @@ def _optimizer(
     }
     if name not in registry:
         raise KeyError(f"unknown optimizer {name!r}; registered: {sorted(registry)}")
-    return registry[name](learning_rate)
+    # convention: params whose tree path contains "frozen" (e.g.
+    # FrozenBatchNorm's frozen_mean/frozen_var) are excluded from the
+    # ENTIRE transform — stop_gradient alone zeroes their grads but cannot
+    # stop gradient-independent updates like adamw's decoupled weight
+    # decay, which would silently decay pretrained statistics toward zero
+    return optax.masked(registry[name](learning_rate), _trainable_mask)
+
+
+def _trainable_mask(tree: Any) -> Any:
+    """True for trainable leaves, False for 'frozen'-named ones."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: "frozen" not in jax.tree_util.keystr(path), tree
+    )
 
 
 def init_params(spec: "ModelSpec", rng: jax.Array) -> Params:

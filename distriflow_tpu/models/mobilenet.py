@@ -6,14 +6,20 @@ Keras ConvNet export, ``experiment/mnist/mnist_server.ts:16-22`` /
 
 TPU-first design decisions:
 
-- **GroupNorm instead of BatchNorm.** Canonical MobileNetV2 uses BatchNorm,
-  whose running statistics are mutable state and, under data parallelism,
-  require a cross-replica stats sync every step. GroupNorm is stateless —
-  the model stays a pure ``(params, x) -> logits`` function, so every
-  trainer (sync psum, async host-coordinated, federated) consumes it
-  unchanged, and no norm-state divergence exists between workers. Channel
-  counts are multiples of 8 by construction (``_make_divisible``), so a
-  fixed group size of 8 always divides evenly.
+- **GroupNorm by default, frozen BatchNorm on request.** Canonical
+  MobileNetV2 uses BatchNorm, whose running statistics are mutable state
+  and, under data parallelism, require a cross-replica stats sync every
+  step. GroupNorm is stateless — the model stays a pure
+  ``(params, x) -> logits`` function, so every trainer (sync psum, async
+  host-coordinated, federated) consumes it unchanged, and no norm-state
+  divergence exists between workers. Channel counts are multiples of 8 by
+  construction (``_make_divisible``), so a fixed group size of 8 always
+  divides evenly. For **canonical pretrained weights**, pass
+  ``norm="batch"``: BatchNorm with the moving statistics stored as
+  (stop-gradient) parameters — the standard frozen-BN inference/fine-tune
+  semantics, parameter-compatible with stock checkpoints (scale, bias,
+  mean, var per conv), still a pure function. Training from scratch
+  should keep GroupNorm (frozen BN never updates its statistics).
 - **ReLU6 kept** (it is elementwise — XLA fuses it into the preceding
   conv's epilogue; clipping aids low-precision activations).
 - **NHWC layout + explicit dtype policy**: pass ``jnp.bfloat16`` to run the
@@ -28,6 +34,7 @@ from __future__ import annotations
 from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distriflow_tpu.models.base import ModelSpec
@@ -55,14 +62,46 @@ def _make_divisible(v: float, divisor: int = 8) -> int:
     return new_v
 
 
+class FrozenBatchNorm(nn.Module):
+    """BatchNorm with moving statistics as frozen parameters.
+
+    ``y = scale * (x - mean) / sqrt(var + eps) + bias`` with ``mean``/``var``
+    under ``stop_gradient``: the optimizer never moves them (zero grads) and
+    the module stays a pure function — the canonical-checkpoint-compatible
+    norm for pretrained MobileNetV2 (same four per-channel arrays as stock
+    BatchNorm layers). Inference / frozen-BN fine-tune semantics only.
+    """
+
+    eps: float = 1e-3  # tf.keras BatchNormalization default
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        # the "frozen_" prefix keeps these out of the optimizer entirely
+        # (base._optimizer masks them): stop_gradient zeroes their grads,
+        # but only the mask stops gradient-independent updates like adamw's
+        # decoupled weight decay from eroding pretrained statistics
+        mean = self.param("frozen_mean", nn.initializers.zeros, (c,), jnp.float32)
+        var = self.param("frozen_var", nn.initializers.ones, (c,), jnp.float32)
+        mean = jax.lax.stop_gradient(mean)
+        var = jax.lax.stop_gradient(var)
+        inv = (scale / jnp.sqrt(var + self.eps)).astype(self.dtype)
+        shift = (bias - mean * scale / jnp.sqrt(var + self.eps)).astype(self.dtype)
+        return x * inv + shift
+
+
 class _ConvNorm(nn.Module):
-    """conv -> GroupNorm -> optional relu6."""
+    """conv -> norm (GroupNorm | frozen BatchNorm) -> optional relu6."""
 
     features: int
     kernel: Tuple[int, int] = (1, 1)
     stride: int = 1
     groups: int = 1  # feature_group_count (== in-channels for depthwise)
     act: bool = True
+    norm: str = "group"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -76,7 +115,12 @@ class _ConvNorm(nn.Module):
             use_bias=False,
             dtype=self.dtype,
         )(x)
-        x = nn.GroupNorm(num_groups=None, group_size=8, dtype=self.dtype)(x)
+        if self.norm == "batch":
+            x = FrozenBatchNorm(dtype=self.dtype)(x)
+        elif self.norm == "group":
+            x = nn.GroupNorm(num_groups=None, group_size=8, dtype=self.dtype)(x)
+        else:  # validate here too: the module classes are public
+            raise ValueError(f"norm must be 'group' or 'batch', got {self.norm!r}")
         return nn.relu6(x) if self.act else x
 
 
@@ -86,6 +130,7 @@ class InvertedResidual(nn.Module):
     out_ch: int
     stride: int = 1
     expand: int = 6
+    norm: str = "group"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -93,15 +138,16 @@ class InvertedResidual(nn.Module):
         in_ch = x.shape[-1]
         h = x
         if self.expand != 1:
-            h = _ConvNorm(in_ch * self.expand, dtype=self.dtype)(h)
+            h = _ConvNorm(in_ch * self.expand, norm=self.norm, dtype=self.dtype)(h)
         h = _ConvNorm(
             h.shape[-1],
             kernel=(3, 3),
             stride=self.stride,
             groups=h.shape[-1],
+            norm=self.norm,
             dtype=self.dtype,
         )(h)
-        h = _ConvNorm(self.out_ch, act=False, dtype=self.dtype)(h)
+        h = _ConvNorm(self.out_ch, act=False, norm=self.norm, dtype=self.dtype)(h)
         if self.stride == 1 and in_ch == self.out_ch:
             h = h + x
         return h
@@ -111,13 +157,15 @@ class MobileNetV2(nn.Module):
     classes: int = 1000
     width: float = 1.0
     schedule: Sequence[Tuple[int, int, int, int]] = V2_SCHEDULE
+    norm: str = "group"
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x.astype(self.dtype)
         x = _ConvNorm(
-            _make_divisible(32 * self.width), kernel=(3, 3), stride=2, dtype=self.dtype
+            _make_divisible(32 * self.width), kernel=(3, 3), stride=2,
+            norm=self.norm, dtype=self.dtype
         )(x)
         for t, c, n, s in self.schedule:
             out_ch = _make_divisible(c * self.width)
@@ -126,10 +174,11 @@ class MobileNetV2(nn.Module):
                     out_ch,
                     stride=s if i == 0 else 1,
                     expand=t,
+                    norm=self.norm,
                     dtype=self.dtype,
                 )(x)
         head = _make_divisible(1280 * max(1.0, self.width))
-        x = _ConvNorm(head, dtype=self.dtype)(x)
+        x = _ConvNorm(head, norm=self.norm, dtype=self.dtype)(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.classes, dtype=self.dtype)(x)
         return x
@@ -139,11 +188,19 @@ def mobilenet_v2(
     image_size: int = 224,
     classes: int = 1000,
     width: float = 1.0,
+    norm: str = "group",
     dtype: Any = jnp.float32,
 ) -> ModelSpec:
-    """BASELINE config #5 model (ImageNet-subset, sync-SGD, v4-32 stretch)."""
+    """BASELINE config #5 model (ImageNet-subset, sync-SGD, v4-32 stretch).
+
+    ``norm="group"`` (default) trains from scratch; ``norm="batch"`` is the
+    canonical-checkpoint-compatible frozen-BatchNorm variant (see
+    :class:`FrozenBatchNorm`).
+    """
+    if norm not in ("group", "batch"):
+        raise ValueError(f"norm must be 'group' or 'batch', got {norm!r}")
     return spec_from_flax(
-        MobileNetV2(classes=classes, width=width, dtype=dtype),
+        MobileNetV2(classes=classes, width=width, norm=norm, dtype=dtype),
         input_shape=(image_size, image_size, 3),
         output_shape=(classes,),
         name="mobilenet_v2",

@@ -70,6 +70,12 @@ class TransformerConfig:
     # memory drops from O(layers * S * d) to O(S * d) at ~1/3 extra FLOPs —
     # the standard trade for long context / deep stacks
     remat: bool = False
+    # pipeline backward schedule (pipelined_transformer_lm only):
+    # None -> "remat" when remat=True else "gpipe";
+    # "gpipe"  = autodiff through the schedule (fastest, O(M) internals),
+    # "remat"  = input-only residuals + per-stage recompute (O(M) inputs),
+    # "1f1b"   = interleaved one-forward-one-backward (O(P) live inputs)
+    pipeline_schedule: Optional[str] = None
     # integer-label CE by default: LM targets are the [B, S] int32 next-token
     # ids, never a [B, S, V] one-hot (HBM + wire cost scales with V otherwise)
     loss: str = "sparse_softmax_cross_entropy"
@@ -441,7 +447,11 @@ def pipelined_transformer_lm(
     Shard params with ``PIPELINED_TRANSFORMER_RULES``
     (``distriflow_tpu/parallel/sharding.py``).
     """
-    from distriflow_tpu.parallel.pipeline import gpipe, gpipe_remat  # lazy: layer order
+    from distriflow_tpu.parallel.pipeline import (  # lazy: layer order
+        gpipe,
+        gpipe_1f1b,
+        gpipe_remat,
+    )
 
     if config is None:
         config = TransformerConfig(**overrides)
@@ -449,13 +459,22 @@ def pipelined_transformer_lm(
         config = dataclasses.replace(config, **overrides)
     if mesh is None or "pipe" not in mesh.shape or mesh.shape["pipe"] < 2:
         raise ValueError("pipelined_transformer_lm needs a mesh with pipe >= 2")
-    # remat=True routes through gpipe_remat: an input-only-residual custom
-    # backward that recomputes each stage under jax.vjp inside the backward
-    # shard_map. (jax.checkpoint inside the stage body does NOT compose with
-    # the hybrid manual/auto shard_map — checkpoint residuals of auto-sharded
-    # stage params would need specs over auto axes — so rematerialization is
-    # built into the pipeline schedule itself instead.)
-    pipeline_fn = gpipe_remat if config.remat else gpipe
+    # Backward-schedule choice. remat=True routes through gpipe_remat: an
+    # input-only-residual custom backward recomputing each stage under
+    # jax.vjp inside the backward shard_map (jax.checkpoint inside the
+    # stage body does NOT compose with the hybrid manual/auto shard_map —
+    # checkpoint residuals of auto-sharded stage params would need specs
+    # over auto axes — so rematerialization is built into the schedule).
+    # "1f1b" bounds live activations at P instead of M (many-microbatch /
+    # long-context runs).
+    schedules = {"gpipe": gpipe, "remat": gpipe_remat, "1f1b": gpipe_1f1b}
+    schedule = config.pipeline_schedule or ("remat" if config.remat else "gpipe")
+    if schedule not in schedules:
+        raise ValueError(
+            f"pipeline_schedule must be one of {sorted(schedules)}, "
+            f"got {schedule!r}"
+        )
+    pipeline_fn = schedules[schedule]
     n_stages = mesh.shape["pipe"]
     if config.n_layers % n_stages:
         raise ValueError(

@@ -73,6 +73,50 @@ def _pipeline_setup(stacked_params, x, mesh, num_microbatches, axis, data_axis):
 
 
 
+def _make_forward_local(stage_fn, p, m, axis, perm_down, save_inputs):
+    """The one forward-schedule body all three variants share: stage 0
+    injects microbatch t, everyone runs the stage, the last stage banks
+    slot t-(P-1), activations rotate one hop down the ring. With
+    ``save_inputs`` each tick's stage input is also returned (leading
+    stages dim) — gpipe_remat's only residual."""
+
+    def local(params, xs):
+        params = jax.tree.map(lambda v: v[0], params)  # my stage's slice
+        idx = lax.axis_index(axis)
+        state0 = pvary(jnp.zeros_like(xs[0]), axis)
+        outputs0 = pvary(jnp.zeros_like(xs), axis)
+
+        def tick(carry, t):
+            state, outputs = carry
+            x_in = lax.dynamic_index_in_dim(xs, jnp.minimum(t, m - 1), 0,
+                                            keepdims=False)
+            state = jnp.where((idx == 0) & (t < m), x_in, state)
+            saved = state
+            out = stage_fn(params, state)
+            out_slot = t - (p - 1)
+            bank = (idx == p - 1) & (out_slot >= 0)
+            outputs = lax.cond(
+                bank,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, out, jnp.maximum(out_slot, 0), 0),
+                lambda o: o,
+                outputs,
+            )
+            state = lax.ppermute(out, axis, perm_down)
+            return (state, outputs), (saved if save_inputs else None)
+
+        (_, outputs), saved = lax.scan(tick, (state0, outputs0),
+                                       jnp.arange(m + p - 1))
+        outputs = lax.psum(
+            jnp.where(idx == p - 1, outputs, jnp.zeros_like(outputs)), axis
+        )
+        if save_inputs:
+            return outputs, saved[:, None]  # [ticks, 1(stage), mb_local, ...]
+        return outputs
+
+    return local
+
+
 def gpipe(
     stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     stacked_params: Any,
@@ -98,43 +142,7 @@ def gpipe(
     p, m, mb, d, xs, batch_spec, manual, perm = _pipeline_setup(
         stacked_params, x, mesh, num_microbatches, axis, data_axis)
 
-    def local(params, xs):
-        params = jax.tree.map(lambda v: v[0], params)  # my stage's slice
-        xs = xs  # replicated [M, mb, ...]
-        idx = lax.axis_index(axis)
-        ticks = m + p - 1
-        state = pvary(jnp.zeros_like(xs[0]), axis)  # activation in flight
-        outputs = pvary(jnp.zeros_like(xs), axis)  # banked on the last stage
-
-        def tick(t, carry):
-            state, outputs = carry
-            # stage 0 injects microbatch t (zeros once the batch is drained)
-            inject = jnp.where(t < m, 1, 0)
-            x_in = lax.dynamic_index_in_dim(xs, jnp.minimum(t, m - 1), 0,
-                                            keepdims=False)
-            state = jnp.where((idx == 0) & (inject == 1), x_in, state)
-            out = stage_fn(params, state)
-            # last stage banks microbatch t-(p-1) once the pipe is full
-            out_slot = t - (p - 1)
-            bank = (idx == p - 1) & (out_slot >= 0)
-            outputs = lax.cond(
-                bank,
-                lambda o: lax.dynamic_update_index_in_dim(
-                    o, out, jnp.maximum(out_slot, 0), 0
-                ),
-                lambda o: o,
-                outputs,
-            )
-            # rotate activations one hop down the ring
-            state = lax.ppermute(out, axis, perm)
-            return state, outputs
-
-        _, outputs = lax.fori_loop(0, ticks, tick, (state, outputs))
-        # replicate the last stage's bank to every pipe member
-        outputs = lax.psum(
-            jnp.where(idx == p - 1, outputs, jnp.zeros_like(outputs)), axis
-        )
-        return outputs
+    local = _make_forward_local(stage_fn, p, m, axis, perm, save_inputs=False)
 
     # Hybrid manual/auto: only the pipe (and data) axes are manual in the
     # body. Every other mesh axis stays automatic, so e.g. Megatron TP
@@ -189,37 +197,8 @@ def gpipe_remat(
     ticks = m + p - 1
     perm_up = [(i, (i - 1) % p) for i in range(p)]
 
-    def fwd_local(params, xs):
-        params = jax.tree.map(lambda v: v[0], params)  # my stage's slice
-        idx = lax.axis_index(axis)
-        state0 = pvary(jnp.zeros_like(xs[0]), axis)
-        outputs0 = pvary(jnp.zeros_like(xs), axis)
-
-        def tick(carry, t):
-            state, outputs = carry
-            x_in = lax.dynamic_index_in_dim(xs, jnp.minimum(t, m - 1), 0,
-                                            keepdims=False)
-            state = jnp.where((idx == 0) & (t < m), x_in, state)
-            saved = state  # the ONLY residual: this tick's stage input
-            out = stage_fn(params, state)
-            out_slot = t - (p - 1)
-            bank = (idx == p - 1) & (out_slot >= 0)
-            outputs = lax.cond(
-                bank,
-                lambda o: lax.dynamic_update_index_in_dim(
-                    o, out, jnp.maximum(out_slot, 0), 0),
-                lambda o: o,
-                outputs,
-            )
-            state = lax.ppermute(out, axis, perm_down)
-            return (state, outputs), saved
-
-        (_, outputs), saved = lax.scan(tick, (state0, outputs0),
-                                       jnp.arange(ticks))
-        outputs = lax.psum(
-            jnp.where(idx == p - 1, outputs, jnp.zeros_like(outputs)), axis
-        )
-        return outputs, saved[:, None]  # [ticks, 1(stage), mb_local, ...]
+    fwd_local = _make_forward_local(
+        stage_fn, p, m, axis, perm_down, save_inputs=True)
 
     def bwd_local(params, saved, dys):
         params = jax.tree.map(lambda v: v[0], params)
@@ -293,3 +272,148 @@ def gpipe_remat(
     run.defvjp(run_fwd, run_bwd)
     out = run(stacked_params, xs)
     return out.reshape((b,) + x.shape[1:])
+
+
+def gpipe_1f1b(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pipe",
+    data_axis: str = "data",
+) -> jnp.ndarray:
+    """Interleaved 1F1B pipeline: O(P) live activations, any microbatch count.
+
+    The backward pass runs the classic one-forward-one-backward schedule as
+    a single SPMD tick loop: stage ``s`` runs the *forward* of microbatch
+    ``m`` at tick ``2m + s`` and its *backward* at tick ``2m + 2P - 1 - s``
+    — per device, forward and backward ticks strictly alternate (the 1F1B
+    steady state), forward activations flow down the ring on even-offset
+    ticks while cotangents flow up on the odd ones, and a microbatch's
+    stage input is freed ``2(P - s) - 1`` ticks after it is produced. Live
+    stage inputs per device therefore never exceed P — a ring buffer of P
+    microbatch activations — versus O(M) for :func:`gpipe_remat`'s saved
+    schedule and O(M x stage internals) for autodiff :func:`gpipe`. The
+    custom VJP keeps **no residuals at all** beyond (params, xs): the
+    backward loop recomputes the forward wave itself, interleaved with
+    consumption, which is what bounds the window to P.
+
+    Gradients are exact (equivalence-tested against autodiff
+    :func:`gpipe`). Cost: the primal forward plus a 2(M+P-1)-tick combined
+    loop whose per-tick work is one stage forward OR one stage
+    re-linearization (``jax.vjp``), selected by a per-device
+    ``lax.cond`` — collectives stay outside the conditional, so lockstep
+    ppermutes are preserved. Prefer this schedule for long training runs
+    with many microbatches where even gpipe_remat's O(M) stage-input
+    buffer binds; prefer :func:`gpipe_remat` when M is small (its loop is
+    shorter and branch-free).
+    """
+    b = x.shape[0]
+    p, m, mb, d, xs, batch_spec, manual, perm_down = _pipeline_setup(
+        stacked_params, x, mesh, num_microbatches, axis, data_axis)
+    perm_up = [(i, (i - 1) % p) for i in range(p)]
+    bwd_ticks = 2 * m + 2 * p - 2
+    ring_size = p
+
+    # primal forward: the plain schedule, nothing saved (the 1F1B backward
+    # recomputes the forward wave itself)
+    fwd_local = _make_forward_local(
+        stage_fn, p, m, axis, perm_down, save_inputs=False)
+
+    def bwd_local(params, xs, dys):
+        params = jax.tree.map(lambda v: v[0], params)
+        idx = lax.axis_index(axis)
+        fwd0 = pvary(jnp.zeros_like(xs[0]), axis)
+        cot0 = pvary(jnp.zeros_like(dys[0]), axis)
+        ring0 = pvary(jnp.zeros((ring_size,) + xs[0].shape, xs.dtype), axis)
+        dxs0 = pvary(jnp.zeros_like(dys), axis)
+        grads0 = jax.tree.map(jnp.zeros_like, params)
+
+        def tick(carry, t):
+            fwd_state, cot_in, ring, dxs, grads = carry
+            # forward slot: stage idx runs microbatch m_f at tick 2*m_f+idx
+            tf = t - idx
+            m_f = jnp.clip(tf // 2, 0, m - 1)
+            f_active = (tf >= 0) & (tf % 2 == 0) & (tf // 2 < m)
+            # backward slot: tick 2*m_b + 2P-1 - idx
+            tb = t - (2 * p - 1 - idx)
+            m_b = jnp.clip(tb // 2, 0, m - 1)
+            b_active = (tb >= 0) & (tb % 2 == 0) & (tb // 2 < m)
+
+            zero_state = jnp.zeros_like(fwd_state)
+
+            def f_branch(ops):
+                fwd_state, cot_in, ring, dxs = ops
+                x_in = lax.dynamic_index_in_dim(xs, m_f, 0, keepdims=False)
+                state = jnp.where(idx == 0, x_in, fwd_state)
+                out = stage_fn(params, state)
+                # save this microbatch's stage input; ring slot m_f mod P is
+                # free again by schedule construction. Inactive (idle) ticks
+                # run this branch too — suppress their garbage write.
+                slot = m_f % ring_size
+                old = lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False)
+                ring = lax.dynamic_update_index_in_dim(
+                    ring, jnp.where(f_active, state, old), slot, 0)
+                return out, zero_state, jax.tree.map(jnp.zeros_like, grads), ring, dxs
+
+            def b_branch(ops):
+                fwd_state, cot_in, ring, dxs = ops
+                state_t = lax.dynamic_index_in_dim(ring, m_b % ring_size, 0,
+                                                   keepdims=False)
+                dy_t = lax.dynamic_index_in_dim(dys, m_b, 0, keepdims=False)
+                # last stage consumes the loss cotangent of its banked slot;
+                # everyone else consumes what downstream sent up the ring
+                cot_out = jnp.where(idx == p - 1, dy_t, cot_in)
+                _, vjp_fn = jax.vjp(stage_fn, params, state_t)
+                dp, dstate = vjp_fn(cot_out)
+                # stage 0 banks dx (its input was the injected microbatch);
+                # nothing real continues above stage 0
+                old = lax.dynamic_index_in_dim(dxs, m_b, 0, keepdims=False)
+                dxs = lax.dynamic_update_index_in_dim(
+                    dxs, jnp.where(idx == 0, dstate, old), m_b, 0)
+                dstate_pass = jnp.where(idx == 0, jnp.zeros_like(dstate), dstate)
+                return zero_state, dstate_pass, dp, ring, dxs
+
+            out, dstate_pass, dp, ring, dxs = lax.cond(
+                b_active, b_branch, f_branch,
+                (fwd_state, cot_in, ring, dxs))
+            grads = jax.tree.map(jnp.add, grads, dp)
+            # both waves advance every tick, branch-independent (collectives
+            # never sit inside the cond)
+            fwd_next = lax.ppermute(out, axis, perm_down)
+            cot_next = lax.ppermute(dstate_pass, axis, perm_up)
+            return (fwd_next, cot_next, ring, dxs, grads), None
+
+        (_, _, _, dxs, grads), _ = lax.scan(
+            tick, (fwd0, cot0, ring0, dxs0, grads0), jnp.arange(bwd_ticks))
+        if d > 1:
+            grads = jax.tree.map(lambda g: lax.psum(g, data_axis), grads)
+        dxs = lax.psum(jnp.where(idx == 0, dxs, jnp.zeros_like(dxs)), axis)
+        return jax.tree.map(lambda g: g[None], grads), dxs
+
+    fwd_sm = shard_map(
+        fwd_local, mesh=mesh,
+        in_specs=(P(axis), batch_spec), out_specs=batch_spec,
+        axis_names=manual, check_vma=False,
+    )
+    bwd_sm = shard_map(
+        bwd_local, mesh=mesh,
+        in_specs=(P(axis), batch_spec, batch_spec),
+        out_specs=(P(axis), batch_spec),
+        axis_names=manual, check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def run(params, xs):
+        return fwd_sm(params, xs)
+
+    def run_fwd(params, xs):
+        return fwd_sm(params, xs), (params, xs)
+
+    def run_bwd(res, dy):
+        params, xs = res
+        return bwd_sm(params, xs, dy)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked_params, xs).reshape((b,) + x.shape[1:])

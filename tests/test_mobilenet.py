@@ -109,3 +109,108 @@ def test_train_entrypoint_synthetic(devices):
         ["--steps", "3", "--batch-size", "16", "--image-size", "32", "--width", "0.25"]
     )
     assert np.isfinite(acc)
+
+
+def test_frozen_batchnorm_matches_manual_formula():
+    """norm="batch": y = scale*(x-mean)/sqrt(var+eps)+bias with hand-set
+    stats; mean/var receive ZERO gradient (frozen)."""
+    from distriflow_tpu.models.mobilenet import FrozenBatchNorm
+
+    m = FrozenBatchNorm(eps=1e-3)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 4, 4, 3).astype(np.float32))
+    params = {
+        "params": {
+            "scale": jnp.asarray([1.0, 2.0, 0.5]),
+            "bias": jnp.asarray([0.0, 1.0, -1.0]),
+            "frozen_mean": jnp.asarray([0.1, -0.2, 0.3]),
+            "frozen_var": jnp.asarray([1.0, 4.0, 0.25]),
+        }
+    }
+    got = np.asarray(m.apply(params, x))
+    p = {k: np.asarray(v) for k, v in params["params"].items()}
+    want = (p["scale"] * (np.asarray(x) - p["frozen_mean"])
+            / np.sqrt(p["frozen_var"] + 1e-3) + p["bias"])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def loss(pp):
+        return jnp.sum(m.apply(pp, x) ** 2)
+
+    g = jax.grad(loss)(params)["params"]
+    assert np.all(np.asarray(g["frozen_mean"]) == 0.0)
+    assert np.all(np.asarray(g["frozen_var"]) == 0.0)
+    assert np.any(np.asarray(g["scale"]) != 0.0)  # trainables still learn
+
+
+def test_mobilenet_batchnorm_variant_trains():
+    """norm="batch" builds the canonical-checkpoint-shaped model: each norm
+    has scale/bias/mean/var, and a training step still works (frozen-BN
+    fine-tune semantics)."""
+    from distriflow_tpu.models.mobilenet import mobilenet_v2
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    spec = mobilenet_v2(image_size=32, classes=10, width=0.35, norm="batch")
+    trainer = SyncTrainer(spec, learning_rate=0.01)
+    trainer.init(jax.random.PRNGKey(0))
+    flat = {
+        jax.tree_util.keystr(p)
+        for p, _ in jax.tree_util.tree_flatten_with_path(trainer.state.params)[0]
+    }
+    assert any("FrozenBatchNorm" in k and "mean" in k for k in flat), sorted(flat)[:5]
+    assert not any("GroupNorm" in k for k in flat)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 32, 32, 3).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+    before = jax.device_get(trainer.state.params)
+    loss = trainer.step((x, y))
+    assert np.isfinite(loss)
+    after = jax.device_get(trainer.state.params)
+    # frozen stats did not move; conv kernels did
+    flat_b = jax.tree_util.tree_flatten_with_path(before)[0]
+    flat_a = jax.tree_util.tree_flatten_with_path(after)[0]
+    moved_kernel = moved_stat = False
+    for (pb, vb), (pa, va) in zip(flat_b, flat_a):
+        key = jax.tree_util.keystr(pb)
+        changed = not np.array_equal(np.asarray(vb), np.asarray(va))
+        if "FrozenBatchNorm" in key and ("mean" in key or "var" in key):
+            moved_stat = moved_stat or changed
+        if "Conv" in key and "kernel" in key:
+            moved_kernel = moved_kernel or changed
+    assert moved_kernel and not moved_stat
+
+
+def test_mobilenet_norm_validation():
+    from distriflow_tpu.models.mobilenet import mobilenet_v2
+
+    with pytest.raises(ValueError, match="norm"):
+        mobilenet_v2(norm="layer")
+
+
+def test_frozen_stats_survive_adamw_weight_decay():
+    """stop_gradient alone cannot stop adamw's decoupled weight decay; the
+    'frozen_' optimizer mask must: after steps with adamw, the stats are
+    bit-identical while trainables moved."""
+    from distriflow_tpu.models.mobilenet import mobilenet_v2
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    spec = mobilenet_v2(image_size=32, classes=10, width=0.35, norm="batch")
+    trainer = SyncTrainer(spec, learning_rate=0.01, optimizer="adamw")
+    trainer.init(jax.random.PRNGKey(0))
+    before = jax.device_get(trainer.state.params)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 32, 32, 3).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+    for _ in range(3):
+        trainer.step((x, y))
+    after = jax.device_get(trainer.state.params)
+    flat_b = jax.tree_util.tree_flatten_with_path(before)[0]
+    flat_a = jax.tree_util.tree_flatten_with_path(after)[0]
+    for (pb, vb), (_, va) in zip(flat_b, flat_a):
+        key = jax.tree_util.keystr(pb)
+        if "frozen" in key:
+            np.testing.assert_array_equal(np.asarray(vb), np.asarray(va)), key
+    assert any(
+        "frozen" not in jax.tree_util.keystr(pb)
+        and not np.array_equal(np.asarray(vb), np.asarray(va))
+        for (pb, vb), (_, va) in zip(flat_b, flat_a)
+    )

@@ -157,3 +157,79 @@ def test_gpipe_remat_activation_memory_drop(devices):
     # the drop must be structural (internals no longer scale with ticks),
     # not noise: require at least 2x on this wide-FFN configuration
     assert remat * 2 <= base, f"no memory drop: gpipe={base} remat={remat}"
+
+
+@pytest.mark.parametrize("data_axis_size", [1, 2])
+@pytest.mark.parametrize("microbatches", [4, 8])
+def test_gpipe_1f1b_grads_match_autodiff_gpipe(devices, data_axis_size,
+                                               microbatches):
+    """Interleaved 1F1B schedule: exact grad equivalence with autodiff
+    GPipe — param grads and input cotangents, with/without a data axis,
+    M == P and M > P."""
+    from distriflow_tpu.parallel.pipeline import gpipe_1f1b
+
+    mesh = create_mesh(
+        MeshConfig(pipe=4, data=data_axis_size),
+        devices[: 4 * data_axis_size])
+    rng = np.random.RandomState(3)
+    params = _stack_params(rng, 4, 8)
+    x = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    y = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+
+    def loss(pipeline_fn, pp, xx):
+        out = pipeline_fn(_mlp_stage, pp, xx, mesh, microbatches)
+        return jnp.mean((out - y) ** 2)
+
+    g_base = jax.jit(jax.grad(lambda pp, xx: loss(gpipe, pp, xx),
+                              argnums=(0, 1)))(params, x)
+    g_1f1b = jax.jit(jax.grad(lambda pp, xx: loss(gpipe_1f1b, pp, xx),
+                              argnums=(0, 1)))(params, x)
+    for a, b in zip(jax.tree.leaves(g_base), jax.tree.leaves(g_1f1b)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_gpipe_1f1b_forward_matches_gpipe(devices):
+    from distriflow_tpu.parallel.pipeline import gpipe_1f1b
+
+    mesh = create_mesh(MeshConfig(pipe=4, data=2), devices)
+    rng = np.random.RandomState(4)
+    params = _stack_params(rng, 4, 8)
+    x = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+    base = jax.jit(lambda pp, xx: gpipe(_mlp_stage, pp, xx, mesh, 8))(params, x)
+    got = jax.jit(lambda pp, xx: gpipe_1f1b(_mlp_stage, pp, xx, mesh, 8))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_gpipe_1f1b_memory_flat_in_microbatches(devices):
+    """The 1F1B ring bounds live activations at P: temp memory must stay
+    ~flat as M grows, and at large M undercut gpipe_remat's O(M) saved
+    schedule."""
+    from distriflow_tpu.parallel.pipeline import gpipe_1f1b, gpipe_remat
+
+    mesh = create_mesh(MeshConfig(pipe=4, data=2), devices)
+    rng = np.random.RandomState(5)
+    d_, ff = 16, 256
+    params = {
+        "w_in": jnp.asarray(rng.randn(4, d_, ff).astype(np.float32) * 0.1),
+        "w_out": jnp.asarray(rng.randn(4, ff, d_).astype(np.float32) * 0.1),
+    }
+
+    def wide_stage(p, a):
+        return a + jnp.tanh(jnp.tanh(a @ p["w_in"]) @ p["w_out"])
+
+    def temp_bytes(pipeline_fn, M):
+        x = jnp.asarray(rng.randn(M * 8, d_).astype(np.float32))
+
+        def loss(pp, xx):
+            return jnp.mean(pipeline_fn(wide_stage, pp, xx, mesh, M) ** 2)
+
+        compiled = jax.jit(jax.grad(loss)).lower(params, x).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    small, big = temp_bytes(gpipe_1f1b, 8), temp_bytes(gpipe_1f1b, 64)
+    # 8x the microbatches must NOT cost anywhere near 8x the temp memory
+    # (the ring is fixed at P; only the M-sized dxs/xs banks grow)
+    assert big < small * 3, (small, big)
+    assert big < temp_bytes(gpipe_remat, 64), "1f1b should undercut remat at large M"

@@ -183,6 +183,26 @@ def test_step_many_fires_version_callback(devices):
     assert seen == ["3"]  # fired once per chunk, with the advanced counter
 
 
+def _adam_mu(opt_state):
+    """Locate the adam mu buffer regardless of wrappers (optax.masked wraps
+    the whole state in MaskedState since the frozen-param convention)."""
+    found = []
+
+    def visit(node):
+        if hasattr(node, "mu"):
+            found.append(node.mu)
+            return
+        if isinstance(node, (tuple, list)):
+            for c in node:
+                visit(c)
+        elif hasattr(node, "inner_state"):
+            visit(node.inner_state)
+
+    visit(opt_state)
+    assert found, f"no mu in {type(opt_state)}"
+    return found[0]
+
+
 def test_zero_optimizer_sharding_matches_replicated(devices):
     """ZeRO-1 (moments sharded over data) is a pure memory layout change:
     losses and params must match the replicated-optimizer run exactly, and
@@ -204,11 +224,11 @@ def test_zero_optimizer_sharding_matches_replicated(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-6)
 
     # the adam mu buffer for the 784x16 kernel is sharded over data (8)
-    mu = t1.state.opt_state[0].mu
+    mu = _adam_mu(t1.state.opt_state)
     big = max(jax.tree_util.tree_leaves(mu), key=lambda v: v.size)
     assert big.addressable_shards[0].data.shape[0] == big.shape[0] // 8
     # replicated run keeps full copies
-    mu0 = t0.state.opt_state[0].mu
+    mu0 = _adam_mu(t0.state.opt_state)
     big0 = max(jax.tree_util.tree_leaves(mu0), key=lambda v: v.size)
     assert big0.addressable_shards[0].data.shape == big0.shape
 
@@ -228,6 +248,6 @@ def test_zero_sharding_skips_params_already_on_data_axis(devices):
     t.step((x, y))
     # set_params keeps the ZeRO moment sharding
     t.set_params(jax.tree.map(np.asarray, t.get_params()))
-    mu = t.state.opt_state[0].mu
+    mu = _adam_mu(t.state.opt_state)
     big = max(jax.tree_util.tree_leaves(mu), key=lambda v: v.size)
     assert big.addressable_shards[0].data.size < big.size
