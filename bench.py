@@ -428,6 +428,10 @@ def bench_transformer(n_chips):
         "value": round(toks / n_chips, 1),
         "step_ms": round(r["step_ms"], 3),
         "mfu": mfu,
+        # XLA cost analysis does not count Pallas custom-call FLOPs, so the
+        # flash-attention share is missing from the numerator: true MFU is
+        # slightly higher (~7% of step FLOPs are attention at S=1024)
+        "mfu_note": "lower bound (flash-attention kernel FLOPs uncounted)",
         "d_model": cfg.d_model,
         "n_layers": cfg.n_layers,
         "seq_len": S,

@@ -51,12 +51,23 @@ def _optimizer(
     here sgd is the parity default and the registry is open via optax.
 
     ``name`` may also be a ready-made ``optax.GradientTransformation``
-    (passed through untouched — bring any chain), and ``learning_rate`` may
-    be an optax schedule (step -> lr), e.g. from
-    ``distriflow_tpu.train.schedules``. ``None`` means "unset": the caller's
-    ``default_rate`` applies (the reference client default 0.001,
-    ``src/common/utils.ts:183``), and no ignored-rate warning can fire when
-    a ready-made transformation is supplied.
+    (bring any chain), and ``learning_rate`` may be an optax schedule
+    (step -> lr), e.g. from ``distriflow_tpu.train.schedules``. ``None``
+    means "unset": the caller's ``default_rate`` applies (the reference
+    client default 0.001, ``src/common/utils.ts:183``), and no
+    ignored-rate warning can fire when a ready-made transformation is
+    supplied.
+
+    **Frozen-param convention**: every returned transform — registry-built
+    or ready-made — is wrapped in ``optax.masked`` excluding params whose
+    leaf name starts with ``frozen_`` (e.g. ``FrozenBatchNorm``'s
+    ``frozen_mean``/``frozen_var``). stop_gradient alone zeroes their
+    grads but cannot stop gradient-independent updates like adamw's
+    decoupled weight decay, which would silently decay pretrained
+    statistics toward zero. NB the wrapper adds a ``MaskedState`` level to
+    the opt-state pytree, so opt-state checkpoints written by versions
+    without it do not restore (structure is path-keyed and mismatches
+    raise loudly).
     """
     if isinstance(name, optax.GradientTransformation):
         if learning_rate is not None:
@@ -67,7 +78,7 @@ def _optimizer(
                 "transformation — set the rate inside the chain instead",
                 stacklevel=2,
             )
-        return name
+        return optax.masked(name, _trainable_mask)
     if learning_rate is None:
         learning_rate = default_rate
     registry: Dict[str, Callable[[Any], optax.GradientTransformation]] = {
@@ -80,19 +91,23 @@ def _optimizer(
     }
     if name not in registry:
         raise KeyError(f"unknown optimizer {name!r}; registered: {sorted(registry)}")
-    # convention: params whose tree path contains "frozen" (e.g.
-    # FrozenBatchNorm's frozen_mean/frozen_var) are excluded from the
-    # ENTIRE transform — stop_gradient alone zeroes their grads but cannot
-    # stop gradient-independent updates like adamw's decoupled weight
-    # decay, which would silently decay pretrained statistics toward zero
     return optax.masked(registry[name](learning_rate), _trainable_mask)
 
 
 def _trainable_mask(tree: Any) -> Any:
-    """True for trainable leaves, False for 'frozen'-named ones."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, _: "frozen" not in jax.tree_util.keystr(path), tree
-    )
+    """True for trainable leaves; False where the LEAF NAME starts with
+    ``frozen_`` (an exact-prefix test on the final path component — a
+    module merely containing the substring, e.g. ``UnfrozenEncoder``,
+    still trains)."""
+
+    def trainable(path, _):
+        last = path[-1] if path else None
+        name = getattr(last, "key", None)
+        if name is None:
+            name = getattr(last, "name", "")
+        return not str(name).startswith("frozen_")
+
+    return jax.tree_util.tree_map_with_path(trainable, tree)
 
 
 def init_params(spec: "ModelSpec", rng: jax.Array) -> Params:

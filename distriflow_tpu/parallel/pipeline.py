@@ -15,13 +15,13 @@ is the SPMD collective-permute pipeline:
 - activations must keep one shape through stages (true for transformer
   blocks), which is what lets a single jitted program express the schedule.
 
-Two backward strategies:
+Three backward strategies (``TransformerConfig.pipeline_schedule``):
 
 - :func:`gpipe` — plain autodiff through the schedule. JAX saves every
   tick's stage *internals* (attention scores, FFN intermediates, ...) as
   scan residuals: per-device activation memory is
   O(ticks x microbatch x per-stage internals) — the deep/long-context
-  memory wall.
+  memory wall. Fastest when memory is not binding.
 - :func:`gpipe_remat` — a custom-VJP schedule that saves ONLY each tick's
   stage *input* ([mb, ...] activations, one tensor per tick) and re-runs
   the stage under ``jax.vjp`` during a mirrored reverse schedule. This is
@@ -31,6 +31,13 @@ Two backward strategies:
   (the round-1 failure mode). Cost: one extra stage forward per
   microbatch-stage (the standard remat trade); memory: internals shrink to
   one live microbatch per device regardless of pipeline depth.
+- :func:`gpipe_1f1b` — the interleaved one-forward-one-backward order as a
+  single combined tick loop in the backward: live stage inputs are bounded
+  by P (a ring buffer) instead of remat's M, and the custom VJP keeps no
+  residuals beyond (params, xs). The winner when activations dominate —
+  many microbatches x long sequences.
+
+Gradients are exact for all three (equivalence-tested).
 """
 
 from __future__ import annotations

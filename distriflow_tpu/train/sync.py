@@ -310,6 +310,11 @@ class SyncTrainer:
         models measure through ``step_many``/``run_chunked`` and pass the
         per-step time explicitly. ``peak_flops_per_chip`` is looked up from
         the device kind (dense bf16 peak) when not given.
+
+        Caveat: XLA's cost analysis does not count FLOPs inside Pallas
+        custom calls, so models using the flash-attention kernels report a
+        LOWER BOUND (the attention share of step FLOPs is missing from the
+        numerator — ~7% at S=1k, growing with sequence length).
         """
         if step_seconds is None:
             if self.mean_step_ms is None:

@@ -214,3 +214,45 @@ def test_frozen_stats_survive_adamw_weight_decay():
         and not np.array_equal(np.asarray(vb), np.asarray(va))
         for (pb, vb), (_, va) in zip(flat_b, flat_a)
     )
+
+
+def test_frozen_mask_applies_to_ready_made_transformations():
+    """A user-supplied optax chain gets the frozen mask too — adamw weight
+    decay via a ready-made transformation must not erode frozen stats."""
+    import optax
+
+    from distriflow_tpu.models.mobilenet import mobilenet_v2
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    spec = mobilenet_v2(image_size=32, classes=10, width=0.35, norm="batch")
+    trainer = SyncTrainer(spec, optimizer=optax.adamw(1e-2, weight_decay=0.1))
+    trainer.init(jax.random.PRNGKey(0))
+    before = jax.device_get(trainer.state.params)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 32, 32, 3).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+    for _ in range(2):
+        trainer.step((x, y))
+    after = jax.device_get(trainer.state.params)
+    for (pb, vb), (_, va) in zip(
+        jax.tree_util.tree_flatten_with_path(before)[0],
+        jax.tree_util.tree_flatten_with_path(after)[0],
+    ):
+        if "frozen" in jax.tree_util.keystr(pb):
+            np.testing.assert_array_equal(np.asarray(vb), np.asarray(va))
+
+
+def test_frozen_mask_is_leaf_prefix_not_substring():
+    """Only leaf names starting with 'frozen_' are masked: a module or
+    param merely CONTAINING the substring still trains."""
+    from distriflow_tpu.models.base import _trainable_mask
+
+    tree = {
+        "UnfrozenEncoder": {"kernel": np.zeros(2), "unfrozen_bias": np.zeros(2)},
+        "bn": {"frozen_mean": np.zeros(2), "scale": np.zeros(2)},
+    }
+    mask = _trainable_mask(tree)
+    assert mask["UnfrozenEncoder"]["kernel"] is True
+    assert mask["UnfrozenEncoder"]["unfrozen_bias"] is True
+    assert mask["bn"]["frozen_mean"] is False
+    assert mask["bn"]["scale"] is True

@@ -30,7 +30,11 @@ def test_optimizer_accepts_schedule_and_transform():
     sched = get_schedule("cosine", init_value=0.1, decay_steps=50)
     assert isinstance(_optimizer("adam", sched), optax.GradientTransformation)
     chain = optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1))
-    assert _optimizer(chain, 0.0) is chain
+    # ready-made chains come back wrapped in the frozen-param mask (so the
+    # frozen_ convention holds for user transforms too), not passed through
+    wrapped = _optimizer(chain, 0.0)
+    assert isinstance(wrapped, optax.GradientTransformation)
+    assert wrapped is not chain
     with pytest.raises(KeyError, match="unknown optimizer"):
         _optimizer("lion", 0.1)
 
