@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from distriflow_tpu.client.abstract_client import AbstractClient
 from distriflow_tpu.utils.messages import DownloadMsg, GradientMsg, UploadMsg
-from distriflow_tpu.utils.serialization import deserialize_array, serialize_tree
+from distriflow_tpu.utils.serialization import deserialize_array
 
 
 class AsynchronousSGDClient(AbstractClient):
@@ -55,7 +55,7 @@ class AsynchronousSGDClient(AbstractClient):
                 batch=msg.data.batch,
                 gradients=GradientMsg(
                     version=msg.model.version,
-                    vars=serialize_tree(self.compress_grads(grads)),
+                    vars=self.serialize_grads(grads),
                 ),
                 metrics=metrics,
             )

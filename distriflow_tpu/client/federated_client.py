@@ -18,7 +18,6 @@ import numpy as np
 
 from distriflow_tpu.client.abstract_client import AbstractClient
 from distriflow_tpu.utils.messages import GradientMsg, UploadMsg
-from distriflow_tpu.utils.serialization import serialize_tree
 
 
 class FederatedClient(AbstractClient):
@@ -70,7 +69,7 @@ class FederatedClient(AbstractClient):
                         client_id=self.client_id,
                         gradients=GradientMsg(
                             version=version,
-                            vars=serialize_tree(self.compress_grads(grads)),
+                            vars=self.serialize_grads(grads),
                         ),
                         metrics=metrics,
                     )

@@ -61,7 +61,7 @@ def asdict(cfg: Any) -> Dict[str, Any]:
 
 
 # allowed gradient_compression values (shared with AbstractClient.compress_grads)
-COMPRESSION_DTYPES = ("none", "float16", "bfloat16")
+COMPRESSION_DTYPES = ("none", "float16", "bfloat16", "int8")
 
 
 @dataclass
