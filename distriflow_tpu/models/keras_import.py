@@ -222,7 +222,7 @@ class _Builder:
         if handler is None:
             raise ValueError(
                 f"unsupported layer {class_name!r}; supported: Conv1D/2D, "
-                "DepthwiseConv2D, Conv2DTranspose, UpSampling2D, Dense, "
+                "DepthwiseConv2D, SeparableConv2D, Conv2DTranspose, UpSampling2D, Dense, "
                 "Embedding, SimpleRNN, LSTM, GRU, Bidirectional, Activation, "
                 "ReLU, Max/AveragePooling1D/2D, GlobalAverage/MaxPooling1D/2D, "
                 "Flatten, Reshape, ZeroPadding2D, Dropout, SpatialDropout1D, "
@@ -317,6 +317,61 @@ class _Builder:
         oh = _conv_dim(h, ek_h, strides[0], padding)
         ow = _conv_dim(w, ek_w, strides[1], padding)
         self.shape = (oh, ow, cin * mult)
+
+    def _add_SeparableConv2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        """Depthwise 2D conv followed by a 1x1 pointwise conv (Xception /
+        MobileNetV1 family): two kernels, one bias, activation after the
+        pointwise step."""
+        h, w, cin = self._need_shape(name)
+        kh, kw = cfg["kernel_size"]
+        mult = int(cfg.get("depth_multiplier", 1))
+        filters = int(cfg["filters"])
+        strides = tuple(int(s) for s in cfg.get("strides", (1, 1)))
+        dilation = tuple(int(d) for d in cfg.get("dilation_rate", (1, 1)))
+        padding = _pool_padding(cfg)
+        use_bias = cfg.get("use_bias", True)
+        act = _activation(cfg.get("activation"))
+        weights = {
+            "depthwise_kernel": (
+                (kh, kw, cin, mult),
+                _initializer(cfg.get("depthwise_initializer")
+                             or {"class_name": "GlorotUniform"}),
+            ),
+            "pointwise_kernel": (
+                (1, 1, cin * mult, filters),
+                _initializer(cfg.get("pointwise_initializer")
+                             or {"class_name": "GlorotUniform"}),
+            ),
+        }
+        if use_bias:
+            weights["bias"] = ((filters,), _initializer(cfg.get("bias_initializer")))
+        self._register(name, weights)
+
+        def fn(params: Params, x: jnp.ndarray, name=name, strides=strides,
+               padding=padding, dilation=dilation, cin=cin, mult=mult,
+               use_bias=use_bias, act=act):
+            p = params[name]
+            dk = p["depthwise_kernel"].astype(x.dtype)
+            dk = dk.reshape(dk.shape[0], dk.shape[1], 1, cin * mult)
+            y = jax.lax.conv_general_dilated(
+                x, dk, strides, padding, rhs_dilation=dilation,
+                feature_group_count=cin,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            y = jax.lax.conv_general_dilated(
+                y, p["pointwise_kernel"].astype(y.dtype), (1, 1), "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            if use_bias:
+                y = y + p["bias"].astype(y.dtype)
+            return act(y)
+
+        self.fns.append(fn)
+        ek_h = (kh - 1) * dilation[0] + 1
+        ek_w = (kw - 1) * dilation[1] + 1
+        oh = _conv_dim(h, ek_h, strides[0], padding)
+        ow = _conv_dim(w, ek_w, strides[1], padding)
+        self.shape = (oh, ow, filters)
 
     def _add_UpSampling2D(self, name: str, cfg: Dict[str, Any]) -> None:
         h, w, c = self._need_shape(name)

@@ -55,9 +55,12 @@ class TrainState:
     params: Params
     opt_state: Any
     step: jnp.ndarray  # int32 scalar — the 'version' of the reference, on device
+    # exponential moving average of params (None unless ema_decay is set);
+    # the eval/serving weights of choice for noisy small-batch training
+    ema: Any = None
 
     def tree_flatten(self):
-        return (self.params, self.opt_state, self.step), None
+        return (self.params, self.opt_state, self.step, self.ema), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -106,12 +109,20 @@ class SyncTrainer:
         max_checkpoints: Optional[int] = None,
         sharded_checkpoints: bool = False,
         zero_optimizer_sharding: bool = False,
+        ema_decay: Optional[float] = None,
     ):
         self.spec = spec
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.optimizer = _optimizer(optimizer, learning_rate)
         self.param_rules = param_rules
         self.grad_accum = grad_accum
+        # EMA of params, updated inside the jit step: e <- d*e + (1-d)*p.
+        # Initialized AT the initial params (no bias-correction debiasing);
+        # read via ema_params / evaluate(use_ema=True), checkpointed with
+        # the state when enabled.
+        if ema_decay is not None and not (0.0 < ema_decay < 1.0):
+            raise ValueError(f"ema_decay must be in (0, 1), got {ema_decay}")
+        self.ema_decay = ema_decay
         self.logger = VerboseLogger(f"SyncTrainer[{spec.name}]", verbose)
         self.callbacks = CallbackRegistry("new_version", "step")
         self.state: Optional[TrainState] = None
@@ -166,7 +177,9 @@ class SyncTrainer:
             )
             opt_state = jax.jit(self.optimizer.init, out_shardings=opt_sh)(params)
             step = jax.device_put(jnp.int32(0), NamedSharding(self.mesh, P()))
-            self.state = TrainState(params=params, opt_state=opt_state, step=step)
+            ema = jax.tree.map(jnp.copy, params) if self.ema_decay else None
+            self.state = TrainState(params=params, opt_state=opt_state,
+                                    step=step, ema=ema)
         return self.state
 
     @property
@@ -183,6 +196,7 @@ class SyncTrainer:
         spec = self.spec
         optimizer = self.optimizer
         accum = self.grad_accum
+        ema_decay = self.ema_decay
 
         def loss_fn(params: Params, x, y, w) -> jnp.ndarray:
             return spec.loss_fn(params, x, y, w)
@@ -219,7 +233,13 @@ class SyncTrainer:
                 loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y, w)
             updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
-            return TrainState(new_params, new_opt, state.step + 1), loss
+            new_ema = state.ema
+            if ema_decay is not None:
+                new_ema = jax.tree.map(
+                    lambda e, p: ema_decay * e + (1.0 - ema_decay) * p.astype(e.dtype),
+                    state.ema, new_params,
+                )
+            return TrainState(new_params, new_opt, state.step + 1, new_ema), loss
 
         self._one_step = one_step  # raw (unjitted) body, reused by step_many
         return jax.jit(one_step, donate_argnums=(0,) if donate else ())
@@ -372,6 +392,8 @@ class SyncTrainer:
             return None
         state_tree = {"params": self.state.params, "opt_state": self.state.opt_state,
                       "step": self.state.step}
+        if self.state.ema is not None:
+            state_tree["ema"] = self.state.ema
         if hasattr(self.store, "snapshot"):
             # sharded store: host copy of only the shards this process owns;
             # the writer thread then does pure file IO on the snapshot
@@ -424,15 +446,30 @@ class SyncTrainer:
             return False
         like = {"params": self.state.params, "opt_state": self.state.opt_state,
                 "step": self.state.step}
+        want_ema = self.state.ema is not None
+        if want_ema:
+            like["ema"] = self.state.ema
         # `like` is only read for tree structure and leaf shapes — device
         # arrays serve directly, no device->host copy of the current state
-        host = self.store.load(version, like)
+        try:
+            host = self.store.load(version, like)
+        except KeyError:
+            if not want_ema:
+                raise
+            # checkpoint predates EMA being enabled: load without it and
+            # seed the average from the restored params (init()'s semantics)
+            like.pop("ema")
+            host = self.store.load(version, like)
         placed = jax.tree.map(
             lambda v, cur: jax.device_put(v, cur.sharding),
             host,
             like,
         )
-        self.state = TrainState(placed["params"], placed["opt_state"], placed["step"])
+        ema = placed.get("ema")
+        if want_ema and ema is None:
+            ema = jax.tree.map(jnp.copy, placed["params"])
+        self.state = TrainState(placed["params"], placed["opt_state"],
+                                placed["step"], ema)
         return True
 
     def _ensure_writer(self) -> None:
@@ -527,20 +564,28 @@ class SyncTrainer:
 
     # -- evaluation -------------------------------------------------------
 
-    def evaluate(self, x: jnp.ndarray, y: jnp.ndarray, metrics: Tuple[str, ...] = ("loss", "accuracy")) -> List[float]:
+    def evaluate(self, x: jnp.ndarray, y: jnp.ndarray, metrics: Tuple[str, ...] = ("loss", "accuracy"), use_ema: bool = False) -> List[float]:
         if self.state is None:
             self.init()
         if self._eval_fn is None or getattr(self, "_eval_metrics", None) != metrics:
             self._eval_metrics = metrics
             fn = self.spec.metrics_fn(list(metrics))
             self._eval_fn = jax.jit(fn)
+        params = self.ema_params if use_ema else self.state.params
         batch = self._ensure_placed((x, y))
-        return [float(v) for v in self._eval_fn(self.state.params, *batch)]
+        return [float(v) for v in self._eval_fn(params, *batch)]
 
     def get_params(self) -> Params:
         if self.state is None:
             raise RuntimeError("trainer not initialized; call init() first")
         return self.state.params
+
+    @property
+    def ema_params(self) -> Params:
+        """The EMA weights (requires ``ema_decay``)."""
+        if self.state is None or self.state.ema is None:
+            raise RuntimeError("no EMA state; construct with ema_decay=")
+        return self.state.ema
 
     def set_params(self, params: Params) -> None:
         if self.state is None:
@@ -556,4 +601,7 @@ class SyncTrainer:
             zero_axis="data" if self._zero_opt else None,
         )
         opt_state = jax.jit(self.optimizer.init, out_shardings=opt_sh)(placed)
-        self.state = TrainState(placed, opt_state, self.state.step)
+        # EMA restarts at the newly-installed params (same as init): the old
+        # average describes weights that no longer exist
+        ema = jax.tree.map(jnp.copy, placed) if self.ema_decay else None
+        self.state = TrainState(placed, opt_state, self.state.step, ema)

@@ -1054,3 +1054,42 @@ def test_multi_output_softmax_kept_when_head_feeds_forward(tmp_path):
     np.testing.assert_allclose(np.asarray(o1).sum(-1), 1.0, rtol=1e-5)
     # ...and h2 consumed the probabilities (ones-kernel sums them -> 1.0)
     np.testing.assert_allclose(np.asarray(o2), 1.0, rtol=1e-5)
+
+
+def test_separable_conv2d_matches_manual_composition(tmp_path):
+    """SeparableConv2D == depthwise conv then 1x1 pointwise conv + bias,
+    numpy-verified against a scipy-free manual computation."""
+    topo = {"modelTopology": {"model_config": {"class_name": "Sequential",
+        "config": [{
+            "class_name": "SeparableConv2D",
+            "config": {
+                "name": "sep", "filters": 3, "kernel_size": [3, 3],
+                "strides": [1, 1], "padding": "valid", "use_bias": True,
+                "activation": "linear",
+                "batch_input_shape": [None, 6, 6, 2],
+                "depth_multiplier": 2,
+            },
+        }]}}}
+    path = _write_model(tmp_path, topo)
+    spec = spec_from_keras_json(path, loss="mean_squared_error")
+    assert spec.output_shape == (4, 4, 3)
+    params = spec.init(jax.random.PRNGKey(0))
+    assert params["sep"]["depthwise_kernel"].shape == (3, 3, 2, 2)
+    assert params["sep"]["pointwise_kernel"].shape == (1, 1, 4, 3)
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 6, 2).astype(np.float32)
+    got = np.asarray(spec.apply(params, jnp.asarray(x)))
+
+    dk = np.asarray(params["sep"]["depthwise_kernel"])  # [3,3,cin,mult]
+    pk = np.asarray(params["sep"]["pointwise_kernel"])[0, 0]  # [cin*mult, f]
+    b = np.asarray(params["sep"]["bias"])
+    # manual depthwise (channel-major output order: c*mult + m)
+    mid = np.zeros((2, 4, 4, 4), np.float32)
+    for c in range(2):
+        for m in range(2):
+            for i in range(4):
+                for j in range(4):
+                    patch = x[:, i:i + 3, j:j + 3, c]
+                    mid[:, i, j, c * 2 + m] = np.sum(patch * dk[:, :, c, m], axis=(1, 2))
+    want = mid @ pk + b
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
