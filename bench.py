@@ -135,7 +135,7 @@ def bench_mnist_sync(n_chips):
         return x, _one_hot(rng, k, B)
 
     r = _timed_chunked(trainer, make_chunk, steps=50 if FAST else 120,
-                       rounds=3 if FAST else 8, batch=B)
+                       rounds=3 if FAST else 20, batch=B)
     # sync-SGD allreduce step latency (BASELINE.md primary metric): the
     # device-side per-step time of the full fwd+bwd -> XLA-allreduced
     # grads -> update program (the scanned per-step time above). The
