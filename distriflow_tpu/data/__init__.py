@@ -6,7 +6,7 @@ from distriflow_tpu.data.dataset import (
     batch_to_data_msg,
     sample_batch,
 )
-from distriflow_tpu.data.prefetch import prefetch_to_device, sampling_iterator
+from distriflow_tpu.data.prefetch import prefetch_to_device, sampling_iterator, to_uint8_wire
 from distriflow_tpu.data.streaming import StreamingTokenDataset, write_token_file
 
 __all__ = [
@@ -16,6 +16,7 @@ __all__ = [
     "sample_batch",
     "prefetch_to_device",
     "sampling_iterator",
+    "to_uint8_wire",
     "StreamingTokenDataset",
     "write_token_file",
 ]

@@ -25,6 +25,11 @@ from distriflow_tpu.models.keras_import import (
     spec_from_keras_json,
 )
 from distriflow_tpu.models.mobilenet import MobileNetV2, mobilenet_v2
+from distriflow_tpu.models.transformer import (
+    TransformerConfig,
+    pipelined_transformer_lm,
+    transformer_lm,
+)
 from distriflow_tpu.models.zoo import MLP, ConvNet, cifar_convnet, mnist_convnet, mnist_mlp
 
 __all__ = [
@@ -51,6 +56,9 @@ __all__ = [
     "mnist_mlp",
     "beam_search",
     "generate",
+    "TransformerConfig",
+    "transformer_lm",
+    "pipelined_transformer_lm",
     "sequence_logprob",
     "export_keras_weights",
     "spec_from_keras_h5",

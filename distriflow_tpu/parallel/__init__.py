@@ -27,7 +27,9 @@ from distriflow_tpu.parallel.mesh import (
     shard_batch,
     shard_batch_padded,
 )
+from distriflow_tpu.parallel.pipeline import gpipe, gpipe_1f1b, gpipe_remat
 from distriflow_tpu.parallel.sharding import (
+    PIPELINED_TRANSFORMER_RULES,
     REPLICATED_RULES,
     TRANSFORMER_TP_RULES,
     describe_shardings,
@@ -37,6 +39,10 @@ from distriflow_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "PIPELINED_TRANSFORMER_RULES",
+    "gpipe",
+    "gpipe_1f1b",
+    "gpipe_remat",
     "all_gather",
     "allreduce_mean",
     "collective_latency_us",
