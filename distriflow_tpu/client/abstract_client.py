@@ -154,7 +154,11 @@ class AbstractClient:
         self.handle_training_complete()
 
     def set_params_from(self, msg: DownloadMsg) -> None:
-        """Deserialize and install weights (reference ``setVars`` in tidy, ``:160-164``)."""
+        """Deserialize and install weights (reference ``setVars`` in tidy, ``:160-164``).
+
+        Weights may arrive 16-bit (server ``weight_compression``);
+        ``deserialize_tree`` lands every leaf back on the local model's own
+        param dtype, so the model never silently becomes half precision."""
         template = self.model.get_params()
         self.model.set_params(deserialize_tree(msg.model.vars, template))
 
@@ -197,12 +201,9 @@ class AbstractClient:
             raise ValueError(
                 f"gradient_compression must be one of {COMPRESSION_DTYPES}, got {name!r}"
             )
-        import jax
+        from distriflow_tpu.utils.serialization import cast_tree
 
-        from distriflow_tpu.utils.serialization import _np_dtype
-
-        dt = _np_dtype(name)
-        return jax.tree.map(lambda g: np.asarray(g).astype(dt), grads)
+        return cast_tree(grads, name)
 
     def serialize_grads(self, grads: Any) -> Any:
         """Gradients -> {path: SerializedArray} for an UploadMsg, applying

@@ -127,11 +127,20 @@ class AbstractServer:
 
     def compute_download_msg(self) -> DownloadMsg:
         """Serialize current weights + version + pushed hyperparams
-        (reference ``abstract_server.ts:81-89``)."""
+        (reference ``abstract_server.ts:81-89``). With the
+        ``weight_compression`` server hyperparameter the weights go out
+        16-bit — half the bytes of every broadcast; clients restore their
+        model's own param dtype on install (AbstractClient.set_params_from)."""
+        params = self.model.get_params()
+        wc = self.hyperparams.weight_compression
+        if wc != "none":
+            from distriflow_tpu.utils.serialization import cast_tree
+
+            params = cast_tree(params, wc)
         return DownloadMsg(
             model=ModelMsg(
                 version=self.model.version,
-                vars=serialize_tree(self.model.get_params()),
+                vars=serialize_tree(params),
             ),
             hyperparams=asdict(self.client_hyperparams),
         )

@@ -63,6 +63,11 @@ def asdict(cfg: Any) -> Dict[str, Any]:
 # allowed gradient_compression values (shared with AbstractClient.compress_grads)
 COMPRESSION_DTYPES = ("none", "float16", "bfloat16", "int8")
 
+# allowed weight_compression values (server weight broadcasts): no int8 —
+# quantization error on WEIGHTS compounds every round, unlike gradients
+# where client-side error feedback absorbs it
+WEIGHT_COMPRESSION_DTYPES = ("none", "float16", "bfloat16")
+
 
 @dataclass
 class ClientHyperparams:
@@ -117,10 +122,21 @@ class ServerHyperparams:
     min_updates_per_version: int = 20
     maximum_staleness: int = 0
     staleness_decay: float = 1.0
+    # weight-broadcast compression: the dtype the server serializes params
+    # in for DownloadMsg. 16-bit halves every broadcast; clients restore
+    # their model's own param dtype on install. (int8 is deliberately NOT
+    # offered here: quantization error on weights compounds every round,
+    # unlike gradients where error feedback absorbs it.)
+    weight_compression: str = "none"
 
     def validate(self) -> "ServerHyperparams":
         if self.aggregation not in ("mean", "sum"):
             raise ValueError(f"aggregation must be 'mean' or 'sum', got {self.aggregation!r}")
+        if self.weight_compression not in WEIGHT_COMPRESSION_DTYPES:
+            raise ValueError(
+                f"weight_compression must be one of {WEIGHT_COMPRESSION_DTYPES}, "
+                f"got {self.weight_compression!r}"
+            )
         if self.min_updates_per_version <= 0:
             raise ValueError(
                 f"min_updates_per_version must be positive, got {self.min_updates_per_version}"

@@ -135,6 +135,22 @@ def quantize_array(x: Any) -> SerializedArray:
                            data=q.tobytes(), scale=scale)
 
 
+def cast_tree(tree: Any, dtype_name: str) -> Any:
+    """Cast every FLOAT leaf of a pytree to ``dtype_name`` (host arrays).
+
+    The one wire-compression cast (client gradient uploads, server weight
+    broadcasts): non-float leaves (int counters, bool masks) pass through
+    untouched — casting an int32 through float16 would silently round or
+    overflow to inf."""
+    dt = _np_dtype(dtype_name)
+
+    def cast(v):
+        arr = np.asarray(v)
+        return arr.astype(dt) if arr.dtype.kind == "f" else arr
+
+    return jax.tree.map(cast, tree)
+
+
 def serialize_tree(tree: Any) -> Dict[str, SerializedArray]:
     """Pytree of arrays -> {path: SerializedArray}, keyed not positional."""
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
@@ -162,7 +178,14 @@ def deserialize_tree(
             raise ValueError(
                 f"shape mismatch at {key!r}: serialized {s.shape} vs template {tuple(t_shape)}"
             )
-        leaves.append(deserialize_array(s))
+        arr = deserialize_array(s)
+        # land on the template leaf's dtype (like mean_serialized): a
+        # payload that arrived compressed (16-bit weight broadcast) or
+        # dtype-drifted must not silently change the consumer's precision
+        t_dtype = getattr(template, "dtype", None)
+        if t_dtype is not None and arr.dtype != t_dtype:
+            arr = arr.astype(t_dtype)
+        leaves.append(arr)
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 

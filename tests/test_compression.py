@@ -418,3 +418,64 @@ def test_quantize_survives_nonfinite_gradients():
     out2 = probe.serialize_grads(good)
     back2 = deserialize_array(out2[key])
     np.testing.assert_allclose(back2, [0.5, -0.5], atol=1.0 / 127 + 1e-6)
+
+
+def test_weight_compression_halves_download_and_preserves_dtype(tmp_path):
+    """Server weight_compression=float16: broadcast weights go out 16-bit
+    (half the bytes), the client restores its own float32 param dtype on
+    install, and values match to half precision."""
+    from distriflow_tpu.client import FederatedClient
+    from distriflow_tpu.client.abstract_client import DistributedClientConfig
+    from distriflow_tpu.server import FederatedServer
+    from distriflow_tpu.server.abstract_server import DistributedServerConfig
+    from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+    import jax
+
+    server = FederatedServer(
+        DistributedServerInMemoryModel(SpecModel(mnist_mlp(hidden=4))),
+        DistributedServerConfig(
+            save_dir=str(tmp_path),
+            server_hyperparams={"min_updates_per_version": 1,
+                                "weight_compression": "float16"},
+        ),
+    )
+    server.setup()
+    try:
+        assert all(s.dtype == "float16"
+                   for s in server.download_msg.model.vars.values())
+        full_bytes = sum(
+            np.asarray(l).nbytes
+            for l in jax.tree.leaves(server.model.get_params()))
+        wire_bytes = sum(s.nbytes for s in server.download_msg.model.vars.values())
+        assert wire_bytes == full_bytes // 2
+
+        client = FederatedClient(
+            server.address, SpecModel(mnist_mlp(hidden=4)),
+            DistributedClientConfig(hyperparams={"examples_per_update": 4}),
+        )
+        client.setup()
+        try:
+            got = jax.tree.leaves(client.model.get_params())
+            want = jax.tree.leaves(server.model.get_params())
+            for g, w in zip(got, want):
+                assert np.asarray(g).dtype == np.float32  # dtype restored
+                np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                           rtol=2e-3, atol=1e-4)
+            # training over the compressed broadcast still works
+            rng = np.random.RandomState(0)
+            x = rng.rand(4, 28, 28, 1).astype(np.float32)
+            y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4)]
+            assert client.distributed_update(x, y) == 1
+        finally:
+            client.dispose()
+    finally:
+        server.stop()
+
+
+def test_weight_compression_validation():
+    from distriflow_tpu.utils.config import server_hyperparams
+
+    assert server_hyperparams({"weight_compression": "bfloat16"})
+    with pytest.raises(ValueError, match="weight_compression"):
+        server_hyperparams({"weight_compression": "int8"})
