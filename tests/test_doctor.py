@@ -1,0 +1,23 @@
+"""The doctor CLI: every mandatory check passes in a healthy env, and a
+broken env is reported with a non-zero exit instead of a crash."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_doctor_passes_on_cpu():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "distriflow_tpu.doctor"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "all checks passed" in out.stdout
+    for name in ("backend/devices", "mesh construction", "allreduce",
+                 "train step", "wire transport", "checkpoint store"):
+        assert f"ok   {name}" in out.stdout, (name, out.stdout)
