@@ -103,6 +103,7 @@ def _build_fns(
     temperature: float,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
 ):
     """Jit-compiled prefill + decode scan, cached so repeated generate()
     calls with the same config/shape hit the jit cache instead of paying
@@ -125,15 +126,22 @@ def _build_fns(
     @jax.jit
     def decode_steps(params, cache, first_tok, rng):
         def step(carry, key):
-            cache, tok = carry
+            cache, tok, done = carry
             logits, vars_ = module.apply(
                 {**params, "cache": cache}, tok[:, None], mutable=["cache"]
             )
             nxt = pick(logits[:, -1], key).astype(jnp.int32)
-            return (vars_["cache"], nxt), nxt
+            if eos_id is not None:
+                # finished rows keep emitting eos (static shapes: the scan
+                # still runs n_tokens ticks; the output is frozen)
+                nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                done = done | (nxt == eos_id)
+            return (vars_["cache"], nxt, done), nxt
 
+        done0 = (first_tok == eos_id) if eos_id is not None else jnp.zeros(
+            first_tok.shape, bool)
         keys = jax.random.split(rng, n_tokens - 1)
-        (_, _), toks = jax.lax.scan(step, (cache, first_tok), keys)
+        (_, _, _), toks = jax.lax.scan(step, (cache, first_tok, done0), keys)
         return toks.T  # [B, n_tokens - 1]
 
     return prefill, pick, decode_steps
@@ -346,6 +354,7 @@ def generate(
     rng: Optional[jax.Array] = None,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
 ) -> jnp.ndarray:
     """Generate ``n_tokens`` continuations of ``prompt`` ``[B, P] int32``.
 
@@ -354,7 +363,10 @@ def generate(
     (``rng`` required), optionally restricted to the ``top_k`` highest
     logits and/or the ``top_p`` nucleus (smallest set of tokens whose
     probability mass reaches ``top_p``; both given = k first, then p over
-    the top-k-renormalized distribution).
+    the top-k-renormalized distribution). With ``eos_id``, a row that emits
+    the end token keeps emitting it — the output stays ``[B, P+n_tokens]``
+    (static shapes), finished rows are simply frozen, same as
+    ``beam_search``'s EOS handling.
     """
     b, p = prompt.shape
     if n_tokens <= 0:
@@ -366,10 +378,12 @@ def generate(
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if eos_id is not None and not 0 <= eos_id < config.vocab_size:
+        raise ValueError(f"eos_id {eos_id} outside vocab [0, {config.vocab_size})")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     prefill, pick, decode_steps = _build_fns(
-        config, n_tokens, temperature, top_k, top_p
+        config, n_tokens, temperature, top_k, top_p, eos_id
     )
 
     last_logits, cache = prefill(params, prompt)

@@ -24,9 +24,16 @@ import sys
 import jax
 import numpy as np
 
-from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+from distriflow_tpu.models.transformer import (
+    TransformerConfig,
+    pipelined_transformer_lm,
+    transformer_lm,
+)
 from distriflow_tpu.parallel import create_mesh, data_parallel_mesh
-from distriflow_tpu.parallel.sharding import TRANSFORMER_TP_RULES
+from distriflow_tpu.parallel.sharding import (
+    PIPELINED_TRANSFORMER_RULES,
+    TRANSFORMER_TP_RULES,
+)
 from distriflow_tpu.train.sync import SyncTrainer
 from distriflow_tpu.train.loop import run_chunked
 from distriflow_tpu.utils.config import MeshConfig
@@ -56,6 +63,9 @@ def main(argv=None) -> float:
     p.add_argument("--dtype", choices=("bfloat16", "float32"), default="bfloat16")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks in backward (long-context memory)")
+    p.add_argument("--pipeline-schedule", choices=("gpipe", "remat", "1f1b"),
+                   default=None,
+                   help="PP backward schedule (mesh must include pipe=N>1)")
     p.add_argument("--mesh", default="", help="e.g. data=2,model=2,seq=2")
     p.add_argument("--learning-rate", type=float, default=3e-3)
     p.add_argument("--steps-per-dispatch", type=int, default=1,
@@ -110,11 +120,29 @@ def main(argv=None) -> float:
         use_ring_attention=args.attention == "ring",
         use_ulysses_attention=args.attention == "ulysses",
         remat=args.remat,
+        pipeline_schedule=args.pipeline_schedule,
     )
-    spec = transformer_lm(cfg, mesh=mesh, example_seq=args.seq)
+    # a pipe axis in --mesh selects the GPipe-staged model (DP x PP x TP);
+    # --pipeline-schedule then picks the backward schedule
+    pipelined = mesh.shape.get("pipe", 1) > 1
+    if pipelined:
+        if args.generate or args.serve:
+            # fail BEFORE training: decode/serving consume transformer_lm's
+            # flat param tree, not the stage-stacked pipelined layout
+            raise SystemExit(
+                "--generate/--serve do not support the pipelined layout "
+                "(pipe=N in --mesh); train pipelined, or drop the pipe axis "
+                "for a decode-capable run"
+            )
+        spec = pipelined_transformer_lm(cfg, mesh=mesh, example_seq=args.seq)
+    else:
+        if args.pipeline_schedule:
+            raise SystemExit("--pipeline-schedule needs pipe=N>1 in --mesh")
+        spec = transformer_lm(cfg, mesh=mesh, example_seq=args.seq)
     trainer = SyncTrainer(
         spec, mesh=mesh, learning_rate=args.learning_rate, optimizer="adam",
-        param_rules=TRANSFORMER_TP_RULES, verbose=True,
+        param_rules=PIPELINED_TRANSFORMER_RULES if pipelined else TRANSFORMER_TP_RULES,
+        verbose=True,
         checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
     )
     trainer.init(jax.random.PRNGKey(args.seed))

@@ -429,3 +429,24 @@ def test_moe_generate_runs_greedy():
     assert out.shape == (1, 9)
     out2 = generate(cfg, params, prompt, n_tokens=5)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_generate_eos_freezes_rows():
+    """eos_id: once a row emits the end token it keeps emitting it; rows
+    that never hit EOS are unchanged vs a run without eos_id."""
+    params = _params(CFG)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    base = np.asarray(generate(CFG, params, prompt, n_tokens=6))
+    gen = base[0, 4:]
+    # freeze on the token the model actually emits second: everything
+    # after its first occurrence must be that token
+    e = int(gen[1])
+    out = np.asarray(generate(CFG, params, prompt, n_tokens=6, eos_id=e))[0, 4:]
+    first = int(np.argmax(out == e))
+    assert np.all(out[first:] == e), out
+    # an eos the model never emits changes nothing
+    unused = next(t for t in range(CFG.vocab_size) if t not in set(gen.tolist()))
+    same = np.asarray(generate(CFG, params, prompt, n_tokens=6, eos_id=unused))
+    np.testing.assert_array_equal(same, base)
+    with pytest.raises(ValueError, match="eos_id"):
+        generate(CFG, params, prompt, n_tokens=3, eos_id=CFG.vocab_size)
