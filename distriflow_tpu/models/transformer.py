@@ -47,6 +47,12 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq: int = 2048
     n_experts: int = 0  # 0 = dense FFN; >0 = MoE with EP-shardable experts
+    # experts per token: 1 = Switch (combine scaled by the raw chosen
+    # prob), 2 = GShard top-2 (pair-normalized weights; first choices
+    # claim capacity before any second choice). Capacity scales with
+    # moe_top_k (GShard's k * factor * tokens / E), so capacity_factor
+    # keeps its per-choice meaning.
+    moe_top_k: int = 1
     capacity_factor: float = 1.25  # expert buffer = factor * group / E
     router_aux_weight: float = 0.01  # Switch load-balance loss weight
     moe_group_size: int = 1024  # routing-group tokens (bounds dispatch size)
@@ -81,6 +87,11 @@ class TransformerConfig:
     loss: str = "sparse_softmax_cross_entropy"
 
     def __post_init__(self):
+        if self.n_experts > 0 and not 1 <= self.moe_top_k <= self.n_experts:
+            raise ValueError(
+                f"moe_top_k must be in [1, n_experts={self.n_experts}], "
+                f"got {self.moe_top_k}"
+            )
         if self.use_ring_attention and self.use_ulysses_attention:
             raise ValueError(
                 "use_ring_attention and use_ulysses_attention are mutually "
@@ -261,9 +272,14 @@ class DenseFFN(nn.Module):
 
 
 class MoEFFN(nn.Module):
-    """Switch-style top-1 MoE with capacity-based dispatch.
+    """Capacity-dispatched MoE: Switch top-1 (default) or GShard top-2.
 
-    Each token routes to its argmax expert; each expert processes at most
+    ``moe_top_k=1``: each token routes to its argmax expert, combine scaled
+    by the raw chosen prob (Switch). ``moe_top_k=2``: each token routes to
+    its two highest-prob experts with pair-normalized combine weights
+    (GShard); capacity scales with k, and every token's FIRST choice claims
+    its slot before any second choice competes.
+    Each token routes to its chosen expert(s); each expert processes at most
     ``capacity = capacity_factor * tokens / E`` tokens (overflow tokens pass
     through the residual unchanged — standard Switch semantics). Dispatch
     and combine are one-hot einsum contractions, the Mesh-TensorFlow
@@ -301,16 +317,20 @@ class MoEFFN(nn.Module):
         gates = nn.Dense(e, name="router", dtype=jnp.float32)(x.astype(jnp.float32))
         probs = jax.nn.softmax(gates, axis=-1)  # [B, S, E] f32
 
+        k = cfg.moe_top_k
         if cfg.moe_dense_dispatch:
-            # exact all-experts path: every token's true top-1 expert,
-            # combined with the chosen router prob — the SAME gate scaling
-            # as the capacity path below, so dense dispatch is exactly its
-            # no-drop limit (capacity output == dense output wherever no
-            # token overflowed; the decode path relies on this). Router
-            # gradients flow through the prob factor, as in the capacity
-            # path's combine tensor.
-            top = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype)
-            dispatch = top * probs  # [B, S, E]: p_argmax on the chosen expert
+            # exact all-experts path: every token's true top-k experts,
+            # combined with the SAME gate weights as the capacity path
+            # below (k=1: raw chosen prob, Switch; k>=2: top-k-normalized,
+            # GShard), so dense dispatch is exactly its no-drop limit
+            # (capacity output == dense output wherever no token
+            # overflowed; the decode path relies on this). Router
+            # gradients flow through the prob factors.
+            topv, topi = jax.lax.top_k(probs, k)  # [B, S, K]
+            w = topv if k == 1 else topv / jnp.sum(topv, -1, keepdims=True)
+            dispatch = jnp.sum(
+                jax.nn.one_hot(topi, e, dtype=probs.dtype) * w[..., None], axis=-2
+            )  # [B, S, E]: gate weight on each chosen expert
             h = jnp.einsum("bsd,edf->bsef", x, wi)
             h = nn.gelu(h)
             out = jnp.einsum("bsef,efd->bsed", h, wo)
@@ -324,32 +344,42 @@ class MoEFFN(nn.Module):
         # global group would make them quadratic)
         g = _auto_block(n_tok, cfg.moe_group_size)
         n_grp = n_tok // g
-        capacity = max(1, int(cfg.capacity_factor * g / e))
+        capacity = max(1, int(cfg.capacity_factor * cfg.moe_top_k * g / e))
         grp_x = x.reshape(n_grp, g, d)
         grp_probs = probs.reshape(n_grp, g, e)
-        onehot = jax.nn.one_hot(jnp.argmax(grp_probs, -1), e,
-                                dtype=jnp.float32)  # [G, g, E]
-        gate = jnp.sum(grp_probs * onehot, axis=-1)  # [G, g] chosen prob
-        # Switch load-balancing aux: f_e = fraction routed to e, P_e = mean
-        # router prob; minimized (== 1) at uniform load
-        f_frac = jnp.mean(onehot, axis=(0, 1))
+        # top-k choices per token; k=1 reduces exactly to Switch argmax
+        topv, topi = jax.lax.top_k(grp_probs, k)  # [G, g, K]
+        onehot = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # [G, g, K, E]
+        gate = topv if k == 1 else topv / jnp.sum(topv, -1, keepdims=True)
+        # load-balancing aux on the FIRST choice (Switch/GShard convention)
+        f_frac = jnp.mean(onehot[:, :, 0, :], axis=(0, 1))
         p_mean = jnp.mean(grp_probs, axis=(0, 1))
         self.sow("aux", "load_balance", e * jnp.sum(f_frac * p_mean))
-        # position of each token within its expert's capacity buffer; both
-        # pos=0 (not routed here) and pos>capacity (overflow) land outside
-        # [0, C) and one_hot yields all-zero rows — no extra mask needed
-        pos = jnp.cumsum(onehot, axis=1) * onehot  # [G, g, E], 1-based
+        # position of each (token, choice) pair within its expert's buffer.
+        # Pairs flatten CHOICE-MAJOR (all first choices, then all second
+        # choices): GShard fills every token's primary expert before any
+        # secondary claims a slot, so an early token's 2nd choice can
+        # never evict a later token's 1st. pos=0 (not routed) and
+        # pos>capacity (overflow) land outside [0, C) and one_hot yields
+        # all-zero rows — no extra mask needed.
+        oh_flat = onehot.transpose(0, 2, 1, 3).reshape(n_grp, k * g, e)
+        pos = jnp.cumsum(oh_flat, axis=1) * oh_flat  # [G, K*g, E], 1-based
         dispatch = jax.nn.one_hot(pos.astype(jnp.int32) - 1, capacity,
-                                  dtype=jnp.float32)  # [G, g, E, C] 0/1
-        combine = dispatch * gate[..., None, None]  # router grad flows here
+                                  dtype=jnp.float32)  # [G, K*g, E, C] 0/1
+        gate_flat = gate.transpose(0, 2, 1).reshape(n_grp, k * g)
+        combine = dispatch * gate_flat[..., None, None]
+        # tokens tiled choice-major to match: [all tokens (choice 0), ...]
+        x_rep = grp_x if k == 1 else jnp.tile(grp_x, (1, k, 1))
         expert_in = jnp.einsum(
-            "xtec,xtd->xecd", dispatch.astype(cfg.dtype), grp_x
+            "xtec,xtd->xecd", dispatch.astype(cfg.dtype), x_rep
         )  # [G, E, C, d] — the expert all-to-all under GSPMD
         h = nn.gelu(jnp.einsum("xecd,edf->xecf", expert_in, wi))
         expert_out = jnp.einsum("xecf,efd->xecd", h, wo)
         out = jnp.einsum(
             "xtec,xecd->xtd", combine.astype(cfg.dtype), expert_out
-        )  # overflow tokens get zeros: they ride the residual connection
+        )  # overflow pairs get zeros: they ride the residual connection
+        if k > 1:
+            out = out.reshape(n_grp, k, g, d).sum(axis=1)
         return out.reshape(b, s, d)
 
 
