@@ -69,6 +69,9 @@ _ACTIVATIONS: Dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
     "selu": jax.nn.selu,
     "softplus": jax.nn.softplus,
     "gelu": jax.nn.gelu,
+    "swish": jax.nn.silu,  # tf.keras swish == silu (x * sigmoid(x))
+    "silu": jax.nn.silu,
+    "exponential": jnp.exp,
 }
 
 _DTYPES = {"float32": np.float32, "int32": np.int32, "bool": np.bool_, "uint8": np.uint8}
@@ -223,6 +226,7 @@ class _Builder:
             raise ValueError(
                 f"unsupported layer {class_name!r}; supported: Conv1D/2D, "
                 "DepthwiseConv2D, SeparableConv2D, Conv2DTranspose, UpSampling2D, Dense, "
+                "LeakyReLU, PReLU, ELU, Softmax, "
                 "Embedding, SimpleRNN, LSTM, GRU, Bidirectional, Activation, "
                 "ReLU, Max/AveragePooling1D/2D, GlobalAverage/MaxPooling1D/2D, "
                 "Flatten, Reshape, ZeroPadding2D, Dropout, SpatialDropout1D, "
@@ -877,6 +881,40 @@ class _Builder:
 
         self.fns.append(fn)
 
+    def _add_LeakyReLU(self, name: str, cfg: Dict[str, Any]) -> None:
+        alpha = float(cfg.get("alpha", 0.3))  # Keras default
+        self.fns.append(
+            lambda params, x, a=alpha: jax.nn.leaky_relu(x, negative_slope=a))
+
+    def _add_ELU(self, name: str, cfg: Dict[str, Any]) -> None:
+        alpha = float(cfg.get("alpha", 1.0))
+        self.fns.append(lambda params, x, a=alpha: jax.nn.elu(x, alpha=a))
+
+    def _add_Softmax(self, name: str, cfg: Dict[str, Any]) -> None:
+        axis = cfg.get("axis", -1)
+        axis = axis[0] if isinstance(axis, (list, tuple)) and len(axis) == 1 else axis
+        self.fns.append(lambda params, x, ax=axis: jax.nn.softmax(x, axis=ax))
+
+    def _add_PReLU(self, name: str, cfg: Dict[str, Any]) -> None:
+        """Learnable leaky slope: alpha has one entry per feature, with
+        ``shared_axes`` (1-based, batch excluded) collapsed to 1."""
+        shape = self._need_shape(name)
+        shared = cfg.get("shared_axes") or ()
+        alpha_shape = tuple(
+            1 if (i + 1) in shared else d for i, d in enumerate(shape)
+        )
+        self._register(name, {
+            "alpha": (alpha_shape,
+                      _initializer(cfg.get("alpha_initializer")
+                                   or {"class_name": "Zeros"})),
+        })
+
+        def fn(params: Params, x: jnp.ndarray, name=name):
+            a = params[name]["alpha"].astype(x.dtype)
+            return jnp.where(x >= 0, x, a * x)
+
+        self.fns.append(fn)
+
     def _add_ZeroPadding2D(self, name: str, cfg: Dict[str, Any]) -> None:
         h, w, c = self._need_shape(name)
         pad = cfg.get("padding", 1)
@@ -1232,7 +1270,8 @@ def _build_graph(
 
 
 def _strip_graph_softmax(
-    layers: List[Dict[str, Any]], steps: List[GraphStep], out_key: str
+    layers: List[Dict[str, Any]], steps: List[GraphStep], out_key: str,
+    out_shape: Optional[Tuple[int, ...]] = None,
 ) -> bool:
     """Graph-mode analog of :func:`_strip_trailing_softmax`: rewrite the
     output node's fn if it ends in softmax. Returns True if stripped.
@@ -1243,6 +1282,11 @@ def _strip_graph_softmax(
     idx = next(i for i, (n, _, _) in enumerate(steps) if n == out_key)
     key, parents, _ = steps[idx]
     if layer["class_name"] == "Activation" and cfg.get("activation") == "softmax":
+        steps[idx] = (key, parents, lambda params, xs: xs[0])
+        return True
+    if layer["class_name"] == "Softmax" and _is_last_axis(
+        cfg.get("axis", -1), out_shape
+    ):
         steps[idx] = (key, parents, lambda params, xs: xs[0])
         return True
     if layer["class_name"] == "Dense" and cfg.get("activation") == "softmax":
@@ -1464,7 +1508,8 @@ def _spec_from_topology(
         fns = list(builder.fns)
         stripped = False
         if logits_output and fns:
-            stripped = _strip_trailing_softmax(layers, fns, builder.names)
+            stripped = _strip_trailing_softmax(layers, fns, builder.names,
+                                               out_shape)
         multi_in = False
         float_mask: List[bool] = []
 
@@ -1485,8 +1530,8 @@ def _spec_from_topology(
             # in place would feed raw logits to the downstream layer
             consumed = {p for _, parents, _ in steps for p in parents}
             stripped = any([
-                _strip_graph_softmax(config["layers"], steps, k)
-                for k in out_keys
+                _strip_graph_softmax(config["layers"], steps, k, shp)
+                for k, shp in zip(out_keys, out_shapes)
                 if k not in consumed
             ])
         multi_in = len(in_keys) > 1
@@ -1615,14 +1660,32 @@ def _input_shape_from(layers: List[Dict[str, Any]]) -> Tuple[int, ...]:
     raise ValueError("no batch_input_shape found; pass input_shape=")
 
 
+def _is_last_axis(axis: Any, feature_shape: Optional[Tuple[int, ...]]) -> bool:
+    """Does a Keras Softmax-layer ``axis`` denote the LAST tensor axis?
+
+    -1 always does; a positive index equals the last axis when it is
+    len(feature_shape) (+1 for the batch dim the feature shape omits)."""
+    if isinstance(axis, (list, tuple)):
+        if len(axis) != 1:
+            return False
+        axis = axis[0]
+    if axis == -1:
+        return True
+    return feature_shape is not None and axis == len(feature_shape)
+
+
 def _strip_trailing_softmax(
-    layers: List[Dict[str, Any]], fns: List[LayerFn], names: List[str]
+    layers: List[Dict[str, Any]], fns: List[LayerFn], names: List[str],
+    out_shape: Optional[Tuple[int, ...]] = None,
 ) -> bool:
     """If the network ends in softmax, replace that final activation with
     identity (in-place on ``fns``). Returns True if stripped."""
     last = layers[-1]
     cfg = last.get("config", {})
     if last["class_name"] == "Activation" and cfg.get("activation") == "softmax":
+        fns[-1] = lambda params, x: x
+        return True
+    if last["class_name"] == "Softmax" and _is_last_axis(cfg.get("axis", -1), out_shape):
         fns[-1] = lambda params, x: x
         return True
     if last["class_name"] == "Dense" and cfg.get("activation") == "softmax":

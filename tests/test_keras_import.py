@@ -1093,3 +1093,102 @@ def test_separable_conv2d_matches_manual_composition(tmp_path):
                     mid[:, i, j, c * 2 + m] = np.sum(patch * dk[:, :, c, m], axis=(1, 2))
     want = mid @ pk + b
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_advanced_activation_layers(tmp_path):
+    """LeakyReLU / ELU / Softmax / PReLU layer classes, numpy-verified
+    (PReLU with a loaded per-channel alpha and shared spatial axes)."""
+    layers = [
+        _dense_cfg("d1", 4, activation="linear", batch_input=[None, 3]),
+        {"class_name": "LeakyReLU", "config": {"name": "lr", "alpha": 0.2}},
+        {"class_name": "ELU", "config": {"name": "el", "alpha": 0.5}},
+        {"class_name": "Softmax", "config": {"name": "sm", "axis": -1}},
+    ]
+    path = _write_model(tmp_path, {"modelTopology": {"model_config": {
+        "class_name": "Sequential", "config": layers}}})
+    spec = spec_from_keras_json(path, logits_output=False)
+    params = spec.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    x = rng.randn(5, 3).astype(np.float32)
+    got = np.asarray(spec.apply(params, jnp.asarray(x)))
+    h = x @ np.asarray(params["d1"]["kernel"]) + np.asarray(params["d1"]["bias"])
+    h = np.where(h >= 0, h, 0.2 * h)
+    h = np.where(h >= 0, h, 0.5 * np.expm1(h))
+    want = np.exp(h) / np.exp(h).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # trailing Softmax LAYER strips under logits_output (the default):
+    # the result is the pre-softmax activations, not a simplex
+    logits_spec = spec_from_keras_json(path)
+    got_logits = np.asarray(logits_spec.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(got_logits, h, rtol=1e-5, atol=1e-6)
+    assert not np.allclose(got_logits.sum(-1), 1.0)
+
+    # PReLU: per-channel alpha loaded from shards, spatial axes shared
+    alpha = np.asarray([[0.1, 0.2]], np.float32).reshape(1, 2)  # (1, C)
+    players = [
+        {"class_name": "Conv2D", "config": {
+            "name": "c", "filters": 2, "kernel_size": [1, 1], "padding": "same",
+            "use_bias": False, "activation": "linear",
+            "batch_input_shape": [None, 2, 2, 2],
+            "kernel_initializer": {"class_name": "Ones", "config": {}}}},
+        {"class_name": "PReLU", "config": {"name": "pr", "shared_axes": [1, 2]}},
+    ]
+    pdir = tmp_path / "p"
+    pdir.mkdir()
+    ppath = _write_model(
+        pdir,
+        {"modelTopology": {"model_config": {"class_name": "Sequential",
+                                            "config": players}}},
+        weights=[("c/kernel", np.eye(2, dtype=np.float32).reshape(1, 1, 2, 2)),
+                 ("pr/alpha", alpha.reshape(1, 1, 2))],
+    )
+    pspec = spec_from_keras_json(ppath, loss="mean_squared_error")
+    pparams = pspec.init(jax.random.PRNGKey(0))
+    assert pparams["pr"]["alpha"].shape == (1, 1, 2)
+    xi = np.array([[[[1.0, -1.0], [-2.0, 2.0]], [[3.0, -3.0], [-4.0, 4.0]]]],
+                  np.float32)
+    out = np.asarray(pspec.apply(pparams, jnp.asarray(xi)))
+    want = np.where(xi >= 0, xi, xi * alpha.reshape(1, 1, 1, 2))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_softmax_layer_strip_positive_axis_and_graph_mode(tmp_path):
+    """A trailing Softmax LAYER strips whether the axis is written -1 or as
+    the positive last-axis index, and in Functional graphs too."""
+    layers = [
+        _dense_cfg("d1", 4, activation="linear", batch_input=[None, 3]),
+        {"class_name": "Softmax", "config": {"name": "sm", "axis": 1}},  # == -1
+    ]
+    path = _write_model(tmp_path, {"modelTopology": {"model_config": {
+        "class_name": "Sequential", "config": layers}}})
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    out = np.asarray(spec.apply(params, jnp.ones((2, 3))))
+    assert not np.allclose(out.sum(-1), 1.0)  # stripped: logits
+    assert ":logits" in spec.name
+
+    glayers = [
+        {"name": "in_a", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 3], "name": "in_a"},
+         "inbound_nodes": []},
+        {"name": "d", "class_name": "Dense",
+         "config": {"name": "d", "units": 4, "activation": "linear",
+                    "use_bias": False,
+                    "kernel_initializer": {"class_name": "Ones", "config": {}}},
+         "inbound_nodes": [[["in_a", 0, 0, {}]]]},
+        {"name": "sm", "class_name": "Softmax",
+         "config": {"name": "sm", "axis": -1},
+         "inbound_nodes": [[["d", 0, 0, {}]]]},
+    ]
+    gpath_dir = tmp_path / "g"
+    gpath_dir.mkdir()
+    gpath = _write_model(gpath_dir, {"modelTopology": {"model_config": {
+        "class_name": "Model", "config": {
+            "name": "gsm", "layers": glayers,
+            "input_layers": [["in_a", 0, 0]],
+            "output_layers": [["sm", 0, 0]],
+        }}}})
+    gspec = spec_from_keras_json(gpath)
+    gparams = gspec.init(jax.random.PRNGKey(0))
+    gout = np.asarray(gspec.apply(gparams, jnp.ones((2, 3))))
+    np.testing.assert_allclose(gout, 3.0, rtol=1e-6)  # ones kernel: raw logits
