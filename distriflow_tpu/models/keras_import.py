@@ -882,7 +882,8 @@ class _Builder:
         self.fns.append(fn)
 
     def _add_LeakyReLU(self, name: str, cfg: Dict[str, Any]) -> None:
-        alpha = float(cfg.get("alpha", 0.3))  # Keras default
+        # Keras 2/tfjs serialize 'alpha'; Keras 3 'negative_slope'
+        alpha = float(cfg.get("alpha", cfg.get("negative_slope", 0.3)))
         self.fns.append(
             lambda params, x, a=alpha: jax.nn.leaky_relu(x, negative_slope=a))
 
