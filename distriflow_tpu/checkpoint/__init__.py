@@ -47,4 +47,15 @@ def load_model(save_dir: str, spec: Any = None, version: Optional[str] = None, *
     return model
 
 
-__all__ = ["CheckpointStore", "ShardedCheckpointStore", "save_model", "load_model"]
+def make_store(checkpoint_dir, max_checkpoints=None, sharded=False):
+    """The one trainer-side store constructor: None dir -> no store;
+    ``sharded`` selects the multi-host per-shard store."""
+    if checkpoint_dir is None:
+        return None
+    if sharded:
+        return ShardedCheckpointStore(checkpoint_dir, max_checkpoints)
+    return CheckpointStore(checkpoint_dir, max_checkpoints)
+
+
+__all__ = ["CheckpointStore", "ShardedCheckpointStore", "save_model",
+           "load_model", "make_store"]

@@ -53,9 +53,20 @@ class AsyncSGDTrainer:
         optimizer: str = "sgd",
         hyperparams: Optional[Dict[str, Any] | ServerHyperparams] = None,
         verbose: Optional[bool] = None,
+        checkpoint_dir: Optional[str] = None,
+        save_every: int = 0,  # applied updates between auto-saves
+        max_checkpoints: Optional[int] = None,
     ):
         self.spec = spec
         self.dataset = dataset
+        # checkpoint/resume: params + optimizer state + version. Snapshots
+        # capture the (immutable, only ever rebound) array refs under the
+        # apply lock; the device->host gather and file write run OUTSIDE it
+        # so workers never stall on disk.
+        from distriflow_tpu.checkpoint import make_store
+
+        self.save_every = save_every
+        self.store = make_store(checkpoint_dir, max_checkpoints)
         self.devices = list(devices if devices is not None else jax.devices())
         if isinstance(hyperparams, ServerHyperparams):
             # a ready-made dataclass is fully explicit — honor it verbatim
@@ -102,6 +113,43 @@ class AsyncSGDTrainer:
         with self._lock:
             return self.params, self.version
 
+    def _write_checkpoint(self, params, opt_state, version: int) -> str:
+        """Gather + write a captured snapshot (call WITHOUT the lock)."""
+        return self.store.save(
+            {"params": jax.device_get(params),
+             "opt_state": jax.device_get(opt_state),
+             "version": jnp.int32(version)},
+            version=str(version),
+        )
+
+    def save(self) -> str:
+        """Checkpoint params + optimizer state + version (synchronous)."""
+        if self.store is None:
+            raise RuntimeError("no checkpoint_dir configured")
+        if self.params is None:
+            raise RuntimeError("trainer not initialized")
+        with self._lock:  # capture consistent refs only; write outside
+            snap = (self.params, self._opt_state, self.version)
+        return self._write_checkpoint(*snap)
+
+    def restore(self, version: Optional[str] = None) -> bool:
+        """Resume from the latest (or named) version. False when empty."""
+        if self.store is None:
+            raise RuntimeError("no checkpoint_dir configured")
+        if self.params is None:
+            self.init()
+        version = version or self.store.last()
+        if version is None:
+            return False
+        with self._lock:
+            like = {"params": self.params, "opt_state": self._opt_state,
+                    "version": jnp.int32(0)}
+            host = self.store.load(version, like)
+            self.params = jax.device_put(host["params"], self.devices[0])
+            self._opt_state = jax.device_put(host["opt_state"], self.devices[0])
+            self.version = int(host["version"])
+        return True
+
     def submit(self, grads: Params, grad_version: int, client_id: str = "?") -> bool:
         """Apply one gradient update; returns False if rejected as too stale.
 
@@ -128,6 +176,18 @@ class AsyncSGDTrainer:
             )
             self.version += 1
             self.applied_updates += 1
+            snap = None
+            if (self.store is not None and self.save_every
+                    and self.version % self.save_every == 0):
+                snap = (self.params, self._opt_state, self.version)
+        if snap is not None:
+            try:
+                self._write_checkpoint(*snap)
+            except Exception as e:
+                # the update IS applied: a persistence failure here must not
+                # bubble into worker_loop's requeue (that would double-apply
+                # the batch). Log; the next save boundary retries.
+                self.logger.log(f"auto-checkpoint failed: {e!r}")
         self.callbacks.fire("upload", client_id, grad_version)
         self.callbacks.fire("new_version", str(self.version))
         return True

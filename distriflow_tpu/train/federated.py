@@ -50,12 +50,22 @@ class FederatedAveragingTrainer:
         learning_rate: Optional[float] = None,  # None -> 0.01 (FedAvg-typical)
         optimizer: str = "sgd",
         verbose: Optional[bool] = None,
+        checkpoint_dir: Optional[str] = None,
+        save_every: int = 0,  # rounds between auto-saves (0 = manual only)
+        max_checkpoints: Optional[int] = None,
     ):
         self.spec = spec
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.local_steps = local_steps
         self.local_batch_size = local_batch_size
         self.optimizer = _optimizer(optimizer, learning_rate, default_rate=0.01)
+        # checkpoint/resume (reference persistence semantics, C10): FedAvg
+        # state is the averaged params + the round counter — per-worker
+        # optimizer state is transient inside the round and never persists
+        from distriflow_tpu.checkpoint import make_store
+
+        self.save_every = save_every
+        self.store = make_store(checkpoint_dir, max_checkpoints)
         self.logger = VerboseLogger(f"FedAvg[{spec.name}]", verbose)
         self.callbacks = CallbackRegistry("new_version", "round")
         self.params: Optional[Params] = None
@@ -124,6 +134,9 @@ class FederatedAveragingTrainer:
         y = jax.device_put(jnp.asarray(y), NamedSharding(self.mesh, P("data")))
         self.params, loss = self._round_fn(self.params, x, y)
         self.round_index += 1
+        if (self.store is not None and self.save_every
+                and self.round_index % self.save_every == 0):
+            self.save()
         self.callbacks.fire("round", self.round_index)
         self.callbacks.fire("new_version", str(self.round_index))
         return float(loss)
@@ -143,6 +156,34 @@ class FederatedAveragingTrainer:
         xs = xs.reshape((w, k, b) + xs.shape[1:])
         ys = ys.reshape((w, k, b) + ys.shape[1:])
         return xs, ys
+
+    def save(self) -> str:
+        """Checkpoint the averaged params + round counter (synchronous)."""
+        if self.store is None:
+            raise RuntimeError("no checkpoint_dir configured")
+        if self.params is None:
+            raise RuntimeError("trainer not initialized")
+        return self.store.save(
+            {"params": jax.device_get(self.params),
+             "round_index": jnp.int32(self.round_index)},
+            version=str(self.round_index),
+        )
+
+    def restore(self, version: Optional[str] = None) -> bool:
+        """Resume from the latest (or a named) round. False when empty."""
+        if self.store is None:
+            raise RuntimeError("no checkpoint_dir configured")
+        if self.params is None:
+            self.init()
+        version = version or self.store.last()
+        if version is None:
+            return False
+        like = {"params": self.params, "round_index": jnp.int32(0)}
+        host = self.store.load(version, like)
+        self.params = jax.device_put(
+            host["params"], NamedSharding(self.mesh, P()))
+        self.round_index = int(host["round_index"])
+        return True
 
     def evaluate(self, x, y, metrics=("loss", "accuracy")) -> List[float]:
         fn = jax.jit(self.spec.metrics_fn(list(metrics)))

@@ -138,18 +138,12 @@ class SyncTrainer:
         self._cost_cache: Dict[Any, Dict[str, float]] = {}  # per batch signature
         # checkpointing (reference saves on every update, server/models.ts:132-138;
         # here save_every is explicit and the write happens off-thread)
-        self.store = None
+        from distriflow_tpu.checkpoint import make_store
+
         self.save_every = save_every
-        if checkpoint_dir is not None:
-            if sharded_checkpoints:
-                # each process writes only its owned shards (multi-host scale)
-                from distriflow_tpu.checkpoint.sharded import ShardedCheckpointStore
-
-                self.store = ShardedCheckpointStore(checkpoint_dir, max_checkpoints)
-            else:
-                from distriflow_tpu.checkpoint.store import CheckpointStore
-
-                self.store = CheckpointStore(checkpoint_dir, max_checkpoints)
+        # sharded: each process writes only its owned shards (multi-host)
+        self.store = make_store(checkpoint_dir, max_checkpoints,
+                                sharded=sharded_checkpoints)
         self._save_queue: Optional[queue.Queue] = None
         self._save_thread: Optional[threading.Thread] = None
         self._save_errors: List[Exception] = []

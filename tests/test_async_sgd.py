@@ -1,5 +1,6 @@
 """Async-SGD engine tests: staleness bounds, decay, concurrent workers."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -96,3 +97,20 @@ def test_async_training_learns(devices):
     after = t.evaluate(x, y)
     assert after[0] < before[0]
     assert after[1] > 0.8, after
+
+
+def test_async_checkpoint_resume(devices, tmp_path):
+    """Async trainer checkpoints under the apply lock and resumes with
+    params + optimizer state + version intact."""
+    t, dataset = _trainer(checkpoint_dir=str(tmp_path))
+    t.train(num_workers=2)
+    assert t.version > 0
+    v = t.save()
+    params_before = jax.device_get(t.params)
+
+    t2, _ = _trainer(checkpoint_dir=str(tmp_path))
+    assert t2.restore()
+    assert t2.version == int(v)
+    for a, b in zip(jax.tree.leaves(jax.device_get(t2.params)),
+                    jax.tree.leaves(params_before)):
+        np.testing.assert_array_equal(a, b)

@@ -102,3 +102,35 @@ def test_callbacks(devices):
     xs, ys = t.pack_round_data(x, y)
     t.round(xs, ys)
     assert rounds == [1]
+
+
+def test_fedavg_checkpoint_resume(devices, tmp_path):
+    """FedAvg rounds checkpoint (params + round counter) and resume."""
+    from distriflow_tpu.models import mnist_mlp
+
+    mesh = data_parallel_mesh(devices)
+
+    def make():
+        t = FederatedAveragingTrainer(
+            mnist_mlp(hidden=8), mesh=mesh, local_steps=2,
+            local_batch_size=4, learning_rate=0.05,
+            checkpoint_dir=str(tmp_path), save_every=1)
+        t.init(jax.random.PRNGKey(0))
+        return t
+
+    t1 = make()
+    rng = np.random.RandomState(0)
+    x, y = t1.pack_round_data(
+        rng.rand(256, 28, 28, 1).astype(np.float32),
+        np.eye(10, dtype=np.float32)[rng.randint(0, 10, 256)])
+    t1.round(x, y)
+    t1.round(x, y)
+    before = jax.device_get(t1.params)
+
+    t2 = make()
+    assert t2.restore()
+    assert t2.round_index == 2
+    for a, b in zip(jax.tree.leaves(jax.device_get(t2.params)),
+                    jax.tree.leaves(before)):
+        np.testing.assert_array_equal(a, b)
+    assert np.isfinite(t2.round(x, y))
