@@ -62,14 +62,15 @@ class InferenceClient:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
         seed: int = 0,
     ) -> np.ndarray:
         """Remote :func:`distriflow_tpu.models.generate`; returns
-        ``[B, P + n_tokens]`` int32."""
+        ``[B, P + n_tokens]`` int32 (``eos_id`` freezes finished rows)."""
         payload = self._prompt_payload(prompt)
         payload.update(
             n_tokens=int(n_tokens), temperature=float(temperature),
-            top_k=top_k, top_p=top_p, seed=int(seed),
+            top_k=top_k, top_p=top_p, eos_id=eos_id, seed=int(seed),
         )
         result = unpack_bytes(self._request("generate", payload)["result"])
         return deserialize_array(result["tokens"])

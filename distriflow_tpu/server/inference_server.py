@@ -121,6 +121,7 @@ class InferenceServer:
         temperature = float(payload.get("temperature", 0.0))
         top_k = payload.get("top_k")
         top_p = payload.get("top_p")
+        eos_id = payload.get("eos_id")
         seed = int(payload.get("seed", 0))
         with self._device_lock, self.logger.time(
             f"generate[{prompt.shape[0]}x{prompt.shape[1]}+{n_tokens}]"
@@ -130,6 +131,7 @@ class InferenceServer:
                 temperature=temperature,
                 top_k=int(top_k) if top_k is not None else None,
                 top_p=float(top_p) if top_p is not None else None,
+                eos_id=int(eos_id) if eos_id is not None else None,
                 rng=jax.random.PRNGKey(seed),
             )
         return {"result": pack_bytes({"tokens": serialize_array(out)})}
