@@ -226,7 +226,8 @@ class _Builder:
             raise ValueError(
                 f"unsupported layer {class_name!r}; supported: Conv1D/2D, "
                 "DepthwiseConv2D, SeparableConv2D, Conv2DTranspose, UpSampling2D, Dense, "
-                "LeakyReLU, PReLU, ELU, Softmax, "
+                "LeakyReLU, PReLU, ELU, Softmax, Cropping2D, Permute, RepeatVector, "
+                "TimeDistributed(Dense/...), "
                 "Embedding, SimpleRNN, LSTM, GRU, Bidirectional, Activation, "
                 "ReLU, Max/AveragePooling1D/2D, GlobalAverage/MaxPooling1D/2D, "
                 "Flatten, Reshape, ZeroPadding2D, Dropout, SpatialDropout1D, "
@@ -880,6 +881,82 @@ class _Builder:
             return y
 
         self.fns.append(fn)
+
+    def _add_Cropping2D(self, name: str, cfg: Dict[str, Any]) -> None:
+        h, w, c = self._need_shape(name)
+        crop = cfg.get("cropping", ((0, 0), (0, 0)))
+        if isinstance(crop, int):
+            crop = ((crop, crop), (crop, crop))
+        (t, b), (l, r) = (
+            (crop[0], crop[0]) if isinstance(crop[0], int) else tuple(crop[0]),
+            (crop[1], crop[1]) if isinstance(crop[1], int) else tuple(crop[1]),
+        )
+        t, b, l, r = int(t), int(b), int(l), int(r)
+        if h - t - b <= 0 or w - l - r <= 0:
+            raise ValueError(f"{name}: cropping {crop} exceeds input {h}x{w}")
+        self.fns.append(
+            lambda params, x, t=t, b=b, l=l, r=r: x[
+                :, t : x.shape[1] - b, l : x.shape[2] - r, :
+            ]
+        )
+        self.shape = (h - t - b, w - l - r, c)
+
+    def _add_Permute(self, name: str, cfg: Dict[str, Any]) -> None:
+        dims = tuple(int(d) for d in cfg["dims"])  # 1-based, batch excluded
+        shape = self._need_shape(name)
+        if sorted(dims) != list(range(1, len(shape) + 1)):
+            raise ValueError(f"{name}: dims {dims} not a permutation of input rank")
+        self.fns.append(
+            lambda params, x, dims=dims: jnp.transpose(x, (0,) + dims))
+        self.shape = tuple(shape[d - 1] for d in dims)
+
+    def _add_RepeatVector(self, name: str, cfg: Dict[str, Any]) -> None:
+        (c,) = self._need_shape(name)  # requires a [B, C] input
+        n = int(cfg["n"])
+        self.fns.append(
+            lambda params, x, n=n: jnp.repeat(x[:, None, :], n, axis=1))
+        self.shape = (n, c)
+
+    def _add_TimeDistributed(self, name: str, cfg: Dict[str, Any]) -> None:
+        """Unwrap to the inner layer: every supported inner op (Dense, the
+        activations, Dropout, ...) already broadcasts over leading dims, so
+        applying it per time step IS applying it to the [B, T, ...] tensor."""
+        inner = cfg.get("layer")
+        if not inner:
+            raise ValueError(f"{name}: TimeDistributed without an inner layer")
+        if len(self._need_shape(name)) < 2:
+            raise ValueError(
+                f"{name}: TimeDistributed needs a time dimension "
+                f"(input feature shape {self._need_shape(name)} is rank "
+                f"{len(self._need_shape(name))}; Keras requires >= 3D tensors)"
+            )
+        # weights register under the WRAPPER's graph name: Keras/tfjs export
+        # the inner variables under the wrapper scope
+        # ('time_distributed/kernel'), the same convention _add_Bidirectional
+        # follows — registering under the inner config name would make every
+        # pretrained TimeDistributed model unloadable
+        icfg = {**dict(inner.get("config", {})), "name": name}
+        inner_cls = inner["class_name"]
+        if inner_cls not in ("Dense", "Activation", "Dropout", "LeakyReLU",
+                            "ELU", "Softmax", "Flatten"):
+            raise ValueError(
+                f"{name}: TimeDistributed({inner_cls}) is not supported — "
+                "only per-feature inner layers broadcast over time here"
+            )
+        if inner_cls == "Flatten":
+            # per-step flatten: [B, T, ...] -> [B, T, prod(rest)]
+            shape = self._need_shape(name)
+            rest = int(np.prod(shape[1:]))
+            self.fns.append(
+                lambda params, x: x.reshape(x.shape[0], x.shape[1], -1))
+            self.shape = (shape[0], rest)
+            return
+        # dispatch straight to the inner handler (NOT self.add — the outer
+        # add() call appends this layer's name, so calling add() again
+        # would double-count); the inner handler appends exactly one fn.
+        # Shape tracking is the inner layer's (Dense over sequences
+        # already keeps leading dims).
+        getattr(self, f"_add_{inner_cls}")(name, icfg)
 
     def _add_LeakyReLU(self, name: str, cfg: Dict[str, Any]) -> None:
         # Keras 2/tfjs serialize 'alpha'; Keras 3 'negative_slope'
@@ -1689,6 +1766,17 @@ def _strip_trailing_softmax(
     if last["class_name"] == "Softmax" and _is_last_axis(cfg.get("axis", -1), out_shape):
         fns[-1] = lambda params, x: x
         return True
+    if last["class_name"] == "TimeDistributed":
+        # unwrap: the per-step head IS the model head (params live under the
+        # wrapper name, which is exactly names[-1])
+        inner = cfg.get("layer") or {}
+        ic = inner.get("config", {})
+        if inner.get("class_name") == "Activation" and ic.get("activation") == "softmax":
+            fns[-1] = lambda params, x: x
+            return True
+        if inner.get("class_name") == "Dense" and ic.get("activation") == "softmax":
+            fns[-1] = _dense_fn(names[-1], ic.get("use_bias", True))
+            return True
     if last["class_name"] == "Dense" and cfg.get("activation") == "softmax":
         # rebuild the final Dense minus its activation (we need the
         # *pre*-softmax values); params live under the builder-resolved

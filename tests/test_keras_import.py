@@ -1192,3 +1192,87 @@ def test_softmax_layer_strip_positive_axis_and_graph_mode(tmp_path):
     gparams = gspec.init(jax.random.PRNGKey(0))
     gout = np.asarray(gspec.apply(gparams, jnp.ones((2, 3))))
     np.testing.assert_allclose(gout, 3.0, rtol=1e-6)  # ones kernel: raw logits
+
+
+def test_structural_layers(tmp_path):
+    """Cropping2D / Permute / RepeatVector / TimeDistributed(Dense),
+    numpy-verified shape and value semantics."""
+    layers = [
+        {"class_name": "Cropping2D",
+         "config": {"name": "cr", "cropping": [[1, 0], [0, 1]],
+                    "batch_input_shape": [None, 4, 4, 2]}},
+        {"class_name": "Permute", "config": {"name": "pm", "dims": [3, 1, 2]}},
+    ]
+    path = _write_model(tmp_path, {"modelTopology": {"model_config": {
+        "class_name": "Sequential", "config": layers}}})
+    spec = spec_from_keras_json(path, loss="mean_squared_error")
+    assert spec.output_shape == (2, 3, 3)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = np.arange(32, dtype=np.float32).reshape(1, 4, 4, 2)
+    out = np.asarray(spec.apply(params, jnp.asarray(x)))
+    np.testing.assert_array_equal(out, x[:, 1:, :3, :].transpose(0, 3, 1, 2))
+
+    d2 = tmp_path / "td"
+    d2.mkdir()
+    layers2 = [
+        {"class_name": "RepeatVector",
+         "config": {"name": "rv", "n": 3, "batch_input_shape": [None, 2]}},
+        {"class_name": "TimeDistributed",
+         "config": {"name": "td",
+                    "layer": {"class_name": "Dense",
+                              "config": {"name": "td_dense", "units": 4,
+                                         "activation": "relu",
+                                         "use_bias": False,
+                                         "kernel_initializer": {
+                                             "class_name": "Ones",
+                                             "config": {}}}}}},
+    ]
+    path2 = _write_model(d2, {"modelTopology": {"model_config": {
+        "class_name": "Sequential", "config": layers2}}})
+    spec2 = spec_from_keras_json(path2, loss="mean_squared_error")
+    assert spec2.output_shape == (3, 4)
+    p2 = spec2.init(jax.random.PRNGKey(0))
+    # weights register under the WRAPPER name (export convention)
+    assert set(p2) == {"td"}, set(p2)
+    out2 = np.asarray(spec2.apply(p2, jnp.asarray([[1.0, 2.0]])))
+    np.testing.assert_allclose(out2, np.full((1, 3, 4), 3.0), rtol=1e-6)
+
+
+def test_time_distributed_softmax_head_strips_and_loads(tmp_path):
+    """TimeDistributed(Dense(softmax)) as the final layer: the softmax
+    strips under logits_output (no silent double-softmax), and trained
+    weights load from the wrapper-scoped export key."""
+    kernel = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    layers = [
+        {"class_name": "RepeatVector",
+         "config": {"name": "rv", "n": 2, "batch_input_shape": [None, 2]}},
+        {"class_name": "TimeDistributed",
+         "config": {"name": "time_distributed",
+                    "layer": {"class_name": "Dense",
+                              "config": {"name": "inner", "units": 3,
+                                         "activation": "softmax",
+                                         "use_bias": False}}}},
+    ]
+    path = _write_model(
+        tmp_path, {"modelTopology": {"model_config": {
+            "class_name": "Sequential", "config": layers}}},
+        weights=[("time_distributed/kernel", kernel)],
+    )
+    spec = spec_from_keras_json(path)
+    params = spec.init(jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(
+        np.asarray(params["time_distributed"]["kernel"]), kernel)
+    x = np.asarray([[1.0, -2.0]], np.float32)
+    out = np.asarray(spec.apply(params, jnp.asarray(x)))
+    want = np.repeat((x @ kernel)[:, None, :], 2, axis=1)  # logits, no softmax
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+    bad_dir = tmp_path / "bad"
+    bad_dir.mkdir()
+    with pytest.raises(ValueError, match="time dimension"):
+        spec_from_keras_json(_write_model(
+            bad_dir,
+            {"modelTopology": {"model_config": {"class_name": "Sequential",
+                "config": [{"class_name": "TimeDistributed",
+                            "config": {"name": "t", "batch_input_shape": [None, 4],
+                                       "layer": {"class_name": "Dense",
+                                                 "config": {"name": "i", "units": 2}}}}]}}}))

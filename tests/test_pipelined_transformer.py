@@ -230,3 +230,32 @@ def test_pipelined_grad_accum_matches_full_batch(devices):
     for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=2e-4, atol=1e-6)
+
+
+def test_pipelined_1f1b_grad_accum_matches(devices):
+    """grad_accum composes with the 1F1B custom-VJP schedule too."""
+    import dataclasses
+
+    mesh = create_mesh(MeshConfig(pipe=2, data=2), devices[:4])
+    spec = pipelined_transformer_lm(
+        dataclasses.replace(CFG, pipeline_schedule="1f1b"),
+        mesh=mesh, example_seq=16)
+    rng = np.random.RandomState(3)
+    tokens = rng.randint(0, 64, (8, 17))
+    x = tokens[:, :-1].astype(np.int32)
+    y = tokens[:, 1:].astype(np.int32)
+
+    def run(accum):
+        t = SyncTrainer(spec, mesh=mesh, learning_rate=1e-2,
+                        param_rules=PIPELINED_TRANSFORMER_RULES,
+                        grad_accum=accum)
+        t.init(jax.random.PRNGKey(0))
+        loss = t.step((x, y))
+        return loss, jax.device_get(t.state.params)
+
+    l1, p1 = run(1)
+    l2, p2 = run(2)
+    np.testing.assert_allclose(l2, l1, rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=1e-6)

@@ -326,3 +326,28 @@ def test_ema_survives_set_params_and_legacy_checkpoints(devices, tmp_path):
     t.set_params(jax.tree.map(np.asarray, t.get_params()))
     assert np.isfinite(t.step((x, y)))
     t.close()
+
+
+def test_ema_through_step_many(devices):
+    """EMA updates once per device-side scanned step: K step_many steps
+    equal K step() calls exactly (EMA included)."""
+    mesh = data_parallel_mesh(devices)
+    x, y = _mnist_like(16)
+    k = 4
+    xs = np.stack([x] * k)
+    ys = np.stack([y] * k)
+
+    t1 = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, learning_rate=0.05,
+                     ema_decay=0.9)
+    t1.init(jax.random.PRNGKey(0))
+    for _ in range(k):
+        t1.step((x, y))
+
+    t2 = SyncTrainer(mnist_mlp(hidden=8), mesh=mesh, learning_rate=0.05,
+                     ema_decay=0.9)
+    t2.init(jax.random.PRNGKey(0))
+    t2.step_many((xs, ys))
+
+    for a, b in zip(jax.tree.leaves(jax.device_get(t1.ema_params)),
+                    jax.tree.leaves(jax.device_get(t2.ema_params))):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
