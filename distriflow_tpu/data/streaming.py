@@ -84,6 +84,7 @@ class StreamingTokenDataset:
         seed: int = 0,
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
+        window_range: Optional[Tuple[int, int]] = None,
     ):
         if seq_len < 1 or batch_size < 1:
             raise ValueError(
@@ -116,7 +117,23 @@ class StreamingTokenDataset:
             shape=(meta["count"],),
         )
         window = seq_len + 1
-        self.n_windows = meta["count"] // window
+        total_windows = meta["count"] // window
+        # window_range=[lo, hi) restricts this dataset to a slice of the
+        # file's windows — the train/eval holdout mechanism (train on
+        # [0, split), eval on [split, total)); default = everything
+        if window_range is None:
+            window_range = (0, total_windows)  # may be empty: the
+            # batches_per_epoch check below gives the "not enough" error
+        else:
+            lo_, hi_ = int(window_range[0]), int(window_range[1])
+            if not 0 <= lo_ < hi_ <= total_windows:
+                raise ValueError(
+                    f"window_range {window_range} invalid for "
+                    f"{total_windows} windows"
+                )
+        lo, hi = int(window_range[0]), int(window_range[1])
+        self.window_range = (lo, hi)
+        self.n_windows = hi - lo
         # windows this process owns per epoch, floored to full local batches
         per_proc = self.n_windows // process_count
         self.batches_per_epoch = per_proc // batch_size
@@ -135,7 +152,7 @@ class StreamingTokenDataset:
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         rng = np.random.RandomState((self.seed * 1_000_003 + epoch) % (2**31))
-        perm = rng.permutation(self.n_windows)
+        perm = self.window_range[0] + rng.permutation(self.n_windows)
         mine = perm[self.process_index :: self.process_count]
         usable = self.batches_per_epoch * self.batch_size
         return mine[:usable]
@@ -182,6 +199,23 @@ class StreamingTokenDataset:
 
     # -- resume ------------------------------------------------------------
 
+    def seek(self, batches_consumed: int) -> None:
+        """Position the cursor as if ``batches_consumed`` batches had been
+        drawn since epoch 0. Exact and state-free: the epoch order is a
+        pure function of (seed, epoch), and consumption is strictly
+        sequential — so a trainer resumed at step N needs no sidecar
+        cursor file, just ``seek(N)`` (one batch per optimizer step)."""
+        if batches_consumed < 0:
+            raise ValueError(f"batches_consumed must be >= 0, got {batches_consumed}")
+        self.epoch, self.batch_in_epoch = divmod(
+            int(batches_consumed), self.batches_per_epoch)
+        self._order = None  # recomputed lazily for the sought epoch
+
+    def max_token_id(self) -> int:
+        """Largest token id in the WHOLE file (one memmap scan) — validate
+        against the model vocab before training, not per batch."""
+        return int(self._tokens.max()) if len(self._tokens) else 0
+
     def state(self) -> Dict[str, Any]:
         """Cursor snapshot; JSON-serializable (store it in checkpoint
         ``extra_meta`` next to the model state)."""
@@ -194,6 +228,7 @@ class StreamingTokenDataset:
             "seq_len": self.seq_len,
             "batch_size": self.batch_size,
             "n_windows": self.n_windows,
+            "window_range": list(self.window_range),
         }
 
     def restore(self, state: Dict[str, Any]) -> None:
@@ -210,6 +245,11 @@ class StreamingTokenDataset:
                     f"cursor {key}={state.get(key)!r} does not match this "
                     f"dataset's {key}={getattr(self, key)!r}"
                 )
+        if tuple(state.get("window_range", self.window_range)) != self.window_range:
+            raise ValueError(
+                f"cursor window_range={state.get('window_range')!r} does not "
+                f"match this dataset's {self.window_range!r}"
+            )
         self.epoch = int(state["epoch"])
         self.batch_in_epoch = int(state["batch_in_epoch"])
         self._order = self._epoch_order(self.epoch)

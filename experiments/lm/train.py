@@ -19,6 +19,7 @@ Run:  python -m experiments.lm.train --steps 200 --seq 512
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 import jax
@@ -74,6 +75,14 @@ def main(argv=None) -> float:
                         "host/transport latency, which dominates small-model "
                         "wall clock; loss prints once per chunk")
     p.add_argument("--corpus-tokens", type=int, default=200_000)
+    p.add_argument("--tokens-file", default=None,
+                   help="train from a real memmapped token file "
+                        "(write_token_file format); the last ~10%% of the "
+                        "file's windows are HELD OUT for eval — training "
+                        "never sees them")
+    p.add_argument("--vocab-size", type=int, default=None,
+                   help="model vocab (default: the synthetic corpus vocab; "
+                        "REQUIRED to cover the token ids in --tokens-file)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--save-every", type=int, default=0)
     p.add_argument("--generate", type=int, default=0,
@@ -108,7 +117,7 @@ def main(argv=None) -> float:
 
     mesh = parse_mesh(args.mesh)
     cfg = TransformerConfig(
-        vocab_size=VOCAB,
+        vocab_size=args.vocab_size or VOCAB,
         d_model=args.d_model,
         n_heads=args.n_heads,
         n_layers=args.n_layers,
@@ -151,19 +160,65 @@ def main(argv=None) -> float:
         start_step = trainer.version
         print(f"resumed at step {start_step}", file=sys.stderr)
 
-    corpus = generate_corpus(args.corpus_tokens, seed=args.seed)
-    # train on the head, hold out the tail for eval — random training
-    # offsets never enter the held-out slice
-    split = max(len(corpus) - max(4 * (args.seq + 1), len(corpus) // 10),
-                args.seq + 2)
-    train_corpus, eval_corpus = corpus[:split], corpus[split:]
+    stream_ds = eval_ds = None
+    if args.tokens_file:
+        # real corpus: memmapped windows with a REAL holdout — the last 10%
+        # of windows (>= one batch) are eval-only; training never sees them
+        from distriflow_tpu.data import StreamingTokenDataset
+
+        probe = StreamingTokenDataset(
+            args.tokens_file, seq_len=args.seq, batch_size=args.batch_size,
+            seed=args.seed)
+        # fail BEFORE training on out-of-vocab ids anywhere in the FILE
+        # (a silent overflow would index the embedding with garbage)
+        max_id = probe.max_token_id()
+        if max_id >= cfg.vocab_size:
+            raise SystemExit(
+                f"--tokens-file contains id {max_id} >= model vocab "
+                f"{cfg.vocab_size}; pass --vocab-size >= {max_id + 1}"
+            )
+        total = probe.n_windows
+        # each side needs one full batch PER PROCESS (the dataset shards
+        # windows across processes before flooring to whole batches)
+        per_side = probe.process_count * args.batch_size
+        split = total - max(total // 10, per_side)
+        if split < per_side:
+            raise SystemExit(
+                f"--tokens-file has only {total} windows of seq {args.seq}: "
+                f"a train/eval split needs >= {2 * per_side} "
+                f"({probe.process_count} process(es) x batch {args.batch_size} "
+                "per side)"
+            )
+        stream_ds = StreamingTokenDataset(
+            args.tokens_file, seq_len=args.seq, batch_size=args.batch_size,
+            seed=args.seed, window_range=(0, split))
+        eval_ds = StreamingTokenDataset(
+            args.tokens_file, seq_len=args.seq, batch_size=args.batch_size,
+            seed=args.seed, window_range=(split, total))
+        if start_step:
+            # exact cursor resume with no sidecar state: consumption is one
+            # batch per optimizer step and the epoch order is a pure
+            # function of (seed, epoch) — seek to the restored step
+            stream_ds.seek(start_step)
+            print(f"stream cursor sought to epoch {stream_ds.epoch} "
+                  f"batch {stream_ds.batch_in_epoch}", file=sys.stderr)
+        stream = iter(stream_ds)
+        corpus = eval_corpus = None
+    else:
+        corpus = generate_corpus(args.corpus_tokens, seed=args.seed)
+        # train on the head, hold out the tail for eval — random training
+        # offsets never enter the held-out slice
+        split = max(len(corpus) - max(4 * (args.seq + 1), len(corpus) // 10),
+                    args.seq + 2)
+        train_corpus, eval_corpus = corpus[:split], corpus[split:]
+        stream = batches(train_corpus, args.batch_size, args.seq, args.steps,
+                         args.seed + start_step)
     # one device dispatch per --steps-per-dispatch steps (run_chunked:
     # steady-state timing, full chunks only); seed by the resumed step so a
     # restarted run continues the batch stream instead of replaying windows
     res = run_chunked(
         trainer,
-        batches(train_corpus, args.batch_size, args.seq, args.steps,
-                args.seed + start_step),
+        stream,
         steps=args.steps,
         steps_per_dispatch=args.steps_per_dispatch,
         log=lambda s, l: print(
@@ -175,32 +230,45 @@ def main(argv=None) -> float:
     # steady-state only: runs that fit in one dispatch have no timed steps
     tok_s = res.steps_per_sec * args.batch_size * args.seq
 
-    # held-out eval (aux-free, jitted via the trainer) vs the context-free
-    # unigram baseline
-    ex, ey = next(batches(eval_corpus, args.batch_size, args.seq, 1, args.seed + 99))
-    (eval_loss,) = (float(v) for v in trainer.evaluate(ex, ey, metrics=("loss",)))
-    counts = np.bincount(corpus, minlength=VOCAB).astype(np.float64)
-    probs = counts / counts.sum()
-    unigram = float(-(probs[probs > 0] * np.log(probs[probs > 0])).sum())
-    print(
-        f"lm: {tok_s:,.0f} tok/s | eval loss {eval_loss:.4f} "
-        f"(ppl {np.exp(eval_loss):.1f}) vs unigram {unigram:.4f} "
-        f"(ppl {np.exp(unigram):.1f})",
-        file=sys.stderr,
-    )
+    # held-out eval (aux-free, jitted via the trainer); with the synthetic
+    # corpus, compare against the context-free unigram baseline
+    if args.tokens_file:
+        ex, ey = next(iter(eval_ds))  # held-out windows: never trained on
+        (eval_loss,) = (float(v) for v in trainer.evaluate(ex, ey, metrics=("loss",)))
+        print(
+            f"lm: {tok_s:,.0f} tok/s | eval loss {eval_loss:.4f} "
+            f"(ppl {np.exp(eval_loss):.1f}) [held-out stream windows]",
+            file=sys.stderr,
+        )
+    else:
+        ex, ey = next(batches(eval_corpus, args.batch_size, args.seq, 1, args.seed + 99))
+        (eval_loss,) = (float(v) for v in trainer.evaluate(ex, ey, metrics=("loss",)))
+        counts = np.bincount(corpus, minlength=VOCAB).astype(np.float64)
+        probs = counts / counts.sum()
+        unigram = float(-(probs[probs > 0] * np.log(probs[probs > 0])).sum())
+        print(
+            f"lm: {tok_s:,.0f} tok/s | eval loss {eval_loss:.4f} "
+            f"(ppl {np.exp(eval_loss):.1f}) vs unigram {unigram:.4f} "
+            f"(ppl {np.exp(unigram):.1f})",
+            file=sys.stderr,
+        )
     if args.generate > 0:
         from distriflow_tpu.models import generate as lm_generate
 
-        prompt = jnp.asarray(eval_corpus[None, :gen_prompt_len], jnp.int32)
+        prompt_src = eval_corpus if eval_corpus is not None else np.asarray(ex[0])
+        prompt = jnp.asarray(prompt_src[None, :gen_prompt_len], jnp.int32)
         out = lm_generate(cfg, trainer.get_params(), prompt, args.generate)
         gen = np.asarray(out[0, gen_prompt_len:])
-        # a correct continuation only ever takes transitions that occur in
-        # the corpus; measure the fraction of generated bigrams that do
-        seen = set(zip(corpus[:-1].tolist(), corpus[1:].tolist()))
-        pairs = list(zip(np.asarray(out[0, 31:-1]).tolist(), gen.tolist()))
-        valid = sum(p in seen for p in pairs) / len(pairs)
-        print(f"generated {args.generate} tokens; {valid:.0%} of transitions "
-              f"follow the corpus Markov structure", file=sys.stderr)
+        if corpus is None:
+            print(f"generated {args.generate} tokens", file=sys.stderr)
+        else:
+            # a correct continuation only ever takes transitions that occur
+            # in the corpus; measure the fraction of generated bigrams that do
+            seen = set(zip(corpus[:-1].tolist(), corpus[1:].tolist()))
+            pairs = list(zip(np.asarray(out[0, 31:-1]).tolist(), gen.tolist()))
+            valid = sum(p in seen for p in pairs) / len(pairs)
+            print(f"generated {args.generate} tokens; {valid:.0%} of transitions "
+                  f"follow the corpus Markov structure", file=sys.stderr)
     if args.serve is not None:
         from distriflow_tpu.server import InferenceServer
 

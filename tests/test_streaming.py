@@ -166,3 +166,69 @@ def test_wide_token_files_fail_loudly_not_wrap(tmp_path):
                                 process_index=0, process_count=1)
     x, _ = next(ds2)
     assert x.dtype == np.int32 and int(x.max()) < 7
+
+
+def test_window_range_holdout_is_disjoint(tmp_path):
+    """window_range slices the file's windows: train [0, split) and eval
+    [split, total) never share a window, and the cursor state refuses a
+    mismatched range."""
+    path = write_token_file(str(tmp_path / "t"),
+                            np.arange(1000, dtype=np.int32) % 17)
+    full = StreamingTokenDataset(path, seq_len=9, batch_size=2, seed=0,
+                                 process_index=0, process_count=1)
+    total = full.n_windows
+    split = total - 2
+    train = StreamingTokenDataset(path, seq_len=9, batch_size=2, seed=0,
+                                  process_index=0, process_count=1,
+                                  window_range=(0, split))
+    ev = StreamingTokenDataset(path, seq_len=9, batch_size=2, seed=0,
+                               process_index=0, process_count=1,
+                               window_range=(split, total))
+    train_ids = set(train._epoch_order(0).tolist()) | set(train._epoch_order(1).tolist())
+    eval_ids = set(ev._epoch_order(0).tolist())
+    assert train_ids and eval_ids
+    assert not (train_ids & eval_ids)
+    assert max(train_ids) < split <= min(eval_ids)
+    with pytest.raises(ValueError, match="window_range"):
+        StreamingTokenDataset(path, seq_len=9, batch_size=2,
+                              process_index=0, process_count=1,
+                              window_range=(0, total + 5))
+    # a cursor from one range cannot restore into another: both guards
+    # (n_windows for different-size ranges, window_range for same-size)
+    st = train.state()
+    with pytest.raises(ValueError):
+        ev.restore(st)
+    shifted = StreamingTokenDataset(path, seq_len=9, batch_size=2, seed=0,
+                                    process_index=0, process_count=1,
+                                    window_range=(1, split + 1))  # same size
+    with pytest.raises(ValueError, match="window_range"):
+        shifted.restore(st)
+
+
+def test_max_token_id_scans_whole_file(tmp_path):
+    toks = np.zeros(500, np.int32)
+    toks[450] = 99  # far from the start: a first-batch sample would miss it
+    path = write_token_file(str(tmp_path / "m"), toks)
+    ds = StreamingTokenDataset(path, seq_len=9, batch_size=2,
+                               process_index=0, process_count=1)
+    assert ds.max_token_id() == 99
+
+
+def test_seek_matches_sequential_consumption(tmp_path):
+    """seek(N) positions the cursor exactly where N next() calls would:
+    the sidecar-free resume contract (one batch per optimizer step)."""
+    path = write_token_file(str(tmp_path / "s"),
+                            np.arange(2000, dtype=np.int32) % 31)
+    def make():
+        return StreamingTokenDataset(path, seq_len=9, batch_size=2, seed=3,
+                                     process_index=0, process_count=1)
+    a = make()
+    consumed = [next(a) for _ in range(a.batches_per_epoch + 3)]  # crosses an epoch
+    b = make()
+    b.seek(len(consumed))
+    xa, ya = next(a)
+    xb, yb = next(b)
+    np.testing.assert_array_equal(xa, xb)
+    np.testing.assert_array_equal(ya, yb)
+    with pytest.raises(ValueError, match="batches_consumed"):
+        b.seek(-1)
