@@ -341,3 +341,72 @@ def test_stale_upload_decays_into_aggregation(tmp_path):
         assert not upload(g1, v0)  # staleness now 2 > 1
     finally:
         server.stop()
+
+
+def test_many_clients_soak(tmp_path):
+    """8 concurrent clients push interleaved uploads through several
+    aggregation rounds: every accepted upload lands in exactly one
+    aggregation, versions advance monotonically, and no update is lost to
+    the updating-flag race (buffered counts stay consistent under load)."""
+    server = FederatedServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(
+            # bounded staleness: uploads racing a broadcast stay acceptable
+            # (the default staleness-0 rule would drop most of the traffic
+            # this test generates, stalling aggregation — reference
+            # semantics, but not what a soak should measure)
+            server_hyperparams={"min_updates_per_version": 8,
+                                "maximum_staleness": 3,
+                                "staleness_decay": 0.9},
+            client_hyperparams={"examples_per_update": 1},
+            save_dir=str(tmp_path / "models"),
+        ),
+    )
+    server.setup()
+    versions = []
+    server.on_new_version(versions.append)
+    clients = []
+    try:
+        clients = [_fed_client(server) for _ in range(8)]
+        x = np.ones((1, 4), np.float32)
+        y = np.ones((1, 2), np.float32)
+        rounds = 12
+        errors = []
+
+        def hammer(c):
+            try:
+                for _ in range(rounds):
+                    c.distributed_update(x, y)
+                    time.sleep(0.02)  # yield: let aggregations drain (the
+                    # updating flag drops mid-aggregation arrivals by design)
+            except Exception as e:  # surface thread failures to the assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(c,)) for c in clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "hammer thread still running after 60s"
+        assert not errors, errors
+        # uploads are synchronous through aggregation (the ack returns only
+        # after handle_upload, including update_model and the new_version
+        # fire), so once the threads joined the state is final
+        # 8 clients x 12 rounds = 96 uploads; staleness <= 3 accepted and
+        # the updating flag still drops mid-aggregation arrivals, so the
+        # floor is conservative: >= 2 aggregations at min_updates=8
+        assert len(versions) >= 2, versions
+        assert server.model.model.update_calls == len(versions)
+        # EXACT conservation: every accepted upload is either inside one of
+        # the aggregations (each consumes exactly min_updates=8 — the
+        # updating flag blocks buffering past the threshold) or still
+        # buffered below it; a silently vanished update breaks the equality
+        assert server.num_updates == 8 * len(versions) + len(server.updates), (
+            server.num_updates, len(versions), len(server.updates))
+        assert len(server.updates) < 8
+        # versions strictly advance (monotonic token stream)
+        assert len(set(versions)) == len(versions)
+    finally:
+        for c in clients:
+            c.dispose()
+        server.stop()
