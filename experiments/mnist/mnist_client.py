@@ -30,10 +30,18 @@ def main(argv=None) -> None:
     p.add_argument("--client-id", default=None)
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--seed", type=int, default=1, help="federated-mode local shard seed")
+    p.add_argument("--gradient-compression",
+                   choices=("none", "float16", "bfloat16", "int8"),
+                   default=None,
+                   help="upload compression (int8 = 4x fewer bytes with "
+                        "error feedback); default: whatever the server "
+                        "pushes, else none")
     args = p.parse_args(argv)
 
+    hp = ({"gradient_compression": args.gradient_compression}
+          if args.gradient_compression else None)
     config = DistributedClientConfig(client_id=args.client_id, send_metrics=True,
-                                     verbose=True)
+                                     verbose=True, hyperparams=hp)
     model = create_dense_model()
     if args.mode == "async":
         client = AsynchronousSGDClient(args.server, model, config)

@@ -40,12 +40,26 @@ def build_server(args: argparse.Namespace) -> AbstractServer:
     config = DistributedServerConfig(
         host=args.host, port=args.port, verbose=args.verbose
     )
+    server_hp = {}
+    if getattr(args, "weight_compression", None):
+        # halve every weight broadcast; clients restore their own dtype
+        server_hp["weight_compression"] = args.weight_compression
+    client_hp = {}
+    if getattr(args, "gradient_compression", None):
+        # pushed to every client on download (hyperparam precedence:
+        # a client's local setting still wins)
+        client_hp["gradient_compression"] = args.gradient_compression
+    if client_hp:
+        config.client_hyperparams = client_hp
     if args.mode == "async":
+        if server_hp:
+            config.server_hyperparams = server_hp
         dataset = load_dataset(args.data_dir, {"batch_size": args.batch_size,
                                                "epochs": args.epochs})
         server: AbstractServer = AsynchronousSGDServer(model, dataset, config)
     else:
-        config.server_hyperparams = {"min_updates_per_version": args.min_updates}
+        config.server_hyperparams = {
+            "min_updates_per_version": args.min_updates, **server_hp}
         server = FederatedServer(model, config)
 
     def log_metrics(msg, _result=None):
@@ -70,6 +84,11 @@ def main(argv=None) -> None:
     p.add_argument("--learning-rate", type=float, default=0.001)
     p.add_argument("--min-updates", type=int, default=20,
                    help="federated mode: gradients buffered per version")
+    p.add_argument("--weight-compression", choices=("float16", "bfloat16"),
+                   default=None, help="16-bit weight broadcasts")
+    p.add_argument("--gradient-compression",
+                   choices=("float16", "bfloat16", "int8"), default=None,
+                   help="push this upload compression to every client")
     p.add_argument("--quiet", action="store_true", help="suppress progress logs")
     p.add_argument("--verbose", action="store_true",
                    help="accepted for compatibility (progress logs are on by default)")
