@@ -110,6 +110,19 @@ def _trainable_mask(tree: Any) -> Any:
     return jax.tree_util.tree_map_with_path(trainable, tree)
 
 
+def jitted_metrics(holder: Any, spec: "ModelSpec", metrics: Tuple[str, ...]):
+    """One compiled metrics program per metric tuple, cached on ``holder``
+    (all three trainers share this — a fresh ``jax.jit`` per evaluate call
+    would recompile on every chunk of ``train.evaluate_dataset``)."""
+    cache = getattr(holder, "_eval_fns", None)
+    if cache is None:
+        cache = holder._eval_fns = {}
+    key = tuple(metrics)
+    if key not in cache:
+        cache[key] = jax.jit(spec.metrics_fn(list(key)))
+    return cache[key]
+
+
 def init_params(spec: "ModelSpec", rng: jax.Array) -> Params:
     """Run ``spec.init`` under jit, falling back to eager.
 

@@ -92,19 +92,33 @@ def shard_batch_padded(
     ``small_last_batch`` path (fixed vs the reference, SURVEY.md §2 C13)
     runs on a mesh whose data axis does not divide the final batch.
     """
-    n = len(x)
-    m = axis_size(mesh, axis)
-    pad = (-n) % m
-    weight = np.ones((n,), dtype=np.float32)
-    if pad:
-        def pad0(v):
-            v = np.asarray(v)
-            widths = [(0, pad)] + [(0, 0)] * (v.ndim - 1)
-            return np.pad(v, widths)
-
-        x, y = pad0(x), pad0(y)
-        weight = np.concatenate([weight, np.zeros((pad,), np.float32)])
+    x, y, weight = pad_partial_batch(axis_size(mesh, axis), x, y)
+    if weight is None:
+        weight = np.ones((len(x),), dtype=np.float32)
     return shard_batch(mesh, (x, y, weight), axis)
+
+
+def pad_partial_batch(divisor: int, *arrays: Any) -> Tuple[Any, ...]:
+    """Zero-pad every array's row count up to a multiple of ``divisor``.
+
+    Returns ``(*padded_arrays, weight)``: ``weight`` is 1.0 for real rows
+    and 0.0 for padding (so weighted-mean losses/metrics stay exact), or
+    ``None`` when no padding was needed. The ONE implementation of the
+    pad-with-weight-0 invariant, shared by the device-side
+    :func:`shard_batch_padded` and the host-side chunked evaluation
+    (``train.evaluate_dataset``)."""
+    n = len(arrays[0])
+    pad = (-n) % max(int(divisor), 1)
+    if not pad:
+        return (*arrays, None)
+
+    def pad0(v):
+        v = np.asarray(v)
+        return np.pad(v, [(0, pad)] + [(0, 0)] * (v.ndim - 1))
+
+    weight = np.concatenate(
+        [np.ones((n,), np.float32), np.zeros((pad,), np.float32)])
+    return (*(pad0(v) for v in arrays), weight)
 
 
 def replicate(mesh: Mesh, tree: Any) -> Any:

@@ -2,7 +2,7 @@
 
 from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
 from distriflow_tpu.train.federated import FederatedAveragingTrainer
-from distriflow_tpu.train.loop import ChunkedRunResult, run_chunked
+from distriflow_tpu.train.loop import ChunkedRunResult, evaluate_dataset, run_chunked
 from distriflow_tpu.train.sync import SyncTrainer, TrainState
 
 __all__ = [
@@ -12,4 +12,5 @@ __all__ = [
     "SyncTrainer",
     "TrainState",
     "run_chunked",
+    "evaluate_dataset",
 ]

@@ -258,7 +258,12 @@ class AsyncSGDTrainer:
 
     # -- introspection -----------------------------------------------------
 
-    def evaluate(self, x, y, metrics=("loss", "accuracy")) -> List[float]:
-        fn = jax.jit(self.spec.metrics_fn(list(metrics)))
+    def evaluate(self, x, y, metrics=("loss", "accuracy"), weight=None) -> List[float]:
+        from distriflow_tpu.models.base import jitted_metrics
+
+        fn = jitted_metrics(self, self.spec, metrics)
         params, _ = self.snapshot()
-        return [float(v) for v in fn(params, jnp.asarray(x), jnp.asarray(y))]
+        args = [jnp.asarray(x), jnp.asarray(y)]
+        if weight is not None:
+            args.append(jnp.asarray(weight, jnp.float32))
+        return [float(v) for v in fn(params, *args)]

@@ -185,6 +185,11 @@ class FederatedAveragingTrainer:
         self.round_index = int(host["round_index"])
         return True
 
-    def evaluate(self, x, y, metrics=("loss", "accuracy")) -> List[float]:
-        fn = jax.jit(self.spec.metrics_fn(list(metrics)))
-        return [float(v) for v in fn(self.params, jnp.asarray(x), jnp.asarray(y))]
+    def evaluate(self, x, y, metrics=("loss", "accuracy"), weight=None) -> List[float]:
+        from distriflow_tpu.models.base import jitted_metrics
+
+        fn = jitted_metrics(self, self.spec, metrics)
+        args = [jnp.asarray(x), jnp.asarray(y)]
+        if weight is not None:
+            args.append(jnp.asarray(weight, jnp.float32))
+        return [float(v) for v in fn(self.params, *args)]

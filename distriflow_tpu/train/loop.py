@@ -16,6 +16,7 @@ mid-run).
 
 from __future__ import annotations
 
+import functools
 import itertools
 import time
 from typing import Any, Callable, Iterable, NamedTuple, Optional
@@ -102,3 +103,57 @@ def run_chunked(
             log(step, last)
     elapsed = time.perf_counter() - start
     return ChunkedRunResult(step, timed_steps, elapsed, last, ran_dry)
+
+
+def evaluate_dataset(
+    evaluate: Callable[..., list],
+    x: Any,
+    y: Any,
+    batch_size: int = 512,
+    metrics: tuple = ("loss", "accuracy"),
+    divisor: Optional[int] = None,
+    **eval_kwargs: Any,
+) -> list:
+    """Exact whole-array metrics, evaluated in fixed-size chunks.
+
+    ``evaluate`` is any trainer's ``evaluate(x, y, metrics=..., weight=...)``
+    (all three training engines share the signature). Per-chunk
+    example-mean metrics recombine weighted by real-row count, so the
+    result equals one giant batch without ever materializing it on device
+    — the CLIs' truncate-to-512 shortcut, replaced.
+
+    ``divisor`` is the sharding constraint on chunk row counts (the mesh's
+    data-axis size for SyncTrainer); auto-detected from the bound
+    trainer's mesh when possible. A trailing chunk that does not divide is
+    zero-padded with weight-0 rows — weighted-mean metrics stay exact. The
+    tail's distinct shape compiles one extra program.
+    """
+    n = len(x)
+    if n == 0:
+        raise ValueError("evaluate_dataset needs at least one example")
+    if len(y) != n:
+        raise ValueError(f"x and y lengths differ: {n} vs {len(y)}")
+    if divisor is None:
+        fn = evaluate
+        while isinstance(fn, functools.partial):  # unwrap partial chains
+            fn = fn.func
+        owner = getattr(fn, "__self__", None)
+        mesh = getattr(owner, "mesh", None)
+        divisor = int(mesh.shape.get("data", 1)) if mesh is not None else 1
+    if batch_size % divisor:
+        batch_size += divisor - batch_size % divisor  # keep full chunks legal
+    from distriflow_tpu.parallel.mesh import pad_partial_batch
+
+    totals = [0.0] * len(metrics)
+    for lo in range(0, n, batch_size):
+        hi = min(lo + batch_size, n)
+        real = hi - lo
+        cx, cy, weight = pad_partial_batch(divisor, x[lo:hi], y[lo:hi])
+        if weight is not None:
+            vals = evaluate(cx, cy, metrics=tuple(metrics), weight=weight,
+                            **eval_kwargs)
+        else:
+            vals = evaluate(cx, cy, metrics=tuple(metrics), **eval_kwargs)
+        for i, v in enumerate(vals):
+            totals[i] += float(v) * real
+    return [t / n for t in totals]

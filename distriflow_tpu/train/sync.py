@@ -131,7 +131,6 @@ class SyncTrainer:
         # XLA inserts the reduce-scatter/all-gather pair around the update
         self._zero_opt = zero_optimizer_sharding
         self._step_fn = self._build_step(donate)
-        self._eval_fn = None
         # observability (reference time()/log wrappers, abstract_server.ts:92-103)
         self.last_step_ms: Optional[float] = None
         self._step_times: List[float] = []  # rolling window
@@ -558,16 +557,21 @@ class SyncTrainer:
 
     # -- evaluation -------------------------------------------------------
 
-    def evaluate(self, x: jnp.ndarray, y: jnp.ndarray, metrics: Tuple[str, ...] = ("loss", "accuracy"), use_ema: bool = False) -> List[float]:
+    def evaluate(self, x: jnp.ndarray, y: jnp.ndarray, metrics: Tuple[str, ...] = ("loss", "accuracy"), use_ema: bool = False, weight=None) -> List[float]:
+        """Example-mean metrics on one batch. ``weight`` (per-row, 0 for
+        padding) makes padded partial batches exact on a sharded mesh —
+        how ``train.evaluate_dataset`` handles non-divisible tails."""
+        from distriflow_tpu.models.base import jitted_metrics
+
         if self.state is None:
             self.init()
-        if self._eval_fn is None or getattr(self, "_eval_metrics", None) != metrics:
-            self._eval_metrics = metrics
-            fn = self.spec.metrics_fn(list(metrics))
-            self._eval_fn = jax.jit(fn)
+        fn = jitted_metrics(self, self.spec, metrics)
         params = self.ema_params if use_ema else self.state.params
-        batch = self._ensure_placed((x, y))
-        return [float(v) for v in self._eval_fn(params, *batch)]
+        if weight is None:
+            batch = self._ensure_placed((x, y))
+            return [float(v) for v in fn(params, *batch)]
+        batch = self._ensure_placed((x, y, jnp.asarray(weight, jnp.float32)))
+        return [float(v) for v in fn(params, *batch)]
 
     def get_params(self) -> Params:
         if self.state is None:

@@ -29,7 +29,7 @@ from distriflow_tpu.models.base import with_uint8_inputs
 from distriflow_tpu.parallel import data_parallel_mesh
 from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
 from distriflow_tpu.train.federated import FederatedAveragingTrainer
-from distriflow_tpu.train.loop import run_chunked
+from distriflow_tpu.train.loop import evaluate_dataset, run_chunked
 from distriflow_tpu.train.sync import SyncTrainer
 
 from experiments.cifar10.cifar_data import load_splits, to_xy, to_xy_raw
@@ -67,7 +67,7 @@ def run_sync(args, spec, train, val) -> float:
     sps = res.steps_per_sec * args.batch_size
     sps_txt = f"{sps:.0f}" if np.isfinite(sps) else "n/a (single dispatch)"
     vx, vy = (to_xy_raw if raw_wire else to_xy)(val)
-    val_loss, val_acc = trainer.evaluate(vx[:512], vy[:512])
+    val_loss, val_acc = evaluate_dataset(trainer.evaluate, vx, vy)
     print(f"sync: {sps_txt} samples/sec, val loss {val_loss:.4f} acc {val_acc:.4f}",
           file=sys.stderr)
     return val_acc
@@ -90,7 +90,7 @@ def run_async(args, spec, train, val) -> float:
     trainer.init(jax.random.PRNGKey(args.seed))
     stats = trainer.train(num_workers=args.workers)
     vx, vy = to_xy(val)
-    val_loss, val_acc = trainer.evaluate(vx[:512], vy[:512])
+    val_loss, val_acc = evaluate_dataset(trainer.evaluate, vx, vy)
     print(f"async: {stats}, val loss {val_loss:.4f} acc {val_acc:.4f}",
           file=sys.stderr)
     return val_acc
@@ -111,7 +111,7 @@ def run_federated(args, spec, train, val) -> float:
         if r % 5 == 0:
             print(f"round {r} loss {loss:.4f}", file=sys.stderr)
     vx, vy = to_xy(val)
-    val_loss, val_acc = trainer.evaluate(vx[:512], vy[:512])
+    val_loss, val_acc = evaluate_dataset(trainer.evaluate, vx, vy)
     print(f"federated: val loss {val_loss:.4f} acc {val_acc:.4f}", file=sys.stderr)
     return val_acc
 

@@ -21,7 +21,7 @@ from distriflow_tpu.data.prefetch import prefetch_to_device, sampling_iterator
 from distriflow_tpu.models.base import with_uint8_inputs
 from distriflow_tpu.models.mobilenet import mobilenet_v2
 from distriflow_tpu.parallel import data_parallel_mesh
-from distriflow_tpu.train.loop import run_chunked
+from distriflow_tpu.train.loop import evaluate_dataset, run_chunked
 from distriflow_tpu.train.sync import SyncTrainer
 
 from experiments.imagenet_subset.data import load_splits, to_xy, to_xy_raw
@@ -87,7 +87,7 @@ def main(argv=None) -> float:
 
     vx, vy = (to_xy_raw(splits["val"]) if raw_wire
               else to_xy(splits["val"], num_classes))
-    val_loss, val_acc = trainer.evaluate(vx[:256], vy[:256])
+    val_loss, val_acc = evaluate_dataset(trainer.evaluate, vx, vy, batch_size=256)
     print(
         f"mobilenet_v2/{args.image_size}px: {sps_txt} samples/sec, "
         f"val loss {val_loss:.4f} acc {val_acc:.4f}",
