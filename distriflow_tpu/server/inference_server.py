@@ -14,7 +14,7 @@ same encoding every other message type uses):
 - ``model_info``  {} -> {vocab_size, max_seq, d_model, n_layers, n_heads,
   name}
 - ``generate``    {prompt: <packed {tokens}>, n_tokens, temperature?,
-  top_k?, top_p?, seed?} -> {result: <packed {tokens}>}
+  top_k?, top_p?, eos_id?, seed?} -> {result: <packed {tokens}>}
 - ``beam``        {prompt: <packed {tokens}>, n_tokens, beam_size?,
   length_penalty?, eos_id?} -> {result: <packed {tokens, scores}>}
 - ``score``       {prompt: <packed {tokens}>, from_pos} ->

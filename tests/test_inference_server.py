@@ -96,3 +96,18 @@ def test_remote_score_matches_local(served):
     remote = client.score(tokens, from_pos=2)
     local = np.asarray(sequence_logprob(CFG, params, jnp.asarray(tokens), 2))
     np.testing.assert_allclose(remote, local, rtol=1e-5)
+
+
+def test_remote_generate_eos_matches_local(served):
+    """eos_id rides the wire: remote generation freezes finished rows
+    exactly like the local path."""
+    _, client, params = served
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    base = client.generate(prompt, n_tokens=6)
+    e = int(base[0, 4])  # the second generated token: forces a mid-stream stop
+    remote = client.generate(prompt, n_tokens=6, eos_id=e)
+    local = np.asarray(generate(CFG, params, jnp.asarray(prompt), 6, eos_id=e))
+    np.testing.assert_array_equal(remote, local)
+    gen = remote[0, 3:]
+    first = int(np.argmax(gen == e))
+    assert np.all(gen[first:] == e)
