@@ -163,6 +163,13 @@ def _feature_shape(batch_input_shape, where: str) -> Tuple[int, ...]:
     return tuple(int(d) for d in dims)
 
 
+def _pair(v: Any) -> Tuple[int, int]:
+    """Keras int-or-(before, after) option -> a concrete (before, after)."""
+    if isinstance(v, int):
+        return v, v
+    return int(v[0]), int(v[1])
+
+
 def _pool_padding(cfg: Dict[str, Any]) -> str:
     return {"valid": "VALID", "same": "SAME"}[cfg.get("padding", "valid")]
 
@@ -226,7 +233,7 @@ class _Builder:
             raise ValueError(
                 f"unsupported layer {class_name!r}; supported: Conv1D/2D, "
                 "DepthwiseConv2D, SeparableConv2D, Conv2DTranspose, UpSampling2D, Dense, "
-                "LeakyReLU, PReLU, ELU, Softmax, Cropping2D, Permute, RepeatVector, "
+                "LeakyReLU, PReLU, ELU, Softmax, Cropping1D/2D, ZeroPadding1D, Permute, RepeatVector, "
                 "TimeDistributed(Dense/...), "
                 "Embedding, SimpleRNN, LSTM, GRU, Bidirectional, Activation, "
                 "ReLU, Max/AveragePooling1D/2D, GlobalAverage/MaxPooling1D/2D, "
@@ -881,6 +888,23 @@ class _Builder:
             return y
 
         self.fns.append(fn)
+
+    def _add_ZeroPadding1D(self, name: str, cfg: Dict[str, Any]) -> None:
+        t, c = self._need_shape(name)
+        l, r = _pair(cfg.get("padding", 1))
+        self.fns.append(
+            lambda params, x, l=l, r=r: jnp.pad(x, ((0, 0), (l, r), (0, 0))))
+        self.shape = (t + l + r, c)
+
+    def _add_Cropping1D(self, name: str, cfg: Dict[str, Any]) -> None:
+        t, c = self._need_shape(name)
+        l, r = _pair(cfg.get("cropping", (1, 1)))
+        if t - l - r <= 0:
+            raise ValueError(
+                f"{name}: cropping ({l}, {r}) exceeds input length {t}")
+        self.fns.append(
+            lambda params, x, l=l, r=r: x[:, l : x.shape[1] - r, :])
+        self.shape = (t - l - r, c)
 
     def _add_Cropping2D(self, name: str, cfg: Dict[str, Any]) -> None:
         h, w, c = self._need_shape(name)

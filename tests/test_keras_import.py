@@ -1276,3 +1276,30 @@ def test_time_distributed_softmax_head_strips_and_loads(tmp_path):
                             "config": {"name": "t", "batch_input_shape": [None, 4],
                                        "layer": {"class_name": "Dense",
                                                  "config": {"name": "i", "units": 2}}}}]}}}))
+
+
+def test_padding_and_cropping_1d(tmp_path):
+    """ZeroPadding1D / Cropping1D: shape tracking and values, asymmetric."""
+    layers = [
+        {"class_name": "ZeroPadding1D",
+         "config": {"name": "zp", "padding": [2, 1],
+                    "batch_input_shape": [None, 4, 3]}},
+        {"class_name": "Cropping1D", "config": {"name": "cr", "cropping": [1, 2]}},
+    ]
+    path = _write_model(tmp_path, {"modelTopology": {"model_config": {
+        "class_name": "Sequential", "config": layers}}})
+    spec = spec_from_keras_json(path, loss="mean_squared_error")
+    assert spec.output_shape == (4, 3)
+    params = spec.init(jax.random.PRNGKey(0))
+    x = np.arange(12, dtype=np.float32).reshape(1, 4, 3)
+    out = np.asarray(spec.apply(params, jnp.asarray(x)))
+    padded = np.pad(x, ((0, 0), (2, 1), (0, 0)))
+    np.testing.assert_array_equal(out, padded[:, 1:-2, :])
+    with pytest.raises(ValueError, match="exceeds"):
+        spec_from_keras_json(_write_model(
+            tmp_path, {"modelTopology": {"model_config": {
+                "class_name": "Sequential", "config": [
+                    {"class_name": "Cropping1D",
+                     "config": {"name": "c", "cropping": [3, 3],
+                                "batch_input_shape": [None, 4, 3]}}]}}}),
+            loss="mean_squared_error")
