@@ -83,8 +83,11 @@ class TransformerConfig:
     # "1f1b"   = interleaved one-forward-one-backward (O(P) live inputs)
     pipeline_schedule: Optional[str] = None
     # integer-label CE by default: LM targets are the [B, S] int32 next-token
-    # ids, never a [B, S, V] one-hot (HBM + wire cost scales with V otherwise)
-    loss: str = "sparse_softmax_cross_entropy"
+    # ids, never a [B, S, V] one-hot (HBM + wire cost scales with V otherwise).
+    # None = auto: the Pallas fused CE on TPU (online-logsumexp over vocab
+    # tiles, no [N, V] log-softmax intermediate in HBM — ops/fused_ce.py),
+    # plain optax CE elsewhere (the kernel interpreter is test-only-slow).
+    loss: Optional[str] = None
 
     def __post_init__(self):
         if self.n_experts > 0 and not 1 <= self.moe_top_k <= self.n_experts:
@@ -97,6 +100,33 @@ class TransformerConfig:
                 "use_ring_attention and use_ulysses_attention are mutually "
                 "exclusive sequence-parallel strategies; pick one"
             )
+
+    def resolved_loss_for(self, mesh: Optional[Mesh]) -> str:
+        """The loss name the model spec actually trains with. An explicit
+        ``loss`` is always honored; ``loss=None`` resolves at spec-build
+        time (not config-construction time, so a config built on the host
+        composes with whatever backend runs it): the fused Pallas sparse CE
+        on a single-device TPU, plain optax CE elsewhere. Multi-device
+        meshes get the optax loss because ``pallas_call`` has no GSPMD
+        partitioning rule — under pjit the fused kernel would all-gather
+        the full global ``[tokens, V]`` logits onto every device and run
+        replicated (a memory/perf regression exactly where the sharded XLA
+        loss parallelizes for free). Opting in explicitly remains possible.
+        """
+        if self.loss is not None:
+            return self.loss
+        if mesh is not None and mesh.size > 1:
+            return "sparse_softmax_cross_entropy"
+        return (
+            "fused_sparse_softmax_cross_entropy"
+            if _default_use_flash()
+            else "sparse_softmax_cross_entropy"
+        )
+
+    @property
+    def resolved_loss(self) -> str:
+        """Meshless resolution (single-device semantics)."""
+        return self.resolved_loss_for(None)
 
 
 def apply_rope(
@@ -414,7 +444,25 @@ class TransformerLM(nn.Module):
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
         logits = nn.Dense(cfg.vocab_size, name="lm_head", dtype=cfg.dtype,
                           use_bias=False)(x)
-        return logits.astype(jnp.float32)
+        return _cast_logits(
+            logits, cfg.resolved_loss_for(self.mesh), decode=self.decode
+        )
+
+
+def _cast_logits(logits, loss_name, decode=False):
+    """f32 logits for XLA losses and decode; native dtype for the fused CE.
+
+    The f32-materialized ``[tokens, V]`` logits are the single biggest HBM
+    array in the training step (~1 GB at the bench config): the fused Pallas
+    CE reads the compute dtype directly and upcasts per-tile in VMEM, so the
+    cast (and its backward twin on the gradient) is pure wasted bandwidth
+    there — measured 8-9% of flagship step time on v5e. ``loss_name`` must
+    be the RESOLVED name the spec trains with (same mesh!) so dtype and loss
+    choice never diverge. Decode always gets f32 (sampling numerics are
+    host-visible API surface)."""
+    if not decode and loss_name.startswith("fused_"):
+        return logits
+    return logits.astype(jnp.float32)
 
 
 class StageBlocks(nn.Module):
@@ -443,6 +491,9 @@ class _EmbedIn(nn.Module):
 
 class _HeadOut(nn.Module):
     config: TransformerConfig
+    # resolved loss of the enclosing spec (the pipelined builder resolves
+    # against its mesh); None = meshless resolution
+    loss_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -450,7 +501,7 @@ class _HeadOut(nn.Module):
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
         logits = nn.Dense(cfg.vocab_size, name="lm_head", dtype=cfg.dtype,
                           use_bias=False)(x)
-        return logits.astype(jnp.float32)
+        return _cast_logits(logits, self.loss_name or cfg.resolved_loss)
 
 
 def pipelined_transformer_lm(
@@ -513,8 +564,9 @@ def pipelined_transformer_lm(
     per = config.n_layers // n_stages
     m = num_microbatches or n_stages
 
+    resolved_loss = config.resolved_loss_for(mesh)
     embed_mod = _EmbedIn(config)
-    head_mod = _HeadOut(config)
+    head_mod = _HeadOut(config, loss_name=resolved_loss)
     stage_mod = StageBlocks(config, per=per)  # mesh=None: dense attn in-stage
     if example_batch is None:
         example_batch = mesh.shape["data"] * m
@@ -542,7 +594,7 @@ def pipelined_transformer_lm(
     return ModelSpec(
         init=init,
         apply=apply,
-        loss=config.loss,
+        loss=resolved_loss,
         input_shape=(example_seq,),
         output_shape=(config.vocab_size,),
         name="pipelined_transformer_lm",
@@ -589,7 +641,7 @@ def transformer_lm(
     return ModelSpec(
         init=init,
         apply=module.apply,
-        loss=config.loss,
+        loss=config.resolved_loss_for(mesh),
         input_shape=(example_seq,),
         output_shape=(config.vocab_size,),
         name="transformer_lm",

@@ -34,6 +34,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distriflow_tpu.ops.flop_count import record_pallas_cost
 from distriflow_tpu.parallel.ring_attention import _auto_block
 
 NEG_INF = -1e30
@@ -236,6 +237,15 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     bk = _auto_block(s, block_k)
     n_q, n_kv = s // bq, s // bk
 
+    # model FLOPs: QK^T + PV, each 2*B*H*S*S*D, halved by causal tile-skip —
+    # mirrored into the trace-time tally so mfu() counts custom-call work
+    # (XLA's cost analysis reports 0 for custom calls)
+    record_pallas_cost(
+        flops=4 * b * h * s * s * d // (2 if causal else 1),
+        bytes_accessed=4 * b * h * s * d * q.dtype.itemsize,
+        transcendentals=b * h * s * s // (2 if causal else 1),
+    )
+
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, s, d)
     vf = v.reshape(b * h, s, d)
@@ -283,8 +293,13 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     return out.reshape(b, h, s, d), lse  # lse stays [B*H, S, LANES]
 
 
-_BWD_BLOCK_CAP = 256  # backward holds p/dp/ds tiles live at once: 512-wide
-# tiles spill scoped VMEM (measured 10x slowdown on v5e); 256 is the optimum
+# Backward block cap. Round-2 tuning (fp32-heavy shapes) capped this at 256
+# "to avoid VMEM spills"; re-measured round 3 on bf16 at the flagship shapes,
+# the cost structure is the OPPOSITE: the kernel is grid-step-overhead-bound,
+# and larger tiles win big — B8/H8/S1k/D64 fwd+bwd 2.75 ms @ 256 blocks vs
+# 0.63 ms @ 1024 blocks; B2/H8/S4k/D64 6.48 ms vs 0.94 ms (55% of peak).
+# 2048-wide tiles fail to compile (scoped VMEM), so 1024 is the ceiling.
+_BWD_BLOCK_CAP = 1024
 
 
 def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
@@ -295,6 +310,16 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
     bq = _auto_block(s, min(block_q, _BWD_BLOCK_CAP))
     bk = _auto_block(s, min(block_k, _BWD_BLOCK_CAP))
     n_q, n_kv = s // bq, s // bk
+
+    # model FLOPs of the attention backward: dV = P^T dO, dP = dO V^T,
+    # dQ = dS K, dK = dS^T Q — four matmuls, 8*B*H*S*S*D (2x forward). The
+    # dq/dkv kernels ALSO recompute the scores, but that is remat overhead,
+    # excluded from MFU by convention (see ops/flop_count.py docstring).
+    record_pallas_cost(
+        flops=8 * b * h * s * s * d // (2 if causal else 1),
+        bytes_accessed=8 * b * h * s * d * q.dtype.itemsize,
+        transcendentals=2 * b * h * s * s // (2 if causal else 1),
+    )
 
     # delta_i = rowsum(do_i * o_i): one cheap fused elementwise pass; makes
     # ds = p * (dp - delta) local to each tile (the flash backward identity).
@@ -379,8 +404,9 @@ def flash_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = True,
-    block_q: int = 256,  # 256 tiles are the v5e optimum for the lse-emitting
-    block_k: int = 256,  # forward AND the backward; 512 spills scoped VMEM
+    block_q: int = 1024,  # v5e bf16 optimum (see _BWD_BLOCK_CAP note): the
+    block_k: int = 1024,  # kernel is grid-overhead-bound, so max out tiles;
+    # causal tile-skipping still operates at tile granularity for S > 1024
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Fused attention over ``[B, H, S, D]`` tensors.
@@ -401,8 +427,8 @@ def flash_attention_with_lse(
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = True,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ):
     """Like :func:`flash_attention` but also returns the per-row logsumexp

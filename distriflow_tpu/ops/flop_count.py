@@ -1,0 +1,56 @@
+"""Trace-time tally of Pallas kernel model-FLOPs.
+
+XLA's compiled-program ``cost_analysis()`` reports **zero** FLOPs for custom
+calls, so any program using the Pallas kernels (flash attention, fused CE)
+under-counts its numerator and ``SyncTrainer.mfu()`` could only report a
+lower bound. Each kernel wrapper calls :func:`record_pallas_cost` with its
+analytic cost at *trace* time; ``SyncTrainer.cost_analysis()`` re-traces the
+step abstractly inside :func:`tally_pallas_cost` (``jax.eval_shape`` — no
+compile, no execution) and adds the tally to XLA's numbers, making MFU exact.
+
+Convention: recorded FLOPs are **model FLOPs** (the algorithmic forward +
+backward work), not hardware FLOPs — the flash backward's score recompute is
+rematerialization overhead and is excluded, per the standard MFU definition
+(PaLM appendix B): MFU compares achieved *useful* FLOP/s against peak, so a
+kernel that recomputes does not get credit for the recompute.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Dict, Iterator, Optional
+
+_TALLY: ContextVar[Optional[Dict[str, float]]] = ContextVar(
+    "pallas_cost_tally", default=None
+)
+
+
+def record_pallas_cost(
+    flops: float = 0.0,
+    bytes_accessed: float = 0.0,
+    transcendentals: float = 0.0,
+) -> None:
+    """Add one kernel invocation's analytic cost to the active tally.
+
+    No-op when no tally is active (the common case: normal jit tracing).
+    Call sites run at trace time, once per ``pallas_call`` wiring, so a
+    kernel invoked per-block (ring attention) records once per block with
+    that block's true shapes.
+    """
+    tally = _TALLY.get()
+    if tally is not None:
+        tally["flops"] += float(flops)
+        tally["bytes_accessed"] += float(bytes_accessed)
+        tally["transcendentals"] += float(transcendentals)
+
+
+@contextmanager
+def tally_pallas_cost() -> Iterator[Dict[str, float]]:
+    """Collect Pallas kernel costs recorded while tracing inside the block."""
+    tally = {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0}
+    token = _TALLY.set(tally)
+    try:
+        yield tally
+    finally:
+        _TALLY.reset(token)

@@ -39,6 +39,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distriflow_tpu.ops.flop_count import record_pallas_cost
+
 BLOCK_N = 256   # 256 x 4096 f32 = 4 MB tiles: the measured sweet spot on
 BLOCK_V = 4096  # v5e (2 MB tiles ran 5x slower; 8 MB tiles blow scoped VMEM)
 # backward streams logits in AND grads out (two [bn, bv] tensors double-
@@ -176,6 +178,22 @@ def _default_interpret(interpret):
     return interpret
 
 
+def _record_ce_cost(logits, backward):
+    """Mirror the kernel's analytic cost into the trace-time tally (XLA's
+    cost analysis reports 0 FLOPs for custom calls; see ops/flop_count.py).
+    Forward streams one [N, V] pass (mask, online max/exp-sum, label
+    contraction ~5 ops/element); backward one more (exp, subtract, scale
+    ~3 ops/element). CE is elementwise — negligible next to the lm_head
+    matmul — but recorded so the fused path never reports LESS than the
+    unfused path XLA used to count."""
+    n, v = logits.shape
+    record_pallas_cost(
+        flops=(3 if backward else 5) * n * v,
+        bytes_accessed=(2 if backward else 1) * n * v * logits.dtype.itemsize,
+        transcendentals=n * v,
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def _per_row_sparse_loss(
     logits: jnp.ndarray, labels: jnp.ndarray,
@@ -189,6 +207,7 @@ def _per_row_sparse_loss(
 
 def _sparse_fwd_impl(logits, labels, block_n, block_v, interpret):
     interpret = _default_interpret(interpret)
+    _record_ce_cost(logits, backward=False)
     n_v = (logits.shape[1] + block_v - 1) // block_v
     loss, lse = _ce_call(
         functools.partial(_fwd_kernel, n_v=n_v, sparse=True),
@@ -206,6 +225,7 @@ def _sparse_fwd(logits, labels, block_n, block_v, interpret):
 def _sparse_bwd(block_n, block_v, interpret, res, g):
     logits, labels, lse = res
     interpret = _default_interpret(interpret)
+    _record_ce_cost(logits, backward=True)
     (grad,) = _ce_call(
         functools.partial(_bwd_kernel, sparse=True),
         1, (logits.dtype,), logits.shape[1], block_n,
@@ -233,6 +253,7 @@ def _per_row_loss(
 
 def _dense_fwd_impl(logits, targets, block_n, block_v, interpret):
     interpret = _default_interpret(interpret)
+    _record_ce_cost(logits, backward=False)
     n_v = (logits.shape[1] + block_v - 1) // block_v
     loss, lse = _ce_call(
         functools.partial(_fwd_kernel, n_v=n_v, sparse=False),
@@ -250,6 +271,7 @@ def _dense_fwd(logits, targets, block_n, block_v, interpret):
 def _dense_bwd(block_n, block_v, interpret, res, g):
     logits, targets, lse = res
     interpret = _default_interpret(interpret)
+    _record_ce_cost(logits, backward=True)
     (grad,) = _ce_call(
         functools.partial(_bwd_kernel, sparse=False),
         1, (logits.dtype,), logits.shape[1], block_n,

@@ -212,8 +212,29 @@ def ring_attention(
         # per-chunk Pallas flash + online lse merge: the chunk partials
         # combine exactly because flash exports each row's logsumexp
         from distriflow_tpu.ops.flash_attention import flash_attention_with_lse
+        from distriflow_tpu.ops.flop_count import record_pallas_cost
 
         my_index = lax.axis_index(axis)
+
+        # FLOP-tally compensation: the ring loop below is a fori_loop whose
+        # body traces a fixed number of times but executes n-1 times, so the
+        # in-kernel records do not reflect the executed off-diagonal chunk
+        # attentions. Under grad on current JAX the scan linearize traces
+        # the body's custom-vjp FWD rule twice plus its BWD rule once
+        # (measured; tests/test_ring_attention.py is the tripwire), i.e.
+        # 2*4u + 8u = 16u recorded per trace for u = bhs²d chunk units,
+        # while each of the n-1 executions costs 12u (fwd+bwd, non-causal).
+        # Record the difference so the tally equals the true executed
+        # model-FLOPs of a TRAIN step (the only cost-analysis consumer);
+        # n=2 makes this a small negative correction, which is fine.
+        b_c, h_c, s_c, d_c = qc.shape
+        u_c = b_c * h_c * s_c * s_c * d_c
+        record_pallas_cost(
+            flops=((n - 1) * 12 - 16) * u_c,
+            bytes_accessed=((n - 1) * 12 - 16) * b_c * h_c * s_c * d_c
+            * qc.dtype.itemsize,
+            transcendentals=((n - 1) * 3 - 4) * b_c * h_c * s_c * s_c,
+        )
 
         def chunk_attn(kc, vc, chunk_causal):
             o_i, lse_i = flash_attention_with_lse(qc, kc, vc, chunk_causal)

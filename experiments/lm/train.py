@@ -62,6 +62,9 @@ def main(argv=None) -> float:
     p.add_argument("--attention", choices=("auto", "flash", "blockwise", "ring", "ulysses"),
                    default="auto")
     p.add_argument("--dtype", choices=("bfloat16", "float32"), default="bfloat16")
+    p.add_argument("--loss", default=None,
+                   help="loss registry name (default auto: the Pallas fused "
+                        "sparse CE on TPU, optax sparse CE elsewhere)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks in backward (long-context memory)")
     p.add_argument("--pipeline-schedule", choices=("gpipe", "remat", "1f1b"),
@@ -130,6 +133,7 @@ def main(argv=None) -> float:
         use_ulysses_attention=args.attention == "ulysses",
         remat=args.remat,
         pipeline_schedule=args.pipeline_schedule,
+        loss=args.loss,
     )
     # a pipe axis in --mesh selects the GPipe-staged model (DP x PP x TP);
     # --pipeline-schedule then picks the backward schedule

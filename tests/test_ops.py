@@ -251,3 +251,95 @@ def test_transformer_with_flash_attention():
     logits = spec.apply(params, tokens)
     assert logits.shape == (2, 16, 64)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_pallas_flop_tally_exact():
+    """The trace-time tally (ops/flop_count.py) records exactly the analytic
+    model-FLOPs of each kernel call — fwd 4BHSSD/2 (causal), bwd 2x fwd."""
+    from distriflow_tpu.ops.flop_count import tally_pallas_cost
+
+    b, h, s, d = 2, 2, 64, 16
+    q, k, v = _qkv(b, h, s, d)
+
+    def loss(q):
+        return jnp.sum(flash_attention(q, k, v, True, 32, 32, True))
+
+    with tally_pallas_cost() as tally:
+        jax.eval_shape(jax.grad(loss), q)
+    fwd = 4 * b * h * s * s * d // 2
+    assert tally["flops"] == fwd + 2 * fwd
+    # no active tally -> recording is a no-op (normal tracing unaffected)
+    with tally_pallas_cost() as empty:
+        pass
+    assert empty["flops"] == 0
+
+
+def test_cost_analysis_counts_pallas_flops(devices):
+    """SyncTrainer.cost_analysis reports Pallas kernel model-FLOPs: with
+    flash shard_map'd over the data mesh, pallas_flops is the exact
+    per-device analytic count. On this interpret-mode (CPU) backend the
+    kernels lower to ordinary HLO that XLA already counts, so the tally is
+    reported but NOT folded into 'flops' (folding happens only where the
+    kernels compile to custom calls — TPU — where XLA counts them as 0)."""
+    from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+    from distriflow_tpu.parallel.mesh import data_parallel_mesh
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    mesh = data_parallel_mesh(devices)
+    b, s = 8, 64
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq=s, dtype=jnp.float32, use_flash_attention=True,
+        loss="sparse_softmax_cross_entropy",  # keep CE out of the tally
+    )
+    spec = transformer_lm(cfg, mesh=mesh, example_seq=s)
+    trainer = SyncTrainer(spec, mesh=mesh)
+    trainer.init()
+    x = jnp.zeros((b, s), jnp.int32)
+    y = jnp.zeros((b, s), jnp.int32)
+    analysis = trainer.cost_analysis((x, y))
+    # per-device slice: shard_map gives each device b/8 rows
+    u_fwd = 4 * (b // 8) * cfg.n_heads * s * s * (cfg.d_model // cfg.n_heads) // 2
+    expected = cfg.n_layers * (u_fwd + 2 * u_fwd)
+    assert analysis["pallas_flops"] == expected
+    # interpret backend: no fold (XLA already counted the kernel HLO)
+    assert analysis["flops"] == analysis["xla_flops"]
+    assert analysis["flops"] > analysis["pallas_flops"]  # XLA part present
+    # mfu() consumes the numerator without raising
+    mfu = trainer.mfu((x, y), step_seconds=1.0, peak_flops_per_chip=1e12)
+    assert mfu > 0
+
+
+def test_flagship_loss_resolution(devices, monkeypatch):
+    """loss=None resolves per-backend at spec-build time: fused sparse CE
+    when the Pallas kernels compile (TPU) AND the mesh is single-device
+    (pallas has no GSPMD rule — a multi-device mesh would all-gather the
+    global logits), plain optax CE elsewhere; an explicit loss is always
+    honored."""
+    import distriflow_tpu.models.transformer as tmod
+    from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+    from distriflow_tpu.parallel.mesh import data_parallel_mesh
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype=jnp.float32)
+    assert cfg.resolved_loss == "sparse_softmax_cross_entropy"  # CPU backend
+    monkeypatch.setattr(tmod, "_default_use_flash", lambda: True)
+    assert cfg.resolved_loss == "fused_sparse_softmax_cross_entropy"
+    assert transformer_lm(cfg, example_seq=8).loss == (
+        "fused_sparse_softmax_cross_entropy"
+    )
+    # multi-device mesh: auto resolution backs off to the sharded XLA loss
+    mesh = data_parallel_mesh(devices)
+    assert cfg.resolved_loss_for(mesh) == "sparse_softmax_cross_entropy"
+    assert transformer_lm(cfg, mesh=mesh, example_seq=8).loss == (
+        "sparse_softmax_cross_entropy"
+    )
+    # ... but an explicit fused choice is honored even on a mesh
+    fused_cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        dtype=jnp.float32, loss="fused_sparse_softmax_cross_entropy")
+    assert fused_cfg.resolved_loss_for(mesh) == "fused_sparse_softmax_cross_entropy"
+    explicit = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                 n_layers=1, d_ff=64, dtype=jnp.float32,
+                                 loss="softmax_cross_entropy")
+    assert explicit.resolved_loss == "softmax_cross_entropy"

@@ -99,3 +99,30 @@ def test_ring_flash_matches_dense(devices, causal):
         argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_ring_flash_flop_tally_compensates_loop(devices):
+    """The ring loop body's kernel records don't match its n-1 executions;
+    the compensation in local_flash corrects the tally to the TRUE executed
+    fwd+bwd model-FLOPs: diag (causal, fwd+bwd = 6u) plus (n-1)
+    off-diagonal chunks (12u each). TRIPWIRE: the correction assumes the
+    current JAX scan-linearize trace multiplicity (fwd rule twice, bwd
+    once); if a JAX upgrade changes that, this equality breaks and the
+    constant in ring_attention.local_flash needs re-measuring."""
+    from distriflow_tpu.ops.flop_count import tally_pallas_cost
+
+    n = 8
+    mesh = create_mesh(MeshConfig(seq=n), devices)
+    b, h, s, d = 2, 2, 128, 16
+    q = jnp.zeros((b, h, s, d), jnp.float32)
+
+    def loss(q):
+        return jnp.sum(ring_attention(q, q, q, mesh, causal=True,
+                                      use_flash=True))
+
+    with tally_pallas_cost() as tally:
+        jax.eval_shape(jax.grad(loss), q)
+    s_c = s // n
+    u = b * h * s_c * s_c * d
+    expected = 6 * u + (n - 1) * 12 * u
+    assert tally["flops"] == expected, (tally["flops"], expected)
