@@ -27,6 +27,7 @@ Set ``BENCH_FAST=1`` for a quick smoke run (fewer steps, skips #5/#6).
 from __future__ import annotations
 
 import json
+from functools import partial
 import os
 import sys
 import time
@@ -36,7 +37,7 @@ FAST = bool(int(os.environ.get("BENCH_FAST", "0")))
 # wall-clock budget: configs that would start after this many seconds are
 # skipped (recorded as skipped) so the final JSON line ALWAYS lands even if
 # the tunnel is slow — a killed bench records nothing at all otherwise
-BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "400"))
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "450"))
 HIDDEN = 10  # reference parity arch: flatten -> dense(10, relu) -> dense(10)
 _T0 = time.monotonic()
 
@@ -59,7 +60,37 @@ def _one_hot(rng, n, k, classes=10):
     return np.eye(classes, dtype=np.float32)[rng.randint(0, classes, (n, k))]
 
 
-def _timed_chunked(trainer, make_chunk, steps, rounds, batch, reps=3):
+def _device_chunk(trainer, k, b, x_shape, classes, one_hot=True, seed=0):
+    """Generate a [K, B, ...] synthetic chunk ON DEVICE (jitted PRNG).
+
+    Round-3: the round-2 bench built chunks on the host and paid the
+    host->device transfer for them — up to ~400 MB per leg over the
+    tunneled backend, which dominated leg wall time and the driver budget.
+    Synthetic data carries no information worth uploading; generating it
+    device-side leaves the timing to what the row measures."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(trainer.mesh, P(None, "data"))
+
+    @partial(jax.jit, static_argnums=0, out_shardings=(sharding, sharding))
+    def make(shape, key):
+        kx, ky = jax.random.split(key)
+        x = jax.random.normal(kx, (k, b) + tuple(shape), jnp.float32)
+        labels = jax.random.randint(ky, (k, b), 0, classes)
+        y = (jax.nn.one_hot(labels, classes, dtype=jnp.float32)
+             if one_hot else labels.astype(jnp.int32))
+        return x, y
+
+    chunk = make(tuple(x_shape), jax.random.PRNGKey(seed))
+    for v in chunk:
+        _fetch(v)
+    return chunk
+
+
+def _timed_chunked(trainer, make_chunk, steps, rounds, batch, reps=3,
+                   device_chunk=None):
     """Stage a K-step chunk on device, warm/compile at the measured scan
     length, then time a 1-dispatch leg and a ``rounds``-dispatch leg —
     each as the MIN over ``reps`` repetitions — and difference them:
@@ -68,15 +99,19 @@ def _timed_chunked(trainer, make_chunk, steps, rounds, batch, reps=3):
     RTT jitter (~±50ms per trip, which would otherwise swamp small
     models). ``dispatch_ms`` reports the min-of-reps single-dispatch
     time. Use ``reps=2`` for compute-dominated configs where device time
-    already dwarfs the jitter."""
+    already dwarfs the jitter. ``device_chunk`` (already device-resident,
+    from :func:`_device_chunk`) skips the host->device upload entirely."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sharding = NamedSharding(trainer.mesh, P(None, "data"))
-    measured = jax.tree.map(
-        lambda v: jax.device_put(v, sharding), make_chunk(steps))
-    for v in measured:  # device_put can be lazy: force the transfer NOW
-        _fetch(v)
+    if device_chunk is not None:
+        measured = device_chunk
+    else:
+        sharding = NamedSharding(trainer.mesh, P(None, "data"))
+        measured = jax.tree.map(
+            lambda v: jax.device_put(v, sharding), make_chunk(steps))
+        for v in measured:  # device_put can be lazy: force the transfer NOW
+            _fetch(v)
     losses = trainer.step_many(measured)  # compile at the MEASURED length
     _fetch(losses[-1])
 
@@ -130,12 +165,10 @@ def bench_mnist_sync(n_chips):
     trainer.init(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
 
-    def make_chunk(k):
-        x = rng.randn(k, B, 28, 28, 1).astype(np.float32)
-        return x, _one_hot(rng, k, B)
-
-    r = _timed_chunked(trainer, make_chunk, steps=50 if FAST else 120,
-                       rounds=3 if FAST else 20, batch=B)
+    steps = 50 if FAST else 120
+    chunk = _device_chunk(trainer, steps, B, (28, 28, 1), 10)
+    r = _timed_chunked(trainer, None, steps=steps,
+                       rounds=3 if FAST else 20, batch=B, device_chunk=chunk)
     # sync-SGD allreduce step latency (BASELINE.md primary metric): the
     # device-side per-step time of the full fwd+bwd -> XLA-allreduced
     # grads -> update program (the scanned per-step time above). The
@@ -175,7 +208,7 @@ def bench_torch_mlp():
 
     for _ in range(5):
         step()
-    n = 50 if FAST else 120
+    n = 30 if FAST else 60
     start = time.perf_counter()
     for _ in range(n):
         step()
@@ -195,18 +228,23 @@ def bench_cifar_sync(n_chips):
     from distriflow_tpu.parallel import data_parallel_mesh
     from distriflow_tpu.train.sync import SyncTrainer
 
-    B = 512
+    # round-3 tuned config (docs/PERFORMANCE.md §conv rows): bf16 compute +
+    # batch 2048. bf16 at the old B=512 is LOSS-making (3.9 ms vs 2.1 f32 —
+    # too little work per conv to amortize), but at B=2048 it is the clear
+    # winner: 6.2 ms vs 12.6 f32. r02 ran f32 @ B=512: 200k samples/s, 0.22.
+    import jax.numpy as jnp
+
+    B = 2048
     mesh = data_parallel_mesh(jax.devices())
-    trainer = SyncTrainer(cifar_convnet(), mesh=mesh, learning_rate=0.01)
+    trainer = SyncTrainer(cifar_convnet(dtype=jnp.bfloat16), mesh=mesh,
+                          learning_rate=0.01)
     trainer.init(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
 
-    def make_chunk(k):
-        x = rng.randn(k, B, 32, 32, 3).astype(np.float32)
-        return x, _one_hot(rng, k, B)
-
-    r = _timed_chunked(trainer, make_chunk, steps=10 if FAST else 20,
-                       rounds=3 if FAST else 4, batch=B)
+    steps = 8 if FAST else 12
+    chunk = _device_chunk(trainer, steps, B, (32, 32, 3), 10)
+    r = _timed_chunked(trainer, None, steps=steps,
+                       rounds=3 if FAST else 4, batch=B, device_chunk=chunk)
     lat_x = rng.randn(B, 32, 32, 3).astype(np.float32)
     lat_y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, B)]
     mfu = _mfu_or_none(trainer, (lat_x, lat_y), r["step_ms"] / 1e3)
@@ -221,6 +259,7 @@ def bench_cifar_sync(n_chips):
         "dispatch_ms": r["dispatch_ms"],
         "mfu": mfu,
         "batch": B,
+        "dtype": "bfloat16",
         "final_loss": round(r["final_loss"], 4),
     }
 
@@ -251,7 +290,7 @@ def bench_torch_cifar():
 
     for _ in range(2):
         step()
-    n = 3 if FAST else 10
+    n = 3 if FAST else 5
     start = time.perf_counter()
     for _ in range(n):
         step()
@@ -271,8 +310,13 @@ def bench_cifar_async():
     from distriflow_tpu.models import cifar_convnet
     from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
 
-    B = 256
-    n_batches = 8 if FAST else 16
+    # round-3: steps_per_upload amortizes the host ping-pong (the r02 row
+    # measured an 89x penalty at one dispatch per batch); 4 workers against
+    # a tight staleness bound make the rejection/decay machinery FIRE on
+    # hardware (r02 ran 2 workers under a loose bound: rejected=0 always).
+    B, K = 256, 8
+    n_batches = 32 if FAST else 96
+    max_stale = 2
     rng = np.random.RandomState(0)
     x = rng.randn(n_batches * B, 32, 32, 3).astype(np.float32)
     y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n_batches * B)]
@@ -280,24 +324,28 @@ def bench_cifar_async():
     trainer = AsyncSGDTrainer(
         cifar_convnet(), dataset,
         learning_rate=0.01,
-        hyperparams={"maximum_staleness": 4, "staleness_decay": 0.7},
+        steps_per_upload=K,
+        hyperparams={"maximum_staleness": max_stale, "staleness_decay": 0.7},
     )
     trainer.init(jax.random.PRNGKey(0))
-    # warm: run a couple of batches through one worker (compiles grad+apply)
-    trainer.worker_loop(0, max_steps=2)
-    warm = trainer.applied_updates + trainer.rejected_updates
+    # warm: one full K-group through one worker (compiles scan-grad + apply)
+    trainer.worker_loop(0, max_steps=K)
+    warm_batches = K
     start = time.perf_counter()
-    trainer.train(num_workers=2)
+    trainer.train(num_workers=4)
     elapsed = time.perf_counter() - start
-    processed = trainer.applied_updates + trainer.rejected_updates - warm
+    processed = n_batches - warm_batches
     sps = processed * B / elapsed
     log(f"#3 cifar async: {sps:.0f} samples/s ({processed} batches, "
-        f"applied={trainer.applied_updates} rejected={trainer.rejected_updates})")
+        f"K={K}/upload, applied={trainer.applied_updates} "
+        f"rejected={trainer.rejected_updates})")
     return {
         "config": "cifar10_convnet_async_bounded_staleness",
         "metric": "samples/sec",
         "value": round(sps, 1),
-        "maximum_staleness": 4,
+        "steps_per_upload": K,
+        "workers": 4,
+        "maximum_staleness": max_stale,
         "staleness_decay": 0.7,
         "applied_updates": trainer.applied_updates,
         "rejected_updates": trainer.rejected_updates,
@@ -363,20 +411,29 @@ def bench_mobilenet(n_chips):
     from distriflow_tpu.parallel import data_parallel_mesh
     from distriflow_tpu.train.sync import SyncTrainer
 
-    B, size, classes = 64, 96, 100  # imagenet-subset shapes (experiments/)
+    # round-3 tuned config (docs/PERFORMANCE.md §conv rows): bf16 compute
+    # (params stay f32), batch 256 — the measured optimum; 384+ falls off a
+    # working-set cliff (12+ ms) and img sizes that don't halve cleanly
+    # through the five stride-2 stages (96 -> 48/24/12/6/3) tile worse than
+    # they look. r02 ran f32 @ B=64: 17.7k samples/s, mfu 0.033.
+    B, size, classes = 256, 96, 100  # imagenet-subset shapes (experiments/)
+    import jax.numpy as jnp
+
     mesh = data_parallel_mesh(jax.devices())
-    trainer = SyncTrainer(mobilenet_v2(image_size=size, classes=classes),
-                          mesh=mesh, learning_rate=0.01)
+    trainer = SyncTrainer(
+        mobilenet_v2(image_size=size, classes=classes, dtype=jnp.bfloat16),
+        mesh=mesh, learning_rate=0.01)
     trainer.init(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
 
-    def make_chunk(k):
-        x = rng.randn(k, B, size, size, 3).astype(np.float32)
-        y = np.eye(classes, dtype=np.float32)[rng.randint(0, classes, (k, B))]
-        return x, y
-
     # only runs in the non-FAST bench, so no FAST branch here
-    r = _timed_chunked(trainer, make_chunk, steps=8, rounds=2, batch=B, reps=2)
+    # steps=8 is a hard ceiling here: a 16-step chunk's jit-output copy
+    # picks a (8,128)-tiled layout that lane-pads the trailing channel dim
+    # 3 -> 128 (a 42x HBM blowup, >19 GB — compile fails); reps=4 instead
+    # to suppress the tunnel's bimodal differencing at short chunks
+    chunk = _device_chunk(trainer, 8, B, (size, size, 3), classes)
+    r = _timed_chunked(trainer, None, steps=8, rounds=3, batch=B, reps=4,
+                       device_chunk=chunk)
     x1 = rng.randn(B, size, size, 3).astype(np.float32)
     y1 = np.eye(classes, dtype=np.float32)[rng.randint(0, classes, B)]
     mfu = _mfu_or_none(trainer, (x1, y1), r["step_ms"] / 1e3)
@@ -390,6 +447,265 @@ def bench_mobilenet(n_chips):
         "mfu": mfu,
         "image_size": size,
         "batch": B,
+        "dtype": "bfloat16",
+    }
+
+
+# -- decode: prefill + per-token latency + batched serving -----------------
+
+
+def bench_decode(n_chips):
+    """Decode row (round-3): prefill ms, per-token ms, and decode tokens/s
+    at ~1k and ~4k context on flagship dims (greedy, KV-cache scan), plus
+    the InferenceServer micro-batching speedup — 8 concurrent greedy
+    clients vs the same 8 requests serialized."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distriflow_tpu.models.generate import _build_fns
+    from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+
+    B, GEN = 8, 128
+    rng = np.random.RandomState(0)
+    mk_cfg = lambda s: TransformerConfig(
+        vocab_size=32000, d_model=512, n_heads=8, n_layers=8, d_ff=2048,
+        max_seq=s, dtype=jnp.bfloat16)
+    # params are max_seq-independent: one init serves both context lengths
+    params = transformer_lm(mk_cfg(4096), example_seq=128).init(
+        jax.random.PRNGKey(0))
+
+    def timed(fn, *args, reps=3):
+        fn(*args)  # compile/warm
+        def once(n):
+            start = time.perf_counter()
+            out = None
+            for _ in range(n):
+                out = fn(*args)
+            _fetch(jax.tree.leaves(out)[0])
+            return time.perf_counter() - start
+        t1 = min(once(1) for _ in range(reps))
+        t3 = min(once(3) for _ in range(reps))
+        return max((t3 - t1) / 2, 1e-9)
+
+    contexts = []
+    for s_ctx in (1024, 4096):
+        cfg = mk_cfg(s_ctx)
+        prompt = jnp.asarray(
+            rng.randint(0, 32000, (B, s_ctx - GEN)), jnp.int32)
+        prefill, pick, decode_steps = _build_fns(cfg, GEN, 0.0, None, None, None)
+        t_prefill = timed(prefill, params, prompt)
+        last, cache = prefill(params, prompt)
+        first = pick(last, jax.random.PRNGKey(0)).astype(jnp.int32)
+        key = jax.random.PRNGKey(1)
+        t_decode = timed(decode_steps, params, cache, first, key)
+        per_tok_ms = t_decode * 1e3 / (GEN - 1)
+        row = {
+            "context": s_ctx,
+            "prefill_ms": round(t_prefill * 1e3, 2),
+            "per_token_ms": round(per_tok_ms, 3),
+            "tokens_per_sec": round(B * 1e3 / per_tok_ms, 1),
+        }
+        log(f"decode ctx={s_ctx}: prefill {row['prefill_ms']} ms, "
+            f"{row['per_token_ms']} ms/token, {row['tokens_per_sec']} tok/s (B={B})")
+        contexts.append(row)
+
+    # serving: 8 concurrent greedy clients vs 8 serialized requests. The
+    # micro-batcher folds the concurrent ones into ~1 device program.
+    import threading
+
+    from distriflow_tpu.client import InferenceClient
+    from distriflow_tpu.server import InferenceServer
+
+    cfg = mk_cfg(1024)
+    server = InferenceServer(cfg, params, port=0).setup()
+    try:
+        prompts = [rng.randint(0, 32000, (1, 64)).astype(np.int32)
+                   for _ in range(8)]
+        with InferenceClient(server.address).setup() as c:
+            c.generate(prompts[0], n_tokens=32)  # compile/warm bucket-1 shape
+        # warm the full bucket-8 shape (the throwaway concurrent round
+        # below compiles any other bucket pattern that forms); a cold
+        # bucket compile (~20 s over a remote backend) would otherwise
+        # swamp the serving measurement
+        from distriflow_tpu.models.generate import generate as _gen
+        stackp = np.concatenate(prompts)
+        _fetch(_gen(cfg, params, jnp.asarray(stackp), 32))
+
+        start = time.perf_counter()
+        with InferenceClient(server.address).setup() as c:
+            for p in prompts:
+                c.generate(p, n_tokens=32)
+        t_seq = time.perf_counter() - start
+
+        # connections are NOT part of the serving measurement: set up all 8
+        # clients first, then time only the barrier-released generate calls
+        clients = [InferenceClient(server.address).setup() for _ in range(8)]
+        try:
+            def one_round():
+                results = [None] * 8
+                barrier = threading.Barrier(8)
+
+                def call(i):
+                    barrier.wait()
+                    results[i] = clients[i].generate(prompts[i], n_tokens=32)
+
+                threads = [threading.Thread(target=call, args=(i,))
+                           for i in range(8)]
+                start = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                assert all(r is not None for r in results)
+                return time.perf_counter() - start
+
+            one_round()  # warm: the first batched dispatch from the server
+            # context pays a one-time ~600 ms retrace/session cost (measured;
+            # subsequent rounds are steady-state)
+            t_conc = min(one_round() for _ in range(2))
+        finally:
+            for c in clients:
+                c.close()
+        speedup = t_seq / t_conc
+        log(f"decode serving: 8 sequential {t_seq*1e3:.0f} ms vs concurrent "
+            f"{t_conc*1e3:.0f} ms -> {speedup:.2f}x "
+            f"(batches={server.decode_batches}, reqs={server.batched_requests})")
+    finally:
+        server.stop()
+
+    return {
+        "config": "decode_flagship",
+        "metric": "tokens/sec (decode, B=8)",
+        "value": contexts[0]["tokens_per_sec"],
+        "batch": B,
+        "gen_tokens": GEN,
+        "contexts": contexts,
+        "serving_batched_speedup_8clients": round(speedup, 2),
+        "dtype": "bfloat16",
+    }
+
+
+# -- flagship MoE: Switch top-1 / GShard top-2 on the real chip ------------
+
+
+def bench_moe(n_chips, matrix):
+    """MoE rows (round-3): tokens/s + exact MFU for Switch top-1 and GShard
+    top-2 at flagship dims, a routing-overhead ratio vs the dense flagship
+    row measured in the same run, and a capacity_factor sweep with MEASURED
+    drop rates (the ``moe_stats`` collection)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distriflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        transformer_lm,
+    )
+    from distriflow_tpu.parallel import data_parallel_mesh
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    B, S, E = 8, 1024, 8
+    MOE_LAYERS = 2  # a quarter of the flagship depth: the routing cost is per-layer
+    # (overhead reported per-layer-normalized below); halves the leg's
+    # compile wall time, which dominates under the driver budget
+    mesh = data_parallel_mesh(jax.devices())
+    rng = np.random.RandomState(0)
+    dense = next(
+        (e for e in matrix if e.get("config") == "transformer_lm_flagship"), {})
+    variants = []
+    shared_params = None  # top-1/top-2 share the SAME param tree (the
+    # router is Dense(E) either way) — init once, skip a jitted-init compile
+    for k, name in ((1, "switch_top1"), (2, "gshard_top2")):
+        cfg = TransformerConfig(
+            vocab_size=32000, d_model=512, n_heads=8, n_layers=MOE_LAYERS,
+            d_ff=2048, max_seq=S, n_experts=E, moe_top_k=k,
+            dtype=jnp.bfloat16)
+        spec = transformer_lm(cfg, mesh=mesh, example_seq=S)
+        trainer = SyncTrainer(spec, mesh=mesh, learning_rate=1e-3,
+                              optimizer="adam")
+        if shared_params is None:
+            trainer.init(jax.random.PRNGKey(0))
+            import jax.numpy as _jnp
+
+            # COPY before training: step_many donates the trainer state,
+            # which would delete the initial buffers we hand to variant 2
+            shared_params = jax.tree.map(_jnp.copy, trainer.get_params())
+        else:
+            trainer.set_params(shared_params)
+
+        def make_chunk(kk):
+            t = rng.randint(0, 32000, (kk, B, S + 1))
+            return (np.asarray(t[:, :, :-1], np.int32),
+                    np.asarray(t[:, :, 1:], np.int32))
+
+        # rounds=3/reps=3: with rounds=2/reps=2 a single slow t_one outlier
+        # once produced an impossible MFU 1.84 row — the differenced signal
+        # must dominate the ~±50 ms dispatch jitter
+        r = _timed_chunked(trainer, make_chunk, steps=6, rounds=3, batch=B,
+                           reps=3)
+        x1, y1 = (v[0] for v in make_chunk(1))
+        mfu = _mfu_or_none(trainer, (x1, y1), r["step_ms"] / 1e3)
+        toks = r["samples_per_sec"] * S
+        row = {
+            "variant": name,
+            "tokens_per_sec_per_chip": round(toks / n_chips, 1),
+            "step_ms": round(r["step_ms"], 3),
+            "mfu": mfu,
+            "final_loss": round(r["final_loss"], 4),
+        }
+        if dense.get("step_ms") and dense.get("n_layers"):
+            # per-LAYER ratio vs the dense flagship (depths differ): >1 =
+            # routing/dispatch cost; MoE runs E-fold params at ~1x
+            # per-token FFN FLOPs, so this ratio IS the routing overhead.
+            # Slightly flattering to MoE (the dense row amortizes its
+            # embed/lm_head over more layers) — noted, not hidden.
+            row["routing_overhead_vs_dense_per_layer"] = round(
+                (r["step_ms"] / MOE_LAYERS)
+                / (dense["step_ms"] / dense["n_layers"]), 3)
+        log(f"moe {name}: {toks:.0f} tokens/s ({r['step_ms']:.2f} ms/step, "
+            f"mfu={mfu})")
+        variants.append(row)
+
+    # capacity_factor sweep with MEASURED drop rates. Drop rate is a
+    # property of the router balance and capacity formula — deterministic
+    # math, not a hardware number — so the sweep runs on the in-process
+    # CPU backend (depth-1 f32 model): zero TPU wall clock under the
+    # driver budget.
+    base = TransformerConfig(
+        vocab_size=32000, d_model=512, n_heads=8, n_layers=1, d_ff=2048,
+        max_seq=S, n_experts=E, moe_top_k=2, dtype=jnp.float32,
+        use_flash_attention=False)
+    cpu = jax.local_devices(backend="cpu")[0]
+    sweep = []
+    with jax.default_device(cpu):
+        spec2 = transformer_lm(base, example_seq=S)
+        params2 = spec2.init(jax.random.PRNGKey(0))
+        xs = jnp.asarray(rng.randint(0, 32000, (B, S)), jnp.int32)
+        for f in (1.0, 1.25, 2.0):
+            cfg_f = dataclasses.replace(base, capacity_factor=f)
+            mod = TransformerLM(cfg_f)
+            stats = jax.jit(
+                lambda p, x, m=mod: m.apply(p, x, mutable=["moe_stats"])[1]
+            )(params2, xs)
+            drop = float(np.mean([np.asarray(v).mean()
+                                  for v in jax.tree.leaves(stats)]))
+            sweep.append({"capacity_factor": f,
+                          "dropped_fraction": round(drop, 4)})
+    log(f"moe capacity sweep (top-2, cpu-exact): {sweep}")
+    return {
+        "config": "transformer_moe_flagship",
+        "metric": "tokens/sec/chip",
+        "value": variants[0]["tokens_per_sec_per_chip"],
+        "n_experts": E,
+        "capacity_factor": 1.25,
+        "d_model": 512, "n_layers": MOE_LAYERS, "seq_len": S, "batch": B,
+        "dtype": "bfloat16",
+        "variants": variants,
+        "capacity_sweep_top2": sweep,
     }
 
 
@@ -423,7 +739,7 @@ def bench_transformer(n_chips):
                 np.asarray(t[:, :, 1:], np.int32))
 
     r = _timed_chunked(trainer, make_chunk, steps=3 if FAST else 6,
-                       rounds=2 if FAST else 3, batch=B, reps=2)
+                       rounds=2, batch=B, reps=3)
     x1, y1 = (v[0] for v in make_chunk(1))
     mfu = _mfu_or_none(trainer, (x1, y1), r["step_ms"] / 1e3)
     toks = r["samples_per_sec"] * S
@@ -472,16 +788,19 @@ def main() -> None:
         log(f"[{fn.__name__}: {time.monotonic() - t0:.0f}s, "
             f"total {time.monotonic() - _T0:.0f}s]")
 
-    # importance order under the budget: primary parity config first, then
-    # the flagship MFU story, then the rest of the BASELINE matrix
-    run(bench_mnist_sync, n_chips)
+    # importance order under the budget: the real-model rows lead (the
+    # round-2 verdict: the MNIST dispatch-arithmetic number is the easiest
+    # possible config and should not headline), then the BASELINE matrix
     run(bench_cifar_sync, n_chips)
     if not FAST:
         run(bench_transformer, n_chips)
+    run(bench_mnist_sync, n_chips)
     run(bench_cifar_async)
     run(bench_fedavg)
     if not FAST:
         run(bench_mobilenet, n_chips)
+        run(bench_decode, n_chips)
+        run(bench_moe, n_chips, matrix)
 
     baselines = {}
     for name, fn in (("mnist_mlp_sync", bench_torch_mlp),
@@ -496,9 +815,14 @@ def main() -> None:
         if base and "value" in entry:
             entry["vs_baseline"] = round(entry["value"] * n_chips / base, 3)
 
-    primary = matrix[0] if matrix and "value" in matrix[0] else {}
+    # headline: the CIFAR sync row — a real model with a real measured
+    # torch baseline (the round-2 verdict: don't headline the MNIST
+    # dispatch-arithmetic number). The transformer MFU story is row #2.
+    primary = next(
+        (e for e in matrix
+         if "value" in e and e.get("config") == "cifar10_convnet_sync"), {})
     result = {
-        "metric": "MNIST MLP sync-SGD throughput (batch 1024, fp32)",
+        "metric": "CIFAR-10 ConvNet sync-SGD throughput (bf16, batch 2048)",
         "value": primary.get("value"),
         "unit": "samples/sec/chip",
         "vs_baseline": primary.get("vs_baseline"),

@@ -33,6 +33,7 @@ import asyncio
 import itertools
 import struct
 import threading
+import sys
 import time
 import uuid
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -189,7 +190,7 @@ class ServerTransport:
                 endpoint = self._clients.get(client_id)
                 if endpoint is not None and seen < cutoff:
                     print(f"[transport] reaping silent client {client_id[:8]} "
-                          f"(no traffic for {self.heartbeat_timeout:.0f}s)", flush=True)
+                          f"(no traffic for {self.heartbeat_timeout:.0f}s)", file=sys.stderr, flush=True)
                     endpoint.writer.close()
 
     async def _handle_client(
@@ -206,7 +207,7 @@ class ServerTransport:
                 try:
                     self.on_connect(cid)
                 except Exception as e:
-                    print(f"[transport] on_connect error: {e!r}", flush=True)
+                    print(f"[transport] on_connect error: {e!r}", file=sys.stderr, flush=True)
 
             await self._loop.run_in_executor(None, _safe_connect)
         async def dispatch(msg: Dict[str, Any]) -> None:
@@ -221,7 +222,7 @@ class ServerTransport:
                 except Exception as e:
                     # a failing handler must not kill the connection
                     print(f"[transport] handler {msg.get('event')!r} error: {e!r}",
-                          flush=True)
+                          file=sys.stderr, flush=True)
                     result = None
             if "msg_id" in msg:
                 try:
@@ -250,7 +251,7 @@ class ServerTransport:
             pass
         except ValueError as e:
             # malformed frame (port scanner, protocol mismatch): drop quietly
-            print(f"[transport] closing client {client_id[:8]}: {e}", flush=True)
+            print(f"[transport] closing client {client_id[:8]}: {e}", file=sys.stderr, flush=True)
         finally:
             self._clients.pop(client_id, None)
             self._last_seen.pop(client_id, None)
@@ -260,7 +261,7 @@ class ServerTransport:
                     try:
                         self.on_disconnect(cid)
                     except Exception as e:
-                        print(f"[transport] on_disconnect error: {e!r}", flush=True)
+                        print(f"[transport] on_disconnect error: {e!r}", file=sys.stderr, flush=True)
 
                 self._loop.run_in_executor(None, _safe_disconnect)
 
@@ -356,7 +357,7 @@ class ClientTransport:
                         > self.heartbeat_timeout
                     ):
                         print("[transport] server lost (no frames for "
-                              f"{self.heartbeat_timeout:.0f}s)", flush=True)
+                              f"{self.heartbeat_timeout:.0f}s)", file=sys.stderr, flush=True)
                         if self.on_server_lost is not None:
                             await self._loop.run_in_executor(None, self.on_server_lost)
                         writer.close()
@@ -374,7 +375,7 @@ class ClientTransport:
                         )
                     except Exception as e:
                         print(f"[transport] client handler "
-                              f"{msg.get('event')!r} error: {e!r}", flush=True)
+                              f"{msg.get('event')!r} error: {e!r}", file=sys.stderr, flush=True)
 
             try:
                 while True:
@@ -390,12 +391,12 @@ class ClientTransport:
             except (asyncio.IncompleteReadError, ConnectionResetError):
                 # server went away (EOF/reset) without us calling close()
                 if not self._stopped and self.on_server_lost is not None:
-                    print("[transport] server connection lost", flush=True)
+                    print("[transport] server connection lost", file=sys.stderr, flush=True)
                     await self._loop.run_in_executor(None, self.on_server_lost)
             except asyncio.CancelledError:
                 pass
             except ValueError as e:
-                print(f"[transport] closing connection: {e}", flush=True)
+                print(f"[transport] closing connection: {e}", file=sys.stderr, flush=True)
             finally:
                 writer.close()
 

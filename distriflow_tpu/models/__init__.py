@@ -23,6 +23,7 @@ from distriflow_tpu.models.keras_import import (
     export_keras_weights,
     spec_from_keras_h5,
     spec_from_keras_json,
+    spec_from_url,
 )
 from distriflow_tpu.models.mobilenet import MobileNetV2, mobilenet_v2
 from distriflow_tpu.models.transformer import (
@@ -63,5 +64,6 @@ __all__ = [
     "export_keras_weights",
     "spec_from_keras_h5",
     "spec_from_keras_json",
+    "spec_from_url",
     "with_uint8_inputs",
 ]

@@ -417,6 +417,17 @@ def fetch_model(source: ModelSource, **kw: Any) -> DistributedModel:
             raise TypeError(f"model factory must return a ModelSpec, got {type(spec)}")
         return SpecModel(spec, **kw)
     if isinstance(source, str):
+        if source.startswith(("http://", "https://")):
+            # the reference's string-URL source: tf.loadLayersModel(url)
+            # with URL-relative weight shards (utils.ts:236-244)
+            from distriflow_tpu.models import keras_import
+
+            spec_kw = {
+                k: kw.pop(k)
+                for k in ("input_shape", "loss", "logits_output", "load_weights", "dtype")
+                if k in kw
+            }
+            return SpecModel(keras_import.spec_from_url(source, **spec_kw), **kw)
         if source.endswith((".json", ".h5", ".hdf5")):
             from distriflow_tpu.models import keras_import
 

@@ -21,6 +21,15 @@ Divergence from a *capacity-routed* training forward is bounded by the
 tokens training itself dropped: zero with ample ``capacity_factor``,
 quantified in tests/test_generate.py for tight capacity. Dense-FFN configs
 decode exactly (teacher-forcing logits match the training forward).
+
+**TP-sharded decoding** (round 3): pass Megatron-sharded params (the
+``TRANSFORMER_TP_RULES`` layout) and the SAME jit-cached programs decode
+tensor-parallel — no bespoke path. GSPMD propagates the column-sharded
+q/k/v projections into a heads-sharded KV cache, keeps the attention
+einsums head-parallel, and row-shards + psums ``o_proj``; output is
+token-for-token identical to single-device decode (greedy, sampled, and
+beam — tests/test_tp_decode.py). The ``InferenceServer`` therefore serves
+model-sharded params unchanged.
 """
 
 from __future__ import annotations

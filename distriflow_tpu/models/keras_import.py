@@ -1709,6 +1709,97 @@ def _spec_from_topology(
     )
 
 
+def spec_from_url(
+    url: str,
+    input_shape: Optional[Sequence[int]] = None,
+    loss: str = "softmax_cross_entropy",
+    logits_output: bool = True,
+    load_weights: bool = True,
+    dtype: Any = jnp.float32,
+    timeout: float = 30.0,
+) -> ModelSpec:
+    """Load a tfjs-layers ``model.json`` (or Keras ``.h5``) over HTTP(S).
+
+    The reference's string-URL model source: ``fetchModel`` passes a URL
+    straight to ``tf.loadLayersModel(url)`` (``src/common/utils.ts:236-244``
+    -> ``src/common/models.ts:92-100``), which resolves each
+    ``weightsManifest`` shard RELATIVE to the model.json URL. Same semantics
+    here: the topology downloads into a temp dir, shards resolve via
+    ``urljoin`` and download next to it, and the local loaders run
+    unchanged (weights are read eagerly, so nothing outlives the temp dir).
+
+    Failure behavior mirrors the local-path loaders: an unreachable
+    topology raises loudly; a missing/unfetchable weight shard warns loudly
+    with the exact URL and falls back to untrained initializer weights
+    (the same ambiguity rule as a local manifest with missing shard files).
+    A ``.h5`` URL always embeds its weights, so any fetch error raises.
+    """
+    import tempfile
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    scheme = urllib.parse.urlparse(url).scheme
+    if scheme not in ("http", "https"):
+        raise ValueError(f"model URL must be http(s), got {url!r}")
+
+    def _get(u: str) -> bytes:
+        with urllib.request.urlopen(u, timeout=timeout) as resp:
+            return resp.read()
+
+    spec_kw = dict(input_shape=input_shape, loss=loss,
+                   logits_output=logits_output, dtype=dtype)
+    with tempfile.TemporaryDirectory(prefix="distriflow_url_model_") as tmp:
+        if url.endswith((".h5", ".hdf5")):
+            local = os.path.join(tmp, os.path.basename(
+                urllib.parse.urlparse(url).path) or "model.h5")
+            with open(local, "wb") as f:
+                f.write(_get(url))  # errors raise: .h5 embeds its weights
+            return spec_from_keras_h5(local, load_weights=load_weights,
+                                      **spec_kw)
+
+        body = _get(url)  # topology fetch errors raise loudly
+        try:
+            topology = json.loads(body)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{url!r} is not a model.json: {e}") from None
+        local = os.path.join(tmp, "model.json")
+        with open(local, "wb") as f:
+            f.write(body)
+        if load_weights:
+            for group in topology.get("weightsManifest") or []:
+                for p in group.get("paths", []):
+                    # shard paths come from the remote manifest: confine
+                    # them to the temp dir (no absolute / '..' escapes)
+                    rel = os.path.normpath(p)
+                    if os.path.isabs(rel) or rel.split(os.sep)[0] == "..":
+                        raise ValueError(
+                            f"manifest shard path {p!r} escapes the model "
+                            "directory")
+                    shard_url = urllib.parse.urljoin(url, p)
+                    try:
+                        shard = _get(shard_url)
+                    except (urllib.error.URLError, OSError) as e:
+                        warnings.warn(
+                            f"{url!r} names weight shard {shard_url!r} but "
+                            f"fetching it failed ({e}); initializing "
+                            "UNTRAINED weights from the recorded layer "
+                            "initializers. Pass load_weights=False if cold "
+                            "init is intended.",
+                            stacklevel=2,
+                        )
+                        load_weights = False
+                        break
+                    dst = os.path.join(tmp, rel)
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    with open(dst, "wb") as f:
+                        f.write(shard)
+                if not load_weights:
+                    break
+        return spec_from_keras_json(local, load_weights=load_weights,
+                                    **spec_kw)
+
+
 def export_keras_weights(
     topology_path: str,
     params: Params,

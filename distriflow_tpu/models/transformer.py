@@ -396,6 +396,12 @@ class MoEFFN(nn.Module):
         pos = jnp.cumsum(oh_flat, axis=1) * oh_flat  # [G, K*g, E], 1-based
         dispatch = jax.nn.one_hot(pos.astype(jnp.int32) - 1, capacity,
                                   dtype=jnp.float32)  # [G, K*g, E, C] 0/1
+        # capacity-overflow observability: fraction of (token, choice) pairs
+        # that found no slot. Sown into its OWN collection so it never mixes
+        # with the 'aux' losses; invisible (flax no-op) unless the caller
+        # applies with mutable=["moe_stats"] — the bench's capacity sweep does.
+        self.sow("moe_stats", "dropped_fraction",
+                 1.0 - jnp.sum(dispatch) / (k * n_tok))
         gate_flat = gate.transpose(0, 2, 1).reshape(n_grp, k * g)
         combine = dispatch * gate_flat[..., None, None]
         # tokens tiled choice-major to match: [all tokens (choice 0), ...]

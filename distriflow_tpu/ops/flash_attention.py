@@ -233,8 +233,9 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     interpret = _resolve_interpret(interpret)
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    bq = _auto_block(s, block_q)
-    bk = _auto_block(s, block_k)
+    fwd_cap, _ = _block_caps(q.dtype)
+    bq = _auto_block(s, min(block_q, fwd_cap))
+    bk = _auto_block(s, min(block_k, fwd_cap))
     n_q, n_kv = s // bq, s // bk
 
     # model FLOPs: QK^T + PV, each 2*B*H*S*S*D, halved by causal tile-skip —
@@ -293,13 +294,23 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     return out.reshape(b, h, s, d), lse  # lse stays [B*H, S, LANES]
 
 
-# Backward block cap. Round-2 tuning (fp32-heavy shapes) capped this at 256
-# "to avoid VMEM spills"; re-measured round 3 on bf16 at the flagship shapes,
-# the cost structure is the OPPOSITE: the kernel is grid-step-overhead-bound,
-# and larger tiles win big — B8/H8/S1k/D64 fwd+bwd 2.75 ms @ 256 blocks vs
+# Backward block cap, PER INPUT DTYPE. Round-2 tuning on fp32 measured 512-
+# wide backward tiles spilling scoped VMEM (10x slowdown) — fp32 keeps the
+# 256 cap. Re-measured round 3 on bf16 at the flagship shapes, the cost
+# structure is the OPPOSITE: the kernel is grid-step-overhead-bound, and
+# larger tiles win big — B8/H8/S1k/D64 fwd+bwd 2.75 ms @ 256 blocks vs
 # 0.63 ms @ 1024 blocks; B2/H8/S4k/D64 6.48 ms vs 0.94 ms (55% of peak).
-# 2048-wide tiles fail to compile (scoped VMEM), so 1024 is the ceiling.
-_BWD_BLOCK_CAP = 1024
+# 2048-wide tiles fail to compile (scoped VMEM), so 1024 is the bf16 ceiling.
+_BWD_BLOCK_CAP = 1024       # <=2-byte input dtypes (bf16/fp16)
+_BWD_BLOCK_CAP_WIDE = 256   # 4-byte inputs (f32): VMEM holds double the bytes
+_FWD_BLOCK_CAP_WIDE = 512   # f32 forward: half the bf16 tile budget
+
+
+def _block_caps(dtype):
+    """(fwd_cap, bwd_cap) for the input dtype — see _BWD_BLOCK_CAP note."""
+    if jnp.dtype(dtype).itemsize <= 2:
+        return 1024, _BWD_BLOCK_CAP
+    return _FWD_BLOCK_CAP_WIDE, _BWD_BLOCK_CAP_WIDE
 
 
 def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
@@ -307,8 +318,9 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
     interpret = _resolve_interpret(interpret)
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    bq = _auto_block(s, min(block_q, _BWD_BLOCK_CAP))
-    bk = _auto_block(s, min(block_k, _BWD_BLOCK_CAP))
+    _, bwd_cap = _block_caps(q.dtype)
+    bq = _auto_block(s, min(block_q, bwd_cap))
+    bk = _auto_block(s, min(block_k, bwd_cap))
     n_q, n_kv = s // bq, s // bk
 
     # model FLOPs of the attention backward: dV = P^T dO, dP = dO V^T,

@@ -56,6 +56,7 @@ class AsyncSGDTrainer:
         checkpoint_dir: Optional[str] = None,
         save_every: int = 0,  # applied updates between auto-saves
         max_checkpoints: Optional[int] = None,
+        steps_per_upload: int = 1,
     ):
         self.spec = spec
         self.dataset = dataset
@@ -84,8 +85,40 @@ class AsyncSGDTrainer:
         self.rejected_updates = 0
         self._lock = threading.Lock()
 
+        # K-batches-per-upload (round-3: the round-2 bench showed an 89x
+        # ping-pong penalty — one host dispatch and one apply per batch).
+        # With steps_per_upload=K a worker grabs K consecutive batches,
+        # evaluates all K gradients against ONE weight snapshot in a single
+        # device-side lax.scan dispatch, and uploads their MEAN — exactly
+        # the gradient of the K-batch super-batch (equal batch sizes), so
+        # async semantics are unchanged: one version-tagged gradient per
+        # upload. The snapshot-to-apply window now spans K batches of every
+        # other worker's progress, so the staleness decay/rejection
+        # machinery engages at correspondingly higher throughput. Reference
+        # analog: the federated client's examplesPerUpdate chunking
+        # (``federated_client.ts:80``), applied to the async mode.
+        self.steps_per_upload = int(steps_per_upload)
+        if self.steps_per_upload < 1:
+            raise ValueError(
+                f"steps_per_upload must be >= 1, got {steps_per_upload}")
+
         # per-device jitted grad fns (one compilation, placed per device)
         self._grad_fn = jax.value_and_grad(spec.loss_fn)
+
+        def _multi_grad(params, xs, ys):
+            """Mean (loss, grad) of K stacked batches at fixed params."""
+
+            def body(carry, xy):
+                lsum, gsum = carry
+                loss, g = jax.value_and_grad(spec.loss_fn)(params, *xy)
+                return (lsum + loss, jax.tree.map(jnp.add, gsum, g)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (lsum, gsum), _ = jax.lax.scan(body, (jnp.float32(0.0), zeros), (xs, ys))
+            k = xs.shape[0]
+            return lsum / k, jax.tree.map(lambda g: g / k, gsum)
+
+        self._multi_grad_fn = jax.jit(_multi_grad)
 
         def _apply(params, opt_state, grads, scale):
             grads = jax.tree.map(lambda g: g * scale, grads)
@@ -205,29 +238,68 @@ class AsyncSGDTrainer:
         device = self.devices[worker_index % len(self.devices)]
         steps = 0
         while max_steps is None or steps < max_steps:
-            batch = self.dataset.next(timeout=5.0)
-            if batch is None:
+            budget = self.steps_per_upload
+            if max_steps is not None:
+                budget = min(budget, max_steps - steps)
+            group = self._take_batches(budget)
+            if not group:
                 if self.dataset.exhausted:
                     break
                 continue  # starved; re-check
             try:
                 params, version = self.snapshot()
                 local_params = jax.device_put(params, device)
-                x = jax.device_put(jnp.asarray(batch.x), device)
-                y = jax.device_put(jnp.asarray(batch.y), device)
-                loss, grads = self._grad_fn(local_params, x, y)
+                shapes = {(b.x.shape, b.y.shape) for b in group}
+                if len(group) > 1 and len(shapes) == 1:
+                    # K uniform batches: ONE device dispatch for all K
+                    # gradients (scan at fixed params), mean on device
+                    import numpy as np
+
+                    xs = jax.device_put(
+                        jnp.asarray(np.stack([np.asarray(b.x) for b in group])),
+                        device)
+                    ys = jax.device_put(
+                        jnp.asarray(np.stack([np.asarray(b.y) for b in group])),
+                        device)
+                    loss, grads = self._multi_grad_fn(local_params, xs, ys)
+                else:
+                    # singleton group or ragged tail (small last batch):
+                    # per-batch grads, tree-mean — same semantics, K dispatches
+                    acc = None
+                    for b in group:
+                        x = jax.device_put(jnp.asarray(b.x), device)
+                        y = jax.device_put(jnp.asarray(b.y), device)
+                        loss, g = self._grad_fn(local_params, x, y)
+                        acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
+                    grads = jax.tree.map(lambda v: v / len(group), acc)
                 self.submit(grads, version, client_id=f"worker-{worker_index}")
             except BaseException:
-                # failure recovery: return the batch to the queue so another
-                # worker picks it up (the redelivery role of reference
+                # failure recovery: return the batches to the queue so another
+                # worker picks them up (the redelivery role of reference
                 # dataset.ts:56-60, triggered by actual failure here)
-                self.dataset.requeue(batch.batch)
+                for b in group:
+                    self.dataset.requeue(b.batch)
                 raise
-            # ack regardless of staleness-acceptance: the batch was consumed
+            # ack regardless of staleness-acceptance: the batches were consumed
             # (reference acks before applying, asynchronousSGD_server.ts:66-72)
-            self.dataset.complete_batch(batch.batch)
-            steps += 1
+            for b in group:
+                self.dataset.complete_batch(b.batch)
+            steps += len(group)
         return steps
+
+    def _take_batches(self, budget: int) -> List[Any]:
+        """Pull up to ``budget`` batches; blocks (5 s) only for the first.
+
+        A starved queue mid-group does not stall the upload: the worker
+        proceeds with the batches it has (the mean-gradient semantics hold
+        for any group size)."""
+        group: List[Any] = []
+        while len(group) < budget:
+            batch = self.dataset.next(timeout=5.0 if not group else 0.05)
+            if batch is None:
+                break
+            group.append(batch)
+        return group
 
     def train(self, num_workers: Optional[int] = None) -> Dict[str, int]:
         """Run workers over the dataset until exhausted; returns counters."""

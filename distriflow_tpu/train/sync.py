@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -110,6 +111,7 @@ class SyncTrainer:
         sharded_checkpoints: bool = False,
         zero_optimizer_sharding: bool = False,
         ema_decay: Optional[float] = None,
+        zero_level: Optional[int] = None,
     ):
         self.spec = spec
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
@@ -127,9 +129,24 @@ class SyncTrainer:
         self.callbacks = CallbackRegistry("new_version", "step")
         self.state: Optional[TrainState] = None
         self._donate = donate
-        # ZeRO-1: moment buffers shard over the data axis (memory / dp);
-        # XLA inserts the reduce-scatter/all-gather pair around the update
-        self._zero_opt = zero_optimizer_sharding
+        # ZeRO levels over the data axis (memory / dp):
+        #   1 — moment buffers shard (ZeRO-1); XLA inserts the
+        #       reduce-scatter/all-gather pair around the update;
+        #   2 — gradients TOO: a with_sharding_constraint right after
+        #       value_and_grad turns the gradient psum into a reduce-scatter
+        #       (each device only ever materializes its grad shard), the
+        #       sharded optimizer update consumes it directly, and the
+        #       updated params all-gather back to replicated. EMA buffers
+        #       shard like the moments.
+        # zero_optimizer_sharding=True is the round-2 spelling of level 1.
+        if zero_level is None:
+            zero_level = 1 if zero_optimizer_sharding else 0
+        if zero_level not in (0, 1, 2):
+            raise ValueError(f"zero_level must be 0, 1 or 2, got {zero_level}")
+        self.zero_level = zero_level
+        self._zero_opt = zero_level >= 1
+        self._zero_grad_shardings = None  # built in init() (needs params)
+        self._param_shardings = None
         self._step_fn = self._build_step(donate)
         # observability (reference time()/log wrappers, abstract_server.ts:92-103)
         self.last_step_ms: Optional[float] = None
@@ -162,6 +179,7 @@ class SyncTrainer:
         with self.logger.time("model setup"):
             params = init_params(self.spec, rng)
             param_sh = tree_shardings(params, self.mesh, self.param_rules)
+            self._param_shardings = param_sh
             params = jax.tree.map(jax.device_put, params, param_sh)
             opt_shape = jax.eval_shape(self.optimizer.init, params)
             opt_sh = opt_state_shardings(
@@ -171,6 +189,20 @@ class SyncTrainer:
             opt_state = jax.jit(self.optimizer.init, out_shardings=opt_sh)(params)
             step = jax.device_put(jnp.int32(0), NamedSharding(self.mesh, P()))
             ema = jax.tree.map(jnp.copy, params) if self.ema_decay else None
+            if self.zero_level >= 2:
+                from distriflow_tpu.parallel.sharding import _zero_extend
+
+                # grads (and the EMA, which mirrors params) shard over data:
+                # the constraint in the step body makes XLA produce grad
+                # SHARDS via reduce-scatter instead of full grads via psum
+                self._zero_grad_shardings = jax.tree.map(
+                    lambda sh, p: _zero_extend(
+                        sh, np.shape(p), self.mesh, "data"),
+                    param_sh, params,
+                )
+                if ema is not None:
+                    ema = jax.tree.map(
+                        jax.device_put, ema, self._zero_grad_shardings)
             self.state = TrainState(params=params, opt_state=opt_state,
                                     step=step, ema=ema)
         return self.state
@@ -194,6 +226,16 @@ class SyncTrainer:
         def loss_fn(params: Params, x, y, w) -> jnp.ndarray:
             return spec.loss_fn(params, x, y, w)
 
+        def constrain_grads(grads):
+            # ZeRO-2: pin the gradient sharding so XLA materializes only
+            # each device's shard (reduce-scatter, not psum-to-replicated).
+            # Read at TRACE time (first step, after init built the
+            # shardings) — not at build time.
+            if self.zero_level >= 2 and self._zero_grad_shardings is not None:
+                return jax.lax.with_sharding_constraint(
+                    grads, self._zero_grad_shardings)
+            return grads
+
         def one_step(state: TrainState, batch):
             x, y, w = batch if len(batch) == 3 else (*batch, None)
             if accum > 1 and x.shape[0] % accum:
@@ -214,18 +256,28 @@ class SyncTrainer:
                     gacc, lacc, wacc = carry
                     mx, my, mw = xyw
                     l, g = jax.value_and_grad(loss_fn)(state.params, mx, my, mw)
+                    g = constrain_grads(g)
                     wsum = jnp.sum(mw)
                     gacc = jax.tree.map(lambda a, b: a + wsum * b, gacc, g)
                     return (gacc, lacc + wsum * l, wacc + wsum), None
 
-                zeros = jax.tree.map(jnp.zeros_like, state.params)
+                zeros = constrain_grads(
+                    jax.tree.map(jnp.zeros_like, state.params))
                 (gsum, lsum, wtot), _ = jax.lax.scan(micro, (zeros, 0.0, 0.0), (xs, ys, ws))
                 grads = jax.tree.map(lambda g: g / wtot, gsum)
                 loss = lsum / wtot
             else:
                 loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y, w)
+                grads = constrain_grads(grads)
             updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
+            if self.zero_level >= 2 and self._param_shardings is not None:
+                # ZeRO-2 contract: the sharded update all-gathers back to
+                # the param layout (otherwise XLA propagates the grad
+                # sharding into the params and every consumer sees sharded
+                # weights — a layout change, not a memory win)
+                new_params = jax.lax.with_sharding_constraint(
+                    new_params, self._param_shardings)
             new_ema = state.ema
             if ema_decay is not None:
                 new_ema = jax.tree.map(
@@ -637,6 +689,7 @@ class SyncTrainer:
         if self.state is None:
             self.init()
         param_sh = tree_shardings(params, self.mesh, self.param_rules)
+        self._param_shardings = param_sh
         placed = jax.tree.map(jax.device_put, params, param_sh)
         # rebuild the optimizer state with the SAME sharding policy as
         # init() — a plain eager init would silently replicate ZeRO-sharded
@@ -650,4 +703,14 @@ class SyncTrainer:
         # EMA restarts at the newly-installed params (same as init): the old
         # average describes weights that no longer exist
         ema = jax.tree.map(jnp.copy, placed) if self.ema_decay else None
+        if self.zero_level >= 2:
+            from distriflow_tpu.parallel.sharding import _zero_extend
+
+            self._zero_grad_shardings = jax.tree.map(
+                lambda sh, p: _zero_extend(sh, np.shape(p), self.mesh, "data"),
+                param_sh, placed,
+            )
+            if ema is not None:
+                ema = jax.tree.map(
+                    jax.device_put, ema, self._zero_grad_shardings)
         self.state = TrainState(placed, opt_state, self.state.step, ema)

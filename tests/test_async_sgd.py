@@ -114,3 +114,51 @@ def test_async_checkpoint_resume(devices, tmp_path):
     for a, b in zip(jax.tree.leaves(jax.device_get(t2.params)),
                     jax.tree.leaves(params_before)):
         np.testing.assert_array_equal(a, b)
+
+
+def test_steps_per_upload_matches_superbatch(devices):
+    """K-batches-per-upload uploads the MEAN gradient of K batches at one
+    snapshot — exactly the gradient of the K*B super-batch. With one worker
+    and SGD, params after one K-group upload equal params after one upload
+    of the concatenated batch."""
+    x, y = _data(128)
+    ds_k = DistributedDataset(x, y, {"batch_size": 32, "epochs": 1})
+    t_k = AsyncSGDTrainer(mnist_mlp(hidden=16), ds_k, learning_rate=0.05,
+                          steps_per_upload=4)
+    t_k.init(jax.random.PRNGKey(7))
+    ds_1 = DistributedDataset(x, y, {"batch_size": 128, "epochs": 1})
+    t_1 = AsyncSGDTrainer(mnist_mlp(hidden=16), ds_1, learning_rate=0.05)
+    t_1.init(jax.random.PRNGKey(7))
+
+    ck = t_k.train(num_workers=1)
+    c1 = t_1.train(num_workers=1)
+    assert ck == {"applied": 1, "rejected": 0, "version": 1}
+    assert c1 == {"applied": 1, "rejected": 0, "version": 1}
+    for a, b in zip(jax.tree.leaves(t_k.params), jax.tree.leaves(t_1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_steps_per_upload_ragged_tail(devices):
+    """A group smaller than K (dataset tail) still uploads (per-batch
+    fallback path); every batch is consumed exactly once."""
+    t, _ = _trainer(n=6 * 32, bs=32, epochs=1, steps_per_upload=4)
+    counters = t.train(num_workers=1)
+    # 6 batches -> one group of 4, one tail group of 2 -> 2 uploads
+    assert counters["applied"] == 2
+    assert counters["version"] == 2
+
+
+def test_steps_per_upload_trains(devices):
+    t, (x, y) = _trainer(n=512, bs=32, epochs=3, steps_per_upload=4)
+    before = t.evaluate(x, y)[0]
+    t.train(num_workers=2)
+    after = t.evaluate(x, y)[0]
+    assert after < before
+
+
+def test_steps_per_upload_validation():
+    x, y = _data(64)
+    ds = DistributedDataset(x, y, {"batch_size": 32, "epochs": 1})
+    with pytest.raises(ValueError, match="steps_per_upload"):
+        AsyncSGDTrainer(mnist_mlp(hidden=16), ds, steps_per_upload=0)
