@@ -46,3 +46,32 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+# -- smoke/full tiers (round 3) -------------------------------------------
+# Modules whose tests are multi-minute (compile-heavy models, real
+# multi-process jax.distributed, soak loops). The smoke tier skips them:
+#   python -m pytest -m "not slow"
+# Marking by MODULE keeps new tests in a heavy module automatically slow.
+_SLOW_TEST_MODULES = {
+    "test_transformer",
+    "test_pipelined_transformer",
+    "test_generate",
+    "test_ulysses",
+    "test_multiprocess",
+    "test_multihost_train",
+    "test_failover",
+    "test_distributed_checkpoint",
+    "test_sharded_checkpoint",
+    "test_keras_rnn",
+    "test_tp_decode",
+    "test_mobilenet",
+    "test_streaming",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = getattr(item, "module", None)
+        if mod is not None and mod.__name__ in _SLOW_TEST_MODULES:
+            item.add_marker(pytest.mark.slow)
