@@ -105,17 +105,20 @@ class TransformerConfig:
         """The loss name the model spec actually trains with. An explicit
         ``loss`` is always honored; ``loss=None`` resolves at spec-build
         time (not config-construction time, so a config built on the host
-        composes with whatever backend runs it): the fused Pallas sparse CE
-        on a single-device TPU, plain optax CE elsewhere. Multi-device
-        meshes get the optax loss because ``pallas_call`` has no GSPMD
-        partitioning rule — under pjit the fused kernel would all-gather
-        the full global ``[tokens, V]`` logits onto every device and run
-        replicated (a memory/perf regression exactly where the sharded XLA
-        loss parallelizes for free). Opting in explicitly remains possible.
+        composes with whatever backend runs it): the fused Pallas sparse
+        CE on TPU when the logits' vocab dim stays unsharded — i.e. on a
+        single device or a pure data-parallel mesh (the kernel carries a
+        rows-sharded ``custom_partitioning`` rule, ``ops/fused_ce.py``).
+        Meshes with model/pipe axes column-shard the lm_head (vocab-sharded
+        logits) and seq axes shard a middle dim the flat [tokens, V] view
+        cannot represent — those fall back to the sharded XLA loss, which
+        GSPMD handles for free. Opting in explicitly remains possible.
         """
         if self.loss is not None:
             return self.loss
-        if mesh is not None and mesh.size > 1:
+        if mesh is not None and any(
+            dict(mesh.shape).get(ax, 1) > 1 for ax in ("model", "pipe", "seq")
+        ):
             return "sparse_softmax_cross_entropy"
         return (
             "fused_sparse_softmax_cross_entropy"

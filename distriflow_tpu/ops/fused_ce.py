@@ -39,6 +39,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from distriflow_tpu.ops.flop_count import record_pallas_cost
 
 BLOCK_N = 256   # 256 x 4096 f32 = 4 MB tiles: the measured sweet spot on
@@ -178,6 +180,56 @@ def _default_interpret(interpret):
     return interpret
 
 
+# -- GSPMD partitioning (round 3) --------------------------------------------
+# pallas_call has no SPMD rule: under pjit with row-sharded logits the kernel
+# would all-gather the full [N, V] array onto every device. Rows are
+# independent, so custom_partitioning declares exactly that: shard rows over
+# whatever mesh axes the operand already uses, replicate the vocab dim, and
+# run the kernel per-shard. This is what lets the fused CE be the DEFAULT
+# loss on pure data-parallel meshes (models/transformer.py::resolved_loss_for)
+# instead of a single-device-only exhibit.
+
+
+def _row_specs(arg_infos):
+    """Row-dim sharding of the logits operand; vocab forced replicated."""
+    spec = getattr(arg_infos[0].sharding, "spec", None) or P()
+    row = spec[0] if len(spec) >= 1 else None
+    return row
+
+
+def _cp_wrap(fn, sharding_rule, out_specs_fn):
+    """Wrap ``fn(*arrays)`` (all row-aligned [N, ...] operands, logits
+    first) with a rows-sharded partitioning rule.
+
+    ``sharding_rule`` is the Shardy einsum-style rule (this JAX uses the
+    Shardy partitioner, which requires it); the ``partition`` callback
+    still provides the per-shard lowering and pins vocab replicated."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    wrapped = custom_partitioning(fn)
+
+    def infer(mesh, arg_infos, result_infos):
+        row = _row_specs(arg_infos)
+        return out_specs_fn(mesh, row)
+
+    def partition(mesh, arg_infos, result_infos):
+        row = _row_specs(arg_infos)
+        arg_sh = []
+        for i, info in enumerate(arg_infos):
+            ndim = len(info.shape)
+            if i == 0:  # logits [N, V]: vocab replicated
+                arg_sh.append(NamedSharding(mesh, P(row, None)))
+            else:  # row-aligned [N] or [N, 1] vectors
+                arg_sh.append(
+                    NamedSharding(mesh, P(row, *([None] * (ndim - 1)))))
+        return mesh, fn, out_specs_fn(mesh, row), tuple(arg_sh)
+
+    wrapped.def_partition(
+        partition=partition, infer_sharding_from_operands=infer,
+        sharding_rule=sharding_rule)
+    return wrapped
+
+
 def _record_ce_cost(logits, backward):
     """Mirror the kernel's analytic cost into the trace-time tally (XLA's
     cost analysis reports 0 FLOPs for custom calls; see ops/flop_count.py).
@@ -205,16 +257,52 @@ def _per_row_sparse_loss(
     return loss
 
 
+@functools.lru_cache(maxsize=8)
+def _sparse_fwd_cp(block_n, block_v, interpret):
+    """Rows-sharded (custom_partitioning) sparse-CE forward for one static
+    (block_n, block_v, interpret) signature."""
+
+    def fwd(logits, labels2d):
+        n_v = (logits.shape[1] + block_v - 1) // block_v
+        loss, lse = _ce_call(
+            functools.partial(_fwd_kernel, n_v=n_v, sparse=True),
+            2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
+            logits, [labels2d],
+        )
+        return loss, lse
+
+    # rows (i) shard together everywhere; vocab (j) and the labels column
+    # (k) are factors the rule keeps out of row propagation
+    return _cp_wrap(
+        fwd, "i j, i k -> i, i",
+        lambda mesh, row: (NamedSharding(mesh, P(row)),
+                           NamedSharding(mesh, P(row))),
+    )
+
+
+def _under_vmap(*arrays):
+    """True when any operand is a vmap BatchTracer: custom_partitioning has
+    no batching rule, so vmapped calls take the plain pallas path (which
+    does). Known hole: vmap-of-jit hides the batch trace from here — the
+    cp primitive inside the jit then fails under vmap; vmap directly over
+    the loss (the common composition) is what this preserves."""
+    from jax._src.interpreters.batching import BatchTracer
+
+    return any(isinstance(a, BatchTracer) for a in arrays)
+
+
 def _sparse_fwd_impl(logits, labels, block_n, block_v, interpret):
     interpret = _default_interpret(interpret)
     _record_ce_cost(logits, backward=False)
-    n_v = (logits.shape[1] + block_v - 1) // block_v
-    loss, lse = _ce_call(
-        functools.partial(_fwd_kernel, n_v=n_v, sparse=True),
-        2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
-        logits, [labels.astype(jnp.int32)[:, None]],
-    )
-    return loss, lse
+    labels2d = labels.astype(jnp.int32)[:, None]
+    if _under_vmap(logits, labels):
+        n_v = (logits.shape[1] + block_v - 1) // block_v
+        return _ce_call(
+            functools.partial(_fwd_kernel, n_v=n_v, sparse=True),
+            2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
+            logits, [labels2d],
+        )
+    return _sparse_fwd_cp(block_n, block_v, interpret)(logits, labels2d)
 
 
 def _sparse_fwd(logits, labels, block_n, block_v, interpret):
@@ -222,18 +310,39 @@ def _sparse_fwd(logits, labels, block_n, block_v, interpret):
     return loss, (logits, labels, lse)
 
 
+@functools.lru_cache(maxsize=8)
+def _sparse_bwd_cp(block_n, block_v, interpret):
+    """Rows-sharded sparse-CE backward (grad wrt logits)."""
+
+    def bwd(logits, labels2d, lse2d, g2d):
+        (grad,) = _ce_call(
+            functools.partial(_bwd_kernel, sparse=True),
+            1, (logits.dtype,), logits.shape[1], block_n,
+            min(block_v, BLOCK_V_BWD), interpret,
+            logits, [labels2d, lse2d, g2d],
+        )
+        return grad
+
+    return _cp_wrap(
+        bwd, "i j, i k, i l, i m -> i j",
+        lambda mesh, row: NamedSharding(mesh, P(row, None)))
+
+
 def _sparse_bwd(block_n, block_v, interpret, res, g):
     logits, labels, lse = res
     interpret = _default_interpret(interpret)
     _record_ce_cost(logits, backward=True)
-    (grad,) = _ce_call(
-        functools.partial(_bwd_kernel, sparse=True),
-        1, (logits.dtype,), logits.shape[1], block_n,
-        min(block_v, BLOCK_V_BWD), interpret,
-        logits,
-        [labels.astype(jnp.int32)[:, None], lse[:, None],
-         g.astype(jnp.float32)[:, None]],
-    )
+    args = (logits, labels.astype(jnp.int32)[:, None], lse[:, None],
+            g.astype(jnp.float32)[:, None])
+    if _under_vmap(logits, labels, g):
+        (grad,) = _ce_call(
+            functools.partial(_bwd_kernel, sparse=True),
+            1, (logits.dtype,), logits.shape[1], block_n,
+            min(block_v, BLOCK_V_BWD), interpret,
+            args[0], list(args[1:]),
+        )
+    else:
+        grad = _sparse_bwd_cp(block_n, block_v, interpret)(*args)
     return grad, None  # integer labels get no gradient
 
 

@@ -46,7 +46,8 @@ def run_sync(args, spec, train, val) -> float:
             with_uint8_inputs(spec), loss="sparse_softmax_cross_entropy"
         )
     trainer = SyncTrainer(spec, mesh=mesh, learning_rate=args.learning_rate,
-                          optimizer=args.optimizer, verbose=True)
+                          optimizer=args.optimizer, verbose=True,
+                          zero_level=args.zero_level)
     trainer.init(jax.random.PRNGKey(args.seed))
     x, y = (to_xy_raw if raw_wire else to_xy)(train)
     k = args.steps_per_dispatch
@@ -85,6 +86,7 @@ def run_async(args, spec, train, val) -> float:
     )
     trainer = AsyncSGDTrainer(
         spec, dataset, learning_rate=args.learning_rate, optimizer=args.optimizer,
+        steps_per_upload=args.steps_per_upload,
         hyperparams={"maximum_staleness": args.max_staleness}, verbose=True,
     )
     trainer.init(jax.random.PRNGKey(args.seed))
@@ -138,6 +140,12 @@ def main(argv=None) -> float:
                         "dispatch (lax.scan) — amortizes host/"
                         "transport latency")
     p.add_argument("--max-staleness", type=int, default=4)
+    p.add_argument("--steps-per-upload", type=int, default=1,
+                   help="async mode: K batches' gradients per snapshot in "
+                        "one device dispatch (mean upload) — amortizes the "
+                        "host ping-pong")
+    p.add_argument("--zero-level", type=int, default=0, choices=(0, 1, 2),
+                   help="sync mode: ZeRO memory sharding over the data axis")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 

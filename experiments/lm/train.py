@@ -86,6 +86,9 @@ def main(argv=None) -> float:
     p.add_argument("--vocab-size", type=int, default=None,
                    help="model vocab (default: the synthetic corpus vocab; "
                         "REQUIRED to cover the token ids in --tokens-file)")
+    p.add_argument("--zero-level", type=int, default=0, choices=(0, 1, 2),
+                   help="ZeRO memory sharding over the data axis: 1 = adam "
+                        "moments, 2 = gradients+EMA reduce-scattered too")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--save-every", type=int, default=0)
     p.add_argument("--generate", type=int, default=0,
@@ -155,7 +158,7 @@ def main(argv=None) -> float:
     trainer = SyncTrainer(
         spec, mesh=mesh, learning_rate=args.learning_rate, optimizer="adam",
         param_rules=PIPELINED_TRANSFORMER_RULES if pipelined else TRANSFORMER_TP_RULES,
-        verbose=True,
+        verbose=True, zero_level=args.zero_level,
         checkpoint_dir=args.checkpoint_dir, save_every=args.save_every,
     )
     trainer.init(jax.random.PRNGKey(args.seed))

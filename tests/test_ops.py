@@ -328,10 +328,18 @@ def test_flagship_loss_resolution(devices, monkeypatch):
     assert transformer_lm(cfg, example_seq=8).loss == (
         "fused_sparse_softmax_cross_entropy"
     )
-    # multi-device mesh: auto resolution backs off to the sharded XLA loss
+    # pure data-parallel mesh: fused stays the default (the kernel carries
+    # a rows-sharded custom_partitioning rule)
     mesh = data_parallel_mesh(devices)
-    assert cfg.resolved_loss_for(mesh) == "sparse_softmax_cross_entropy"
-    assert transformer_lm(cfg, mesh=mesh, example_seq=8).loss == (
+    assert cfg.resolved_loss_for(mesh) == "fused_sparse_softmax_cross_entropy"
+    # ... but meshes that shard the vocab (model/pipe) or the seq dim back
+    # off to the sharded XLA loss
+    from distriflow_tpu.parallel import create_mesh
+    from distriflow_tpu.utils.config import MeshConfig
+
+    tp_mesh = create_mesh(MeshConfig(data=2, model=2), devices[:4])
+    assert cfg.resolved_loss_for(tp_mesh) == "sparse_softmax_cross_entropy"
+    assert transformer_lm(cfg, mesh=tp_mesh, example_seq=8).loss == (
         "sparse_softmax_cross_entropy"
     )
     # ... but an explicit fused choice is honored even on a mesh
@@ -343,3 +351,56 @@ def test_flagship_loss_resolution(devices, monkeypatch):
                                  n_layers=1, d_ff=64, dtype=jnp.float32,
                                  loss="softmax_cross_entropy")
     assert explicit.resolved_loss == "softmax_cross_entropy"
+
+
+def test_fused_ce_partitioned_no_allgather(devices):
+    """The fused sparse CE's custom_partitioning rule keeps row-sharded
+    logits sharded: values and grads match the unfused oracle, the grad
+    stays row-sharded, and the compiled program contains NO all-gather
+    (the failure mode the partitioning exists to prevent)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distriflow_tpu.ops import fused_sparse_softmax_cross_entropy
+
+    mesh = Mesh(np.array(devices), ("data",))
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(64, 300).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 300, 64), jnp.int32)
+    logits_s = jax.device_put(logits, NamedSharding(mesh, P("data", None)))
+    labels_s = jax.device_put(labels, NamedSharding(mesh, P("data")))
+
+    def loss(lg, lb):
+        return fused_sparse_softmax_cross_entropy(lg, lb)
+
+    f = jax.jit(loss)
+    ref = float(jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, labels)))
+    assert abs(float(f(logits_s, labels_s)) - ref) < 1e-5
+    g = jax.jit(jax.grad(loss))(logits_s, labels_s)
+    g_ref = jax.grad(lambda lg: jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(lg, labels)))(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-6)
+    assert tuple(g.sharding.spec)[:1] == ("data",)  # rows stay sharded
+    hlo = f.lower(logits_s, labels_s).compile().as_text()
+    assert "all-gather" not in hlo
+
+
+def test_fused_sparse_ce_vmap_still_works():
+    """custom_partitioning has no batching rule; the loss must detect a
+    vmap trace and take the plain pallas path so vmap over the public op
+    keeps working (it did before the partitioning wrapper existed)."""
+    from distriflow_tpu.ops import fused_sparse_softmax_cross_entropy_per_example
+
+    rng = np.random.RandomState(9)
+    logits = jnp.asarray(rng.randn(4, 16, 30).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 30, (4, 16)), jnp.int32)
+    got = jax.vmap(fused_sparse_softmax_cross_entropy_per_example)(logits, labels)
+    want = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+    # grads under vmap too
+    def per_batch_loss(l, y):
+        return jnp.mean(fused_sparse_softmax_cross_entropy_per_example(l, y))
+    g = jax.vmap(jax.grad(per_batch_loss))(logits, labels)
+    g_ref = jax.vmap(jax.grad(lambda l, y: jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(l, y))))(logits, labels)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-6)
