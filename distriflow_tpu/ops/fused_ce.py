@@ -197,13 +197,15 @@ def _row_specs(arg_infos):
     return row
 
 
-def _cp_wrap(fn, sharding_rule, out_specs_fn):
+def _cp_wrap(fn, sharding_rule, out_specs_fn, vocab_args=(0,)):
     """Wrap ``fn(*arrays)`` (all row-aligned [N, ...] operands, logits
     first) with a rows-sharded partitioning rule.
 
     ``sharding_rule`` is the Shardy einsum-style rule (this JAX uses the
     Shardy partitioner, which requires it); the ``partition`` callback
-    still provides the per-shard lowering and pins vocab replicated."""
+    still provides the per-shard lowering and pins vocab replicated.
+    ``vocab_args`` lists the operand indices that are [N, V]-shaped (dense
+    targets ride along with the logits)."""
     from jax.experimental.custom_partitioning import custom_partitioning
 
     wrapped = custom_partitioning(fn)
@@ -217,7 +219,7 @@ def _cp_wrap(fn, sharding_rule, out_specs_fn):
         arg_sh = []
         for i, info in enumerate(arg_infos):
             ndim = len(info.shape)
-            if i == 0:  # logits [N, V]: vocab replicated
+            if i in vocab_args:  # [N, V]: vocab replicated
                 arg_sh.append(NamedSharding(mesh, P(row, None)))
             else:  # row-aligned [N] or [N, 1] vectors
                 arg_sh.append(
@@ -360,16 +362,38 @@ def _per_row_loss(
     return loss
 
 
+@functools.lru_cache(maxsize=8)
+def _dense_fwd_cp(block_n, block_v, interpret):
+    """Rows-sharded dense-CE forward (targets ride with the logits)."""
+
+    def fwd(logits, targets):
+        n_v = (logits.shape[1] + block_v - 1) // block_v
+        loss, lse = _ce_call(
+            functools.partial(_fwd_kernel, n_v=n_v, sparse=False),
+            2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
+            logits, [targets],
+        )
+        return loss, lse
+
+    return _cp_wrap(
+        fwd, "i j, i j -> i, i",
+        lambda mesh, row: (NamedSharding(mesh, P(row)),
+                           NamedSharding(mesh, P(row))),
+        vocab_args=(0, 1),
+    )
+
+
 def _dense_fwd_impl(logits, targets, block_n, block_v, interpret):
     interpret = _default_interpret(interpret)
     _record_ce_cost(logits, backward=False)
-    n_v = (logits.shape[1] + block_v - 1) // block_v
-    loss, lse = _ce_call(
-        functools.partial(_fwd_kernel, n_v=n_v, sparse=False),
-        2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
-        logits, [targets],
-    )
-    return loss, lse
+    if _under_vmap(logits, targets):
+        n_v = (logits.shape[1] + block_v - 1) // block_v
+        return _ce_call(
+            functools.partial(_fwd_kernel, n_v=n_v, sparse=False),
+            2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
+            logits, [targets],
+        )
+    return _dense_fwd_cp(block_n, block_v, interpret)(logits, targets)
 
 
 def _dense_fwd(logits, targets, block_n, block_v, interpret):
@@ -377,17 +401,40 @@ def _dense_fwd(logits, targets, block_n, block_v, interpret):
     return loss, (logits, targets, lse)
 
 
+@functools.lru_cache(maxsize=8)
+def _dense_bwd_cp(block_n, block_v, interpret):
+    """Rows-sharded dense-CE backward (grad wrt logits)."""
+
+    def bwd(logits, targets, lse2d, g2d):
+        (grad,) = _ce_call(
+            functools.partial(_bwd_kernel, sparse=False),
+            1, (logits.dtype,), logits.shape[1], block_n,
+            min(block_v, BLOCK_V_BWD), interpret,
+            logits, [targets, lse2d, g2d],
+        )
+        return grad
+
+    return _cp_wrap(
+        bwd, "i j, i j, i l, i m -> i j",
+        lambda mesh, row: NamedSharding(mesh, P(row, None)),
+        vocab_args=(0, 1),
+    )
+
+
 def _dense_bwd(block_n, block_v, interpret, res, g):
     logits, targets, lse = res
     interpret = _default_interpret(interpret)
     _record_ce_cost(logits, backward=True)
-    (grad,) = _ce_call(
-        functools.partial(_bwd_kernel, sparse=False),
-        1, (logits.dtype,), logits.shape[1], block_n,
-        min(block_v, BLOCK_V_BWD), interpret,
-        logits,
-        [targets, lse[:, None], g.astype(jnp.float32)[:, None]],
-    )
+    args = (logits, targets, lse[:, None], g.astype(jnp.float32)[:, None])
+    if _under_vmap(logits, targets, g):
+        (grad,) = _ce_call(
+            functools.partial(_bwd_kernel, sparse=False),
+            1, (logits.dtype,), logits.shape[1], block_n,
+            min(block_v, BLOCK_V_BWD), interpret,
+            args[0], list(args[1:]),
+        )
+    else:
+        grad = _dense_bwd_cp(block_n, block_v, interpret)(*args)
     return grad, None  # targets get no gradient (matches prior behavior)
 
 

@@ -404,3 +404,29 @@ def test_fused_sparse_ce_vmap_still_works():
     g_ref = jax.vmap(jax.grad(lambda l, y: jnp.mean(
         optax.softmax_cross_entropy_with_integer_labels(l, y))))(logits, labels)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-6)
+
+
+def test_fused_dense_ce_partitioned_and_vmap(devices):
+    """Dense-target fused CE: same rows-sharded partitioning (targets ride
+    with the logits) and the same vmap fallback."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices), ("data",))
+    rng = np.random.RandomState(10)
+    logits = jnp.asarray(rng.randn(64, 40).astype(np.float32))
+    onehot = jnp.eye(40, dtype=jnp.float32)[rng.randint(0, 40, 64)]
+    sh2 = NamedSharding(mesh, P("data", None))
+    f = jax.jit(lambda l, t: fused_softmax_cross_entropy(l, t))
+    got = float(f(jax.device_put(logits, sh2), jax.device_put(onehot, sh2)))
+    want = float(jnp.mean(optax.softmax_cross_entropy(logits, onehot)))
+    assert abs(got - want) < 1e-5
+    hlo = f.lower(jax.device_put(logits, sh2),
+                  jax.device_put(onehot, sh2)).compile().as_text()
+    assert "all-gather" not in hlo
+    # vmap fallback
+    bl = jnp.asarray(rng.randn(3, 8, 12).astype(np.float32))
+    bt = jnp.eye(12, dtype=jnp.float32)[rng.randint(0, 12, (3, 8))]
+    got_v = jax.vmap(fused_softmax_cross_entropy_per_example)(bl, bt)
+    np.testing.assert_allclose(
+        np.asarray(got_v),
+        np.asarray(optax.softmax_cross_entropy(bl, bt)), rtol=1e-5)
