@@ -351,14 +351,16 @@ class SyncTrainer:
         shapes/dtypes (no data ever moves to the device) and results are
         cached per batch signature.
 
-        The tally follows the same per-device convention as XLA's analysis:
-        shard_map'd kernels (flash attention) trace with per-shard shapes, so
-        they record their per-device slice; kernels outside shard_map (fused
-        CE) have no GSPMD rule, execute full-size replicated on every device,
-        and record full-size — exactly each device's work either way. Known
-        caveat: a ``lax.scan`` body is traced once, so Pallas calls inside
-        ``grad_accum`` micro-steps record one iteration's cost (MFU then
-        under-reports; use grad_accum=1 when benchmarking utilization)."""
+        The tally follows the same per-device convention as XLA's analysis
+        for shard_map'd kernels (flash attention traces with per-shard
+        shapes, recording its per-device slice). Known caveats: (a) the
+        fused CE records full-N rows while its custom_partitioning rule
+        executes N/devices rows per device — on a multi-device data mesh
+        the CE share (~1% of step FLOPs) over-counts by the data degree;
+        exact on one device; (b) a ``lax.scan`` body is traced once, so
+        Pallas calls inside ``grad_accum`` micro-steps record one
+        iteration's cost (MFU then under-reports; use grad_accum=1 when
+        benchmarking utilization)."""
         if self.state is None:
             self.init()
         sharding = batch_sharding(self.mesh)
