@@ -234,3 +234,29 @@ def test_lm_train_then_serve():
             proc.wait(timeout=30)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_lm_train_zero_level_and_explicit_loss():
+    """Round-3 CLI surface: --zero-level shards the optimizer state and
+    --loss pins the registry loss; training still descends."""
+    from experiments.lm import train as lm_train
+
+    eval_loss = lm_train.main([
+        "--steps", "20", "--seq", "64", "--batch-size", "8",
+        "--n-layers", "1", "--d-model", "64", "--d-ff", "128",
+        "--corpus-tokens", "20000", "--dtype", "float32",
+        "--zero-level", "2", "--loss", "sparse_softmax_cross_entropy",
+    ])
+    assert np.isfinite(eval_loss)
+    assert eval_loss < np.log(256)
+
+
+def test_cifar_async_steps_per_upload():
+    """Round-3 CLI surface: async mode with K-batches-per-upload consumes
+    every batch and still evaluates finitely."""
+    acc = cifar_train.main([
+        "--mode", "async", "--steps", "8", "--batch-size", "16",
+        "--workers", "2", "--steps-per-upload", "4",
+        "--learning-rate", "0.05",
+    ])
+    assert np.isfinite(acc)
