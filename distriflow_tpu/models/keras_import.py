@@ -1728,11 +1728,13 @@ def spec_from_url(
     ``urljoin`` and download next to it, and the local loaders run
     unchanged (weights are read eagerly, so nothing outlives the temp dir).
 
-    Failure behavior mirrors the local-path loaders: an unreachable
-    topology raises loudly; a missing/unfetchable weight shard warns loudly
-    with the exact URL and falls back to untrained initializer weights
-    (the same ambiguity rule as a local manifest with missing shard files).
-    A ``.h5`` URL always embeds its weights, so any fetch error raises.
+    Failure behavior: every fetch error raises. An unfetchable weight
+    shard is NOT the local missing-shard-file ambiguity (a topology-only
+    export never *names* shards) — over HTTP it is almost always a
+    transient network error, and the reference's ``tf.loadLayersModel``
+    rejects on a failed shard fetch too, so falling back to untrained
+    initializer weights would silently hand back a garbage model. Pass
+    ``load_weights=False`` when cold init is what you want.
     """
     import tempfile
     import urllib.error
@@ -1780,22 +1782,18 @@ def spec_from_url(
                     try:
                         shard = _get(shard_url)
                     except (urllib.error.URLError, OSError) as e:
-                        warnings.warn(
+                        raise OSError(
                             f"{url!r} names weight shard {shard_url!r} but "
-                            f"fetching it failed ({e}); initializing "
-                            "UNTRAINED weights from the recorded layer "
-                            "initializers. Pass load_weights=False if cold "
-                            "init is intended.",
-                            stacklevel=2,
-                        )
-                        load_weights = False
-                        break
+                            f"fetching it failed ({e}). The reference "
+                            "rejects on a failed shard fetch "
+                            "(tf.loadLayersModel); pass load_weights=False "
+                            "to cold-init from the recorded layer "
+                            "initializers instead."
+                        ) from e
                     dst = os.path.join(tmp, rel)
                     os.makedirs(os.path.dirname(dst), exist_ok=True)
                     with open(dst, "wb") as f:
                         f.write(shard)
-                if not load_weights:
-                    break
         return spec_from_keras_json(local, load_weights=load_weights,
                                     **spec_kw)
 

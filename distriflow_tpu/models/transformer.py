@@ -88,6 +88,13 @@ class TransformerConfig:
     # tiles, no [N, V] log-softmax intermediate in HBM — ops/fused_ce.py),
     # plain optax CE elsewhere (the kernel interpreter is test-only-slow).
     loss: Optional[str] = None
+    # decode-time KV cache precision. None = cfg.dtype. "int8" halves the
+    # cache's HBM footprint AND the per-token read traffic — decode at long
+    # context is KV-read bandwidth-bound (measured at ~peak HBM BW on v5e,
+    # docs/PERFORMANCE.md §8), so this is the lever that actually moves
+    # per-token latency there. Symmetric per-(position, head) absmax
+    # quantization; scales stored alongside in float32.
+    kv_cache_dtype: Optional[str] = None
 
     def __post_init__(self):
         if self.n_experts > 0 and not 1 <= self.moe_top_k <= self.n_experts:
@@ -99,6 +106,11 @@ class TransformerConfig:
             raise ValueError(
                 "use_ring_attention and use_ulysses_attention are mutually "
                 "exclusive sequence-parallel strategies; pick one"
+            )
+        if self.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be None or 'int8', got "
+                f"{self.kv_cache_dtype!r}"
             )
 
     def resolved_loss_for(self, mesh: Optional[Mesh]) -> str:
@@ -257,23 +269,59 @@ class Attention(nn.Module):
         The first call (prefill, any ``s``) fills positions ``[0, s)``; each
         later call appends at the running index. q/k get RoPE at their
         absolute positions. Decoding is matvec-bound, so this is the plain
-        XLA path (flash kernels buy nothing at query length 1)."""
+        XLA path (flash kernels buy nothing at query length 1) — and at
+        long context it runs at ~peak HBM bandwidth reading the cache
+        (docs/PERFORMANCE.md §8), which is why the only real lever here is
+        ``kv_cache_dtype="int8"``: the cache stores symmetric
+        per-(position, head) absmax-quantized int8 K/V plus float32
+        scales, halving both the footprint and the per-token read traffic;
+        dequantization fuses into the attention einsums' read stream.
+        """
         cfg = self.config
+        quant = cfg.kv_cache_dtype == "int8"
         cache_shape = (b, cfg.n_heads, cfg.max_seq, head_dim)
-        ck = self.variable("cache", "cached_k", jnp.zeros, cache_shape, cfg.dtype)
-        cv = self.variable("cache", "cached_v", jnp.zeros, cache_shape, cfg.dtype)
+        store_dtype = jnp.int8 if quant else cfg.dtype
+        ck = self.variable("cache", "cached_k", jnp.zeros, cache_shape,
+                           store_dtype)
+        cv = self.variable("cache", "cached_v", jnp.zeros, cache_shape,
+                           store_dtype)
+        if quant:
+            scale_shape = (b, cfg.n_heads, cfg.max_seq, 1)
+            sk = self.variable("cache", "k_scale", jnp.zeros, scale_shape,
+                               jnp.float32)
+            sv = self.variable("cache", "v_scale", jnp.zeros, scale_shape,
+                               jnp.float32)
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((), jnp.int32))
         idx = ci.value
         if cfg.use_rope:
             q, k = apply_rope(q, k, base=cfg.rope_base, offset=idx)
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, 0, idx, 0))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, 0, idx, 0))
+
+        def _quantize(t):
+            tf = t.astype(jnp.float32)
+            scale = jnp.max(jnp.abs(tf), axis=-1, keepdims=True) / 127.0
+            safe = jnp.maximum(scale, 1e-20)
+            q8 = jnp.clip(jnp.round(tf / safe), -127, 127).astype(jnp.int8)
+            return q8, scale
+
+        if quant:
+            k8, ks = _quantize(k)
+            v8, vs = _quantize(v)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k8, (0, 0, idx, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v8, (0, 0, idx, 0))
+            sk.value = jax.lax.dynamic_update_slice(sk.value, ks, (0, 0, idx, 0))
+            sv.value = jax.lax.dynamic_update_slice(sv.value, vs, (0, 0, idx, 0))
+            keys = ck.value.astype(cfg.dtype) * sk.value.astype(cfg.dtype)
+            vals = cv.value.astype(cfg.dtype) * sv.value.astype(cfg.dtype)
+        else:
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, 0, idx, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, 0, idx, 0))
+            keys, vals = ck.value, cv.value
         ci.value = idx + s
         scores = jnp.einsum(
-            "bhqd,bhkd->bhqk", q, ck.value, preferred_element_type=jnp.float32
+            "bhqd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32
         ) / math.sqrt(head_dim)  # [B, H, s, max_seq]
         k_pos = jnp.arange(cfg.max_seq)[None, :]
         q_pos = idx + jnp.arange(s)[:, None]
@@ -285,7 +333,7 @@ class Attention(nn.Module):
         scores = jnp.where(visible, scores, -1e30)
         p = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum(
-            "bhqk,bhkd->bhqd", p, cv.value, preferred_element_type=jnp.float32
+            "bhqk,bhkd->bhqd", p, vals, preferred_element_type=jnp.float32
         ).astype(cfg.dtype)
         out = out.transpose(0, 2, 1, 3)
         return nn.DenseGeneral(

@@ -30,6 +30,7 @@ def record_pallas_cost(
     flops: float = 0.0,
     bytes_accessed: float = 0.0,
     transcendentals: float = 0.0,
+    category: Optional[str] = None,
 ) -> None:
     """Add one kernel invocation's analytic cost to the active tally.
 
@@ -37,18 +38,34 @@ def record_pallas_cost(
     Call sites run at trace time, once per ``pallas_call`` wiring, so a
     kernel invoked per-block (ring attention) records once per block with
     that block's true shapes.
+
+    ``category`` additionally files the cost under ``tally["by_category"]``
+    so consumers can re-scale one kernel family's share — the fused CE
+    traces with GLOBAL row counts (its custom_partitioning rule splits rows
+    at compile time, invisible to an abstract trace) while the shard_map'd
+    kernels trace per-shard; ``SyncTrainer.cost_analysis`` divides the CE
+    share by the row-shard degree to keep the per-device convention exact.
     """
     tally = _TALLY.get()
     if tally is not None:
         tally["flops"] += float(flops)
         tally["bytes_accessed"] += float(bytes_accessed)
         tally["transcendentals"] += float(transcendentals)
+        if category is not None:
+            cat = tally["by_category"].setdefault(
+                category,
+                {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0},
+            )
+            cat["flops"] += float(flops)
+            cat["bytes_accessed"] += float(bytes_accessed)
+            cat["transcendentals"] += float(transcendentals)
 
 
 @contextmanager
 def tally_pallas_cost() -> Iterator[Dict[str, float]]:
     """Collect Pallas kernel costs recorded while tracing inside the block."""
-    tally = {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0}
+    tally = {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0,
+             "by_category": {}}
     token = _TALLY.set(tally)
     try:
         yield tally

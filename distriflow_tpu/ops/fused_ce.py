@@ -197,6 +197,40 @@ def _row_specs(arg_infos):
     return row
 
 
+def _rows_vmappable(fn):
+    """Make a row-aligned kernel call batchable by collapsing vmap axes
+    into rows.
+
+    Every operand and output of ``fn`` is ``[N, ...]`` with independent
+    rows, so a vmap axis is *just more rows*: the ``custom_vmap`` rule
+    broadcasts any unbatched operands, reshapes ``[B, N, ...] ->
+    [B*N, ...]``, re-enters the wrapped call (so nested vmaps collapse
+    recursively), and splits the leading dim back out. This removes the
+    need to detect batch tracers at all — ``vmap(f)``, ``jit(vmap(f))``
+    and ``vmap(jit(f))`` all reach the same rows-sharded
+    ``custom_partitioning`` kernel (which has no batching rule of its
+    own; round-3 sniffed tracers via a private JAX API and missed the
+    vmap-of-jit composition)."""
+    from jax.custom_batching import custom_vmap
+
+    wrapped = custom_vmap(fn)
+
+    @wrapped.def_vmap
+    def _rule(axis_size, in_batched, *args):
+        full = [
+            a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
+            for a, b in zip(args, in_batched)
+        ]
+        flat = [a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
+                for a in full]
+        outs = wrapped(*flat)
+        unflat = jax.tree.map(
+            lambda o: o.reshape((axis_size, -1) + o.shape[1:]), outs)
+        return unflat, jax.tree.map(lambda _: True, outs)
+
+    return wrapped
+
+
 def _cp_wrap(fn, sharding_rule, out_specs_fn, vocab_args=(0,)):
     """Wrap ``fn(*arrays)`` (all row-aligned [N, ...] operands, logits
     first) with a rows-sharded partitioning rule.
@@ -229,7 +263,7 @@ def _cp_wrap(fn, sharding_rule, out_specs_fn, vocab_args=(0,)):
     wrapped.def_partition(
         partition=partition, infer_sharding_from_operands=infer,
         sharding_rule=sharding_rule)
-    return wrapped
+    return _rows_vmappable(wrapped)
 
 
 def _record_ce_cost(logits, backward):
@@ -245,6 +279,11 @@ def _record_ce_cost(logits, backward):
         flops=(3 if backward else 5) * n * v,
         bytes_accessed=(2 if backward else 1) * n * v * logits.dtype.itemsize,
         transcendentals=n * v,
+        # filed by category: N here is the GLOBAL row count (the
+        # custom_partitioning split happens at compile time, after this
+        # trace-time record) — cost_analysis divides this share by the
+        # row-shard degree to keep its per-device convention exact
+        category="fused_ce",
     )
 
 
@@ -282,28 +321,10 @@ def _sparse_fwd_cp(block_n, block_v, interpret):
     )
 
 
-def _under_vmap(*arrays):
-    """True when any operand is a vmap BatchTracer: custom_partitioning has
-    no batching rule, so vmapped calls take the plain pallas path (which
-    does). Known hole: vmap-of-jit hides the batch trace from here — the
-    cp primitive inside the jit then fails under vmap; vmap directly over
-    the loss (the common composition) is what this preserves."""
-    from jax._src.interpreters.batching import BatchTracer
-
-    return any(isinstance(a, BatchTracer) for a in arrays)
-
-
 def _sparse_fwd_impl(logits, labels, block_n, block_v, interpret):
     interpret = _default_interpret(interpret)
     _record_ce_cost(logits, backward=False)
     labels2d = labels.astype(jnp.int32)[:, None]
-    if _under_vmap(logits, labels):
-        n_v = (logits.shape[1] + block_v - 1) // block_v
-        return _ce_call(
-            functools.partial(_fwd_kernel, n_v=n_v, sparse=True),
-            2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
-            logits, [labels2d],
-        )
     return _sparse_fwd_cp(block_n, block_v, interpret)(logits, labels2d)
 
 
@@ -336,15 +357,7 @@ def _sparse_bwd(block_n, block_v, interpret, res, g):
     _record_ce_cost(logits, backward=True)
     args = (logits, labels.astype(jnp.int32)[:, None], lse[:, None],
             g.astype(jnp.float32)[:, None])
-    if _under_vmap(logits, labels, g):
-        (grad,) = _ce_call(
-            functools.partial(_bwd_kernel, sparse=True),
-            1, (logits.dtype,), logits.shape[1], block_n,
-            min(block_v, BLOCK_V_BWD), interpret,
-            args[0], list(args[1:]),
-        )
-    else:
-        grad = _sparse_bwd_cp(block_n, block_v, interpret)(*args)
+    grad = _sparse_bwd_cp(block_n, block_v, interpret)(*args)
     return grad, None  # integer labels get no gradient
 
 
@@ -386,13 +399,6 @@ def _dense_fwd_cp(block_n, block_v, interpret):
 def _dense_fwd_impl(logits, targets, block_n, block_v, interpret):
     interpret = _default_interpret(interpret)
     _record_ce_cost(logits, backward=False)
-    if _under_vmap(logits, targets):
-        n_v = (logits.shape[1] + block_v - 1) // block_v
-        return _ce_call(
-            functools.partial(_fwd_kernel, n_v=n_v, sparse=False),
-            2, (jnp.float32, jnp.float32), 1, block_n, block_v, interpret,
-            logits, [targets],
-        )
     return _dense_fwd_cp(block_n, block_v, interpret)(logits, targets)
 
 
@@ -426,15 +432,7 @@ def _dense_bwd(block_n, block_v, interpret, res, g):
     interpret = _default_interpret(interpret)
     _record_ce_cost(logits, backward=True)
     args = (logits, targets, lse[:, None], g.astype(jnp.float32)[:, None])
-    if _under_vmap(logits, targets, g):
-        (grad,) = _ce_call(
-            functools.partial(_bwd_kernel, sparse=False),
-            1, (logits.dtype,), logits.shape[1], block_n,
-            min(block_v, BLOCK_V_BWD), interpret,
-            args[0], list(args[1:]),
-        )
-    else:
-        grad = _dense_bwd_cp(block_n, block_v, interpret)(*args)
+    grad = _dense_bwd_cp(block_n, block_v, interpret)(*args)
     return grad, None  # targets get no gradient (matches prior behavior)
 
 

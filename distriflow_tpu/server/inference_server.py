@@ -117,12 +117,18 @@ class InferenceServer:
         # dispatcher thread; observability counters for tests/soaks
         self._queue: "queue_mod.Queue[Optional[_Pending]]" = queue_mod.Queue()
         self._dispatcher: Optional[threading.Thread] = None
+        self._stopped = False
         self.decode_batches = 0  # device programs run for greedy generates
         self.batched_requests = 0  # greedy requests served by those programs
 
     # -- lifecycle ---------------------------------------------------------
 
     def setup(self) -> "InferenceServer":
+        self._stopped = False
+        # restart hygiene: a request that raced a previous stop() was
+        # error-completed but may still sit in the queue — the new
+        # dispatcher must not serve orphans whose callers already errored
+        self._drain_and_error()
         self.transport.start()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
@@ -132,11 +138,16 @@ class InferenceServer:
         return self
 
     def stop(self) -> None:
+        self._stopped = True  # before the drain: closes the enqueue race
         self.transport.stop()
         if self._dispatcher is not None:
             self._queue.put(None)  # wake + exit sentinel
             self._dispatcher.join(timeout=5.0)
             self._dispatcher = None
+        # a handler may have enqueued between the dispatcher's final drain
+        # and _stopped landing in its view; sweep once more so no waiter is
+        # left to the 600 s backstop
+        self._drain_and_error()
 
     @property
     def address(self) -> str:
@@ -176,6 +187,13 @@ class InferenceServer:
                    int(eos_id) if eos_id is not None else None)
             item = _Pending(prompt, sig)
             self._queue.put(item)
+            # re-check AFTER enqueueing (TOCTOU vs stop(): the dispatcher
+            # may have drained and exited between the liveness check above
+            # and the put) — error the item now rather than letting the
+            # waiter ride the 600 s backstop
+            if self._stopped and not item.done.is_set():
+                item.error = RuntimeError("inference server stopped")
+                item.done.set()
             # generous last-resort bound (cold compiles can take minutes);
             # normal completion/shutdown sets the event long before this
             if not item.done.wait(timeout=600.0):

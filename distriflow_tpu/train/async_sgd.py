@@ -27,6 +27,7 @@ per-step serialize+broadcast of the reference disappears.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -57,6 +58,9 @@ class AsyncSGDTrainer:
         save_every: int = 0,  # applied updates between auto-saves
         max_checkpoints: Optional[int] = None,
         steps_per_upload: int = 1,
+        admission_control: bool = True,
+        profile_phases: bool = False,
+        stage_dataset: bool = False,
     ):
         self.spec = spec
         self.dataset = dataset
@@ -85,6 +89,55 @@ class AsyncSGDTrainer:
         self.rejected_updates = 0
         self._lock = threading.Lock()
 
+        # SSP-style admission control (round-4, verdict #3): bounded
+        # staleness by CONSTRUCTION instead of by discard. Two pieces:
+        # (1) a window semaphore — at most ``maximum_staleness + 1``
+        # snapshot-to-submit spans in flight; (2) FIFO submit order — an
+        # admitted worker submits in snapshot order (ticket queue), so a
+        # fast worker cannot overtake a slow one and burn its staleness
+        # budget multiple times. Together: at most ``maximum_staleness``
+        # other applies can land inside any admitted span, so no gradient
+        # ages past the bound while it is being computed — the machinery
+        # that used to reject 25% of finished work (r03: applied=9,
+        # rejected=3) now prevents the waste instead. Same contract as
+        # Stale-Synchronous-Parallel's clock window; the rejection path
+        # stays live for grads submitted outside the gate (an external
+        # client on the transport edge, or admission_control=False).
+        self.admission_control = bool(admission_control)
+        stale_window = int(self.hyperparams.maximum_staleness) + 1
+        self._admission = threading.BoundedSemaphore(stale_window)
+        self._ticket_head = 0  # next ticket to issue (at snapshot)
+        self._ticket_tail = 0  # next ticket allowed to submit
+        self._aborted_tickets: set = set()
+        self._ticket_cv = threading.Condition()
+
+        # per-phase wall-clock accounting (verdict #3: "nothing measures
+        # where the gap lives"). Always-on counters are dispatch-time only;
+        # profile_phases=True adds block_until_ready barriers at each
+        # boundary so the attribution is true device/transfer time (use for
+        # a profiling pass, not the timed run).
+        self.profile_phases = bool(profile_phases)
+        self.phase_ms = {"stage": 0.0, "snapshot": 0.0, "fit": 0.0,
+                         "submit": 0.0, "admission_wait": 0.0}
+        self._phase_lock = threading.Lock()
+
+        # device-resident dataset (round-4, verdict #3): with
+        # ``stage_dataset=True`` the full x/y arrays transfer to each
+        # worker's device ONCE (``pre_stage``/first take) and every batch
+        # is a device-side dynamic slice — per-upload host->device traffic
+        # drops to zero. This is the async analog of the sync path's
+        # device-resident sharded batches; on a bandwidth-starved host
+        # link (or a tunneled dev backend) it is the difference between
+        # streaming-bound and compute-bound async throughput. Incompatible
+        # with host preprocess callbacks (checked at take time).
+        self.stage_dataset = bool(stage_dataset)
+        self._staged_data: Dict[Any, Tuple[Any, Any]] = {}
+        self._slice_cache: Dict[int, Callable] = {}
+        # guards the lazy jit/staging caches: without it N workers racing
+        # the first miss each compile the identical program (20-40 s over
+        # a remote backend) or re-transfer the whole dataset
+        self._build_lock = threading.Lock()
+
         # K-batches-per-upload (round-3: the round-2 bench showed an 89x
         # ping-pong penalty — one host dispatch and one apply per batch).
         # With steps_per_upload=K a worker grabs K consecutive batches,
@@ -104,21 +157,7 @@ class AsyncSGDTrainer:
 
         # per-device jitted grad fns (one compilation, placed per device)
         self._grad_fn = jax.value_and_grad(spec.loss_fn)
-
-        def _multi_grad(params, xs, ys):
-            """Mean (loss, grad) of K stacked batches at fixed params."""
-
-            def body(carry, xy):
-                lsum, gsum = carry
-                loss, g = jax.value_and_grad(spec.loss_fn)(params, *xy)
-                return (lsum + loss, jax.tree.map(jnp.add, gsum, g)), None
-
-            zeros = jax.tree.map(jnp.zeros_like, params)
-            (lsum, gsum), _ = jax.lax.scan(body, (jnp.float32(0.0), zeros), (xs, ys))
-            k = xs.shape[0]
-            return lsum / k, jax.tree.map(lambda g: g / k, gsum)
-
-        self._multi_grad_fn = jax.jit(_multi_grad)
+        self._multi_grad_cache: Dict[int, Callable] = {}
 
         def _apply(params, opt_state, grads, scale):
             grads = jax.tree.map(lambda g: g * scale, grads)
@@ -129,6 +168,145 @@ class AsyncSGDTrainer:
         # snapshot() while the server applies updates; donating would
         # invalidate their buffers mid-flight.
         self._apply_fn = jax.jit(_apply)
+
+    def _multi_grad_for(self, k: int) -> Callable:
+        """Jitted mean-(loss, grad) over ``k`` per-batch device arrays.
+
+        Takes the K batches UNSTACKED (``f(params, x1..xk, y1..yk)``) and
+        stacks on device: the round-3 path ``np.stack``-ed ~25 MB on the
+        host and shipped it as one blocking transfer per upload — now each
+        batch's transfer starts the moment the worker takes it from the
+        queue (async dispatch), overlapping the previous group's compute.
+        One compilation per distinct K (K is steps_per_upload, plus
+        possibly one ragged tail size per epoch)."""
+        with self._build_lock:  # workers race the first miss: one compile
+            fn = self._multi_grad_cache.get(k)
+            if fn is None:
+                loss_fn = self.spec.loss_fn
+
+                def f(params, *arrs):
+                    xs = jnp.stack(arrs[:k])
+                    ys = jnp.stack(arrs[k:])
+
+                    def body(carry, xy):
+                        lsum, gsum = carry
+                        loss, g = jax.value_and_grad(loss_fn)(params, *xy)
+                        return (lsum + loss,
+                                jax.tree.map(jnp.add, gsum, g)), None
+
+                    zeros = jax.tree.map(jnp.zeros_like, params)
+                    (lsum, gsum), _ = jax.lax.scan(
+                        body, (jnp.float32(0.0), zeros), (xs, ys))
+                    return lsum / k, jax.tree.map(lambda g: g / k, gsum)
+
+                fn = self._multi_grad_cache[k] = jax.jit(f)
+            return fn
+
+    def pre_stage(self, device=None) -> None:
+        """Transfer the dataset wholesale to ``device`` (default: every
+        trainer device) ahead of training, so the first uploads don't pay
+        the one-time staging transfer inside the measured/served path."""
+        targets = [device] if device is not None else self.devices
+        for d in targets:
+            self._device_dataset(d)
+
+    def _device_dataset(self, device) -> Tuple[Any, Any]:
+        with self._build_lock:  # one ~dataset-sized transfer per device
+            pair = self._staged_data.get(device)
+            if pair is None:
+                pair = (jax.device_put(jnp.asarray(self.dataset.x), device),
+                        jax.device_put(jnp.asarray(self.dataset.y), device))
+                self._staged_data[device] = pair
+            return pair
+
+    def _slice_for(self, size: int) -> Callable:
+        """One jitted dynamic-slice program per batch size (the whole
+        epoch's batches share it; the ragged tail adds one more)."""
+        with self._build_lock:
+            fn = self._slice_cache.get(size)
+            if fn is None:
+                fn = self._slice_cache[size] = jax.jit(
+                    lambda a, lo: jax.lax.dynamic_slice_in_dim(a, lo, size, 0),
+                    static_argnums=())
+            return fn
+
+    def _staged_multi_grad_for(self, k: int, size: int) -> Callable:
+        """Staged-dataset fit: mean (loss, grad) of ``k`` batches sliced
+        from the device-resident dataset INSIDE the program.
+
+        The whole upload's compute is ONE device dispatch (the slicing
+        rides in the scan body) — on high-dispatch-latency links (remote
+        backends; congested hosts) this is the difference between
+        dispatch-bound and compute-bound async throughput."""
+        key = ("staged", k, size)
+        with self._build_lock:
+            fn = self._multi_grad_cache.get(key)
+            if fn is None:
+                loss_fn = self.spec.loss_fn
+
+                def f(params, xfull, yfull, los):
+                    def body(carry, lo):
+                        lsum, gsum = carry
+                        x = jax.lax.dynamic_slice_in_dim(xfull, lo, size, 0)
+                        y = jax.lax.dynamic_slice_in_dim(yfull, lo, size, 0)
+                        loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+                        return (lsum + loss,
+                                jax.tree.map(jnp.add, gsum, g)), None
+
+                    zeros = jax.tree.map(jnp.zeros_like, params)
+                    (lsum, gsum), _ = jax.lax.scan(
+                        body, (jnp.float32(0.0), zeros), los)
+                    return lsum / k, jax.tree.map(lambda g: g / k, gsum)
+
+                fn = self._multi_grad_cache[key] = jax.jit(f)
+            return fn
+
+    def _admit(self) -> Tuple[int, Params, int]:
+        """Open an SSP span: window slot + ticket + snapshot, atomically.
+
+        The ticket fixes this span's position in the submit order; the
+        snapshot inside the same lock hold means ticket order == snapshot
+        order, which is what makes the staleness bound airtight."""
+        self._admission.acquire()
+        with self._lock:
+            ticket = self._ticket_head
+            self._ticket_head += 1
+            return ticket, self.params, self.version
+
+    def _await_turn(self, ticket: int) -> None:
+        with self._ticket_cv:
+            while self._ticket_tail != ticket:
+                self._ticket_cv.wait()
+
+    def _close_span(self, ticket: int) -> None:
+        """Retire ``ticket`` (normal completion or crash — a dead worker
+        must not stall every later submit) and free its window slot.
+
+        A span that dies before its turn parks in ``_aborted_tickets``;
+        the queue skips over parked tickets when the tail reaches them."""
+        with self._ticket_cv:
+            if self._ticket_tail == ticket:
+                self._ticket_tail += 1
+                while self._ticket_tail in self._aborted_tickets:
+                    self._aborted_tickets.discard(self._ticket_tail)
+                    self._ticket_tail += 1
+            else:
+                self._aborted_tickets.add(ticket)
+            self._ticket_cv.notify_all()
+        self._admission.release()
+
+    def _phase(self, name: str, t0: float, *blockers) -> float:
+        """Accumulate ``time.perf_counter() - t0`` into ``phase_ms[name]``;
+        with profile_phases, block on ``blockers`` first so the wall time
+        is true device/transfer time, not dispatch time. Returns a fresh
+        t0 for the next phase."""
+        if self.profile_phases:
+            for b in blockers:
+                jax.block_until_ready(b)
+        dt = (time.perf_counter() - t0) * 1e3
+        with self._phase_lock:
+            self.phase_ms[name] += dt
+        return time.perf_counter()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -241,64 +419,133 @@ class AsyncSGDTrainer:
             budget = self.steps_per_upload
             if max_steps is not None:
                 budget = min(budget, max_steps - steps)
-            group = self._take_batches(budget)
+            t0 = time.perf_counter()
+            group = self._take_batches(budget, device)
             if not group:
                 if self.dataset.exhausted:
                     break
                 continue  # starved; re-check
+            if self.stage_dataset:
+                t0 = self._phase("stage", t0)  # device-resident: no transfer
+            else:
+                staged = [g[1] for g in group] + [g[2] for g in group]
+                t0 = self._phase("stage", t0, *staged)
+            ticket = None
             try:
-                params, version = self.snapshot()
-                local_params = jax.device_put(params, device)
-                shapes = {(b.x.shape, b.y.shape) for b in group}
-                if len(group) > 1 and len(shapes) == 1:
-                    # K uniform batches: ONE device dispatch for all K
-                    # gradients (scan at fixed params), mean on device
-                    import numpy as np
-
-                    xs = jax.device_put(
-                        jnp.asarray(np.stack([np.asarray(b.x) for b in group])),
-                        device)
-                    ys = jax.device_put(
-                        jnp.asarray(np.stack([np.asarray(b.y) for b in group])),
-                        device)
-                    loss, grads = self._multi_grad_fn(local_params, xs, ys)
+                if self.admission_control:
+                    # SSP span: window slot + submit-order ticket (ctor
+                    # comment) — the wait replaces what used to be
+                    # discarded compute
+                    ticket, params, version = self._admit()
+                    t0 = self._phase("admission_wait", t0)
                 else:
-                    # singleton group or ragged tail (small last batch):
-                    # per-batch grads, tree-mean — same semantics, K dispatches
-                    acc = None
-                    for b in group:
-                        x = jax.device_put(jnp.asarray(b.x), device)
-                        y = jax.device_put(jnp.asarray(b.y), device)
-                        loss, g = self._grad_fn(local_params, x, y)
-                        acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
-                    grads = jax.tree.map(lambda v: v / len(group), acc)
-                self.submit(grads, version, client_id=f"worker-{worker_index}")
+                    params, version = self.snapshot()
+                local_params = jax.device_put(params, device)
+                t0 = self._phase("snapshot", t0, local_params)
+                if self.stage_dataset:
+                    grads = self._staged_fit(local_params, group, device)
+                else:
+                    grads = self._host_fit(local_params, group)
+                t0 = self._phase("fit", t0, grads)
+                if ticket is not None:
+                    # ordering wait books under admission_wait, NOT submit:
+                    # with heterogeneous workers the FIFO wait can dominate
+                    # and the phase breakdown must localize it correctly
+                    self._await_turn(ticket)
+                    t0 = self._phase("admission_wait", t0)
+                self.submit(grads, version,
+                            client_id=f"worker-{worker_index}")
+                self._phase("submit", t0,
+                            self.params if self.profile_phases else ())
             except BaseException:
                 # failure recovery: return the batches to the queue so another
                 # worker picks them up (the redelivery role of reference
                 # dataset.ts:56-60, triggered by actual failure here)
-                for b in group:
+                for b, _, _ in group:
                     self.dataset.requeue(b.batch)
                 raise
+            finally:
+                if ticket is not None:
+                    self._close_span(ticket)
             # ack regardless of staleness-acceptance: the batches were consumed
             # (reference acks before applying, asynchronousSGD_server.ts:66-72)
-            for b in group:
+            for b, _, _ in group:
                 self.dataset.complete_batch(b.batch)
             steps += len(group)
         return steps
 
-    def _take_batches(self, budget: int) -> List[Any]:
+    def _host_fit(self, local_params, group):
+        """Fit over host-staged ``(batch, x_dev, y_dev)`` triples."""
+        shapes = {tuple(x.shape) for _, x, _ in group}
+        if len(group) > 1 and len(shapes) == 1:
+            # K uniform batches: ONE device dispatch for all K gradients
+            # (scan at fixed params), mean on device; the batches were
+            # staged per-take, so transfers overlapped earlier compute
+            fn = self._multi_grad_for(len(group))
+            _, grads = fn(local_params,
+                          *(x for _, x, _ in group),
+                          *(y for _, _, y in group))
+            return grads
+        # singleton group or ragged tail (small last batch): per-batch
+        # grads, tree-mean — same semantics, K dispatches
+        acc = None
+        for _, x, y in group:
+            _, g = self._grad_fn(local_params, x, y)
+            acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
+        return jax.tree.map(lambda v: v / len(group), acc)
+
+    def _staged_fit(self, local_params, group, device):
+        """Fit over device-resident dataset slices ``(batch, lo, size)`` —
+        one dispatch for the whole upload (slices ride inside the scan)."""
+        xd, yd = self._device_dataset(device)
+        sizes = {size for _, _, size in group}
+        if len(sizes) == 1:
+            size = next(iter(sizes))
+            fn = self._staged_multi_grad_for(len(group), size)
+            los = jnp.asarray([lo for _, lo, _ in group], jnp.int32)
+            _, grads = fn(local_params, xd, yd, los)
+            return grads
+        # mixed sizes (ragged tail grouped with full batches): per-batch
+        # slice + grad, tree-mean
+        acc = None
+        for _, lo, size in group:
+            sl = self._slice_for(size)
+            _, g = self._grad_fn(local_params, sl(xd, lo), sl(yd, lo))
+            acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
+        return jax.tree.map(lambda v: v / len(group), acc)
+
+    def _take_batches(self, budget: int, device) -> List[Tuple[Any, Any, Any]]:
         """Pull up to ``budget`` batches; blocks (5 s) only for the first.
+
+        Each batch is staged to the worker's device AS TAKEN (async
+        ``device_put``): the transfer of batch i+1 overlaps whatever the
+        device is still computing, instead of one big blocking host-side
+        stack per upload. Returns ``(batch, x_dev, y_dev)`` triples.
 
         A starved queue mid-group does not stall the upload: the worker
         proceeds with the batches it has (the mean-gradient semantics hold
         for any group size)."""
-        group: List[Any] = []
+        group: List[Tuple[Any, Any, Any]] = []
         while len(group) < budget:
             batch = self.dataset.next(timeout=5.0 if not group else 0.05)
             if batch is None:
                 break
-            group.append(batch)
+            if self.stage_dataset:
+                if self.dataset._preprocess:
+                    raise RuntimeError(
+                        "stage_dataset=True bypasses batch materialization "
+                        "and cannot honor host preprocess callbacks — "
+                        "disable staging or drop the preprocess chain")
+                bs = self.dataset.config.batch_size
+                lo = batch.batch * bs
+                size = min(lo + bs, len(self.dataset.x)) - lo
+                group.append((batch, lo, size))
+            else:
+                group.append((
+                    batch,
+                    jax.device_put(jnp.asarray(batch.x), device),
+                    jax.device_put(jnp.asarray(batch.y), device),
+                ))
         return group
 
     def train(self, num_workers: Optional[int] = None) -> Dict[str, int]:
@@ -322,6 +569,12 @@ class AsyncSGDTrainer:
                 t.join()
         if errors:
             raise errors[0]
+        # drain the async dispatch tail: applied/rejected are host-side
+        # counters — the final parameter state must actually exist on
+        # device before train() claims completion (otherwise wall-clock
+        # around train() measures dispatch rate, not training rate)
+        if self.params is not None:
+            jax.block_until_ready(self.params)
         return {
             "applied": self.applied_updates,
             "rejected": self.rejected_updates,

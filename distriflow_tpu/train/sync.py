@@ -255,6 +255,17 @@ class SyncTrainer:
                 def micro(carry, xyw):
                     gacc, lacc, wacc = carry
                     mx, my, mw = xyw
+                    # re-pin each micro-slice to the batch sharding: the
+                    # [B] -> [accum, B/accum] reshape above splits the
+                    # data-axis tiling into a "superdim" op sharding that
+                    # the fused CE's custom_partitioning callback cannot
+                    # parse (jax explode_superdims assertion); the
+                    # constraint keeps row shardings expressible as a
+                    # PartitionSpec and the micro-step fully data-parallel
+                    sh = batch_sharding(self.mesh)
+                    mx, my, mw = (
+                        jax.lax.with_sharding_constraint(v, sh)
+                        for v in (mx, my, mw))
                     l, g = jax.value_and_grad(loss_fn)(state.params, mx, my, mw)
                     g = constrain_grads(g)
                     wsum = jnp.sum(mw)
@@ -351,16 +362,18 @@ class SyncTrainer:
         shapes/dtypes (no data ever moves to the device) and results are
         cached per batch signature.
 
-        The tally follows the same per-device convention as XLA's analysis
-        for shard_map'd kernels (flash attention traces with per-shard
-        shapes, recording its per-device slice). Known caveats: (a) the
-        fused CE records full-N rows while its custom_partitioning rule
-        executes N/devices rows per device — on a multi-device data mesh
-        the CE share (~1% of step FLOPs) over-counts by the data degree;
-        exact on one device; (b) a ``lax.scan`` body is traced once, so
-        Pallas calls inside ``grad_accum`` micro-steps record one
-        iteration's cost (MFU then under-reports; use grad_accum=1 when
-        benchmarking utilization)."""
+        The tally follows the same per-device convention as XLA's
+        analysis, with two corrections applied here (round-3 ADVICE —
+        both were documented caveats before): (a) the fused CE records
+        GLOBAL row counts (its custom_partitioning split happens at
+        compile time, invisible to the abstract trace) while the
+        shard_map'd kernels trace per-shard — the CE's category share is
+        divided by the mesh's ``data``-axis degree; (b) a ``lax.scan``
+        body is traced once but executes ``grad_accum`` times — with
+        micro-batching every model Pallas call sits inside the scan body
+        (and traces at micro-batch shapes), so the whole tally is
+        multiplied by ``grad_accum``. Both corrections are
+        equality-tripwire-tested (tests/test_sync_train.py)."""
         if self.state is None:
             self.init()
         sharding = batch_sharding(self.mesh)
@@ -385,6 +398,22 @@ class SyncTrainer:
                 # eval_shape always traces (jit lowering may be cached and
                 # skip the Python-level kernel wrappers)
                 jax.eval_shape(self._one_step, state_structs, structs)
+            # correction (a): the fused CE's rows are split over the data
+            # axis at compile time but recorded at global N — rescale its
+            # category share to the per-device convention
+            data_degree = dict(
+                zip(self.mesh.axis_names, self.mesh.devices.shape)
+            ).get("data", 1)
+            ce = tally["by_category"].get("fused_ce")
+            if ce is not None and data_degree > 1:
+                for field in ("flops", "bytes_accessed", "transcendentals"):
+                    tally[field] -= ce[field] * (1.0 - 1.0 / data_degree)
+            # correction (b): with grad_accum > 1 every model Pallas call
+            # sits inside the micro-step scan body — traced once (at
+            # micro-batch shapes), executed grad_accum times
+            if self.grad_accum > 1:
+                for field in ("flops", "bytes_accessed", "transcendentals"):
+                    tally[field] *= self.grad_accum
             analysis["xla_flops"] = float(analysis.get("flops", 0.0))
             analysis["pallas_flops"] = tally["flops"]
             from distriflow_tpu.ops import default_interpret
@@ -427,9 +456,10 @@ class SyncTrainer:
         to XLA's count (see :meth:`cost_analysis`) — the round-2 "lower
         bound" caveat no longer applies. Exact for the straight-line kernel
         paths (tested to equality); the ring-attention loop is corrected
-        for trace-vs-execution multiplicity (tripwire-tested); the one
-        remaining approximation is Pallas calls under ``grad_accum``'s scan
-        (documented in :meth:`cost_analysis`).
+        for trace-vs-execution multiplicity (tripwire-tested), the fused
+        CE for the row-shard degree on data meshes, and the ``grad_accum``
+        scan for trace-once/execute-K multiplicity (both in
+        :meth:`cost_analysis`, equality-tripwire-tested).
         """
         if step_seconds is None:
             if self.mean_step_ms is None:

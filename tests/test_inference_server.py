@@ -168,3 +168,37 @@ def test_sampled_requests_not_batched(served):
     out = client.generate(prompt, n_tokens=4, temperature=0.7, seed=11)
     assert out.shape == (1, 6)
     assert server.decode_batches == b0  # batcher untouched
+
+
+def test_enqueue_after_stop_errors_immediately():
+    """TOCTOU fix (round-3 ADVICE): a greedy request whose handler passed
+    the dispatcher-alive check but enqueued only after stop()'s drain must
+    error promptly instead of holding its transport handler thread for the
+    600 s backstop. The race is forced deterministically: stop() runs to
+    completion between the liveness check and the queue put."""
+    import time
+
+    spec = transformer_lm(CFG, example_seq=16)
+    params = spec.init(jax.random.PRNGKey(0))
+    server = InferenceServer(CFG, params, port=0).setup()
+    orig_put = server._queue.put
+
+    def racing_put(item, *args, **kwargs):
+        server._queue.put = orig_put  # stop() itself must reach the queue
+        server.stop()  # full shutdown, including the final drain
+        orig_put(item, *args, **kwargs)
+
+    server._queue.put = racing_put
+    start = time.monotonic()
+    with pytest.raises(RuntimeError, match="stopped"):
+        server._on_generate("c0", {
+            "prompt": _packed_prompt(np.asarray([[1, 2, 3]], np.int32)),
+            "n_tokens": 4,
+        })
+    assert time.monotonic() - start < 5.0
+
+
+def _packed_prompt(arr):
+    from distriflow_tpu.utils.serialization import pack_bytes, serialize_array
+
+    return pack_bytes({"tokens": serialize_array(arr)})

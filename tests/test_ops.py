@@ -310,6 +310,44 @@ def test_cost_analysis_counts_pallas_flops(devices):
     assert mfu > 0
 
 
+def test_cost_analysis_ce_per_device_and_grad_accum(devices):
+    """Equality tripwires for the round-3 ADVICE corrections: (a) the
+    fused CE's tally share is divided by the data-mesh degree (it records
+    global rows; every other kernel records per-shard), and (b) the
+    grad_accum scan's trace-once/execute-K multiplicity is multiplied
+    back, so pallas_flops is invariant to micro-batching."""
+    from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+    from distriflow_tpu.parallel.mesh import data_parallel_mesh
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    mesh = data_parallel_mesh(devices)
+    # b=32 keeps every micro-batch divisible by the 8-device data axis
+    # at the grad_accum values below
+    b, s, v = 32, 32, 64
+    cfg = TransformerConfig(
+        vocab_size=v, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq=s, dtype=jnp.float32, use_flash_attention=False,
+        loss="fused_sparse_softmax_cross_entropy",  # CE is the only kernel
+    )
+    x = jnp.zeros((b, s), jnp.int32)
+    y = jnp.zeros((b, s), jnp.int32)
+
+    def analyzed(grad_accum):
+        spec = transformer_lm(cfg, mesh=mesh, example_seq=s)
+        t = SyncTrainer(spec, mesh=mesh, grad_accum=grad_accum)
+        t.init()
+        return t.cost_analysis((x, y))
+
+    base = analyzed(1)
+    # (a) per-device CE share: (5 fwd + 3 bwd) ops/element over the
+    # device's row slice (global b*s rows / 8 devices)
+    n_rows = b * s
+    assert base["pallas_flops"] == 8 * n_rows * v / len(devices)
+    # (b) micro-batching must not change the analyzed model FLOPs
+    assert analyzed(2)["pallas_flops"] == base["pallas_flops"]
+    assert analyzed(4)["pallas_flops"] == base["pallas_flops"]
+
+
 def test_flagship_loss_resolution(devices, monkeypatch):
     """loss=None resolves per-backend at spec-build time: fused sparse CE
     when the Pallas kernels compile (TPU) AND the mesh is single-device
@@ -386,9 +424,11 @@ def test_fused_ce_partitioned_no_allgather(devices):
 
 
 def test_fused_sparse_ce_vmap_still_works():
-    """custom_partitioning has no batching rule; the loss must detect a
-    vmap trace and take the plain pallas path so vmap over the public op
-    keeps working (it did before the partitioning wrapper existed)."""
+    """custom_partitioning has no batching rule of its own; the kernel
+    wrapper's custom_vmap rule collapses the batch axis into rows, so
+    vmap over the public op keeps working — including the jit
+    compositions in both orders (round-3 sniffed batch tracers and
+    failed under ``vmap(jit(f))``)."""
     from distriflow_tpu.ops import fused_sparse_softmax_cross_entropy_per_example
 
     rng = np.random.RandomState(9)
@@ -406,9 +446,66 @@ def test_fused_sparse_ce_vmap_still_works():
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-6)
 
 
+def test_fused_sparse_ce_vmap_jit_compositions():
+    """The round-3 hole: ``vmap(jit(loss))`` hid the batch trace from the
+    tracer probe and the custom_partitioning primitive failed under vmap.
+    The batching rule makes every composition order work, values AND
+    grads, plus nested vmap."""
+    from distriflow_tpu.ops import fused_sparse_softmax_cross_entropy_per_example
+
+    fn = fused_sparse_softmax_cross_entropy_per_example
+    rng = np.random.RandomState(11)
+    logits = jnp.asarray(rng.randn(4, 16, 30).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 30, (4, 16)), jnp.int32)
+    want = np.asarray(
+        optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+
+    for f in (jax.vmap(jax.jit(fn)), jax.jit(jax.vmap(fn))):
+        np.testing.assert_allclose(np.asarray(f(logits, labels)), want,
+                                   rtol=1e-5)
+
+    def per_batch_loss(l, y):
+        return jnp.mean(fn(l, y))
+
+    g = jax.vmap(jax.jit(jax.grad(per_batch_loss)))(logits, labels)
+    g_ref = jax.vmap(jax.grad(lambda l, y: jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(l, y))))(logits, labels)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-6)
+    g2 = jax.jit(jax.vmap(jax.grad(per_batch_loss)))(logits, labels)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(g_ref), atol=1e-6)
+
+    # nested vmap collapses recursively (one more leading dim)
+    nl = jnp.stack([logits, logits + 0.5])
+    ny = jnp.stack([labels, labels])
+    got_n = jax.vmap(jax.vmap(fn))(nl, ny)
+    want_n = optax.softmax_cross_entropy_with_integer_labels(nl, ny)
+    np.testing.assert_allclose(np.asarray(got_n), np.asarray(want_n),
+                               rtol=1e-5)
+
+    # unbatched-operand broadcast inside the rule: labels shared across
+    # the vmap axis
+    got_b = jax.vmap(fn, in_axes=(0, None))(logits, labels[0])
+    want_b = optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.broadcast_to(labels[0], labels.shape))
+    np.testing.assert_allclose(np.asarray(got_b), np.asarray(want_b),
+                               rtol=1e-5)
+
+
+def test_fused_ce_no_private_jax_imports():
+    """Tripwire (round-3 ADVICE): the kernel module must not import
+    private ``jax._src`` modules — a JAX upgrade moving one would break
+    every training step that uses the default LM loss."""
+    import inspect
+
+    from distriflow_tpu.ops import fused_ce
+
+    src = inspect.getsource(fused_ce)
+    assert "jax._src" not in src
+
+
 def test_fused_dense_ce_partitioned_and_vmap(devices):
     """Dense-target fused CE: same rows-sharded partitioning (targets ride
-    with the logits) and the same vmap fallback."""
+    with the logits) and the same batch-collapsing vmap rule."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     mesh = Mesh(np.array(devices), ("data",))

@@ -103,11 +103,22 @@ def test_url_shard_in_subdirectory(http_root):
     np.testing.assert_allclose(got, weights["dense_1/kernel"], rtol=1e-6)
 
 
-def test_url_missing_shard_warns_and_cold_inits(http_root):
+def test_url_missing_shard_raises(http_root):
+    """A manifest-named shard that fails to fetch must RAISE (round-3
+    ADVICE): over HTTP that's usually a transient network error, and the
+    reference's tf.loadLayersModel rejects too — silently cold-initing
+    would hand back a garbage model that trains without error."""
     root, base = http_root
     _write_model(root, with_shard=False)
-    with pytest.warns(UserWarning, match="UNTRAINED"):
-        spec = spec_from_url(f"{base}/model.json")
+    with pytest.raises(OSError, match="load_weights=False"):
+        spec_from_url(f"{base}/model.json")
+
+
+def test_url_missing_shard_explicit_cold_init(http_root):
+    """Cold init stays available, but only as an explicit opt-in."""
+    root, base = http_root
+    _write_model(root, with_shard=False)
+    spec = spec_from_url(f"{base}/model.json", load_weights=False)
     params = spec.init(jax.random.PRNGKey(0))  # initializer weights
     assert np.asarray(params["dense_1"]["kernel"]).shape == (3, 4)
 
