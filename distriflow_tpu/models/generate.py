@@ -97,6 +97,31 @@ def _decode_module(config: TransformerConfig) -> TransformerLM:
     return TransformerLM(cfg, mesh=None, decode=True)
 
 
+def _tp_sharded(params) -> bool:
+    """True when any param leaf is sharded across devices (not fully
+    replicated) — the flash-decode kernel has no GSPMD rule, so TP-sharded
+    decoding must keep the XLA attention path (GSPMD propagates the
+    heads-sharded cache through its einsums; a pallas_call would force an
+    all-gather)."""
+    for leaf in jax.tree.leaves(params):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            continue
+        try:
+            if len(sharding.device_set) > 1 and not sharding.is_fully_replicated:
+                return True
+        except Exception:  # non-jax leaves (e.g. numpy): host-side, fine
+            continue
+    return False
+
+
+def _decode_cfg(config: TransformerConfig, params) -> TransformerConfig:
+    """Resolve the flash-decode auto gate against the actual params."""
+    if config.use_flash_decode is None and _tp_sharded(params):
+        return dataclasses.replace(config, use_flash_decode=False)
+    return config
+
+
 def _check_fits(p: int, n_tokens: int, config: TransformerConfig) -> None:
     if p + n_tokens > config.max_seq:
         raise ValueError(
@@ -288,7 +313,9 @@ def beam_search(
     if n_tokens <= 0:
         return prompt, jnp.zeros((b,), jnp.float32)
     _check_fits(p, n_tokens, config)
-    search = _build_beam_fns(config, n_tokens, beam_size, length_penalty, eos_id)
+    search = _build_beam_fns(
+        _decode_cfg(config, params), n_tokens, beam_size, length_penalty,
+        eos_id)
     return search(params, jnp.asarray(prompt, jnp.int32))
 
 
@@ -392,7 +419,8 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
     prefill, pick, decode_steps = _build_fns(
-        config, n_tokens, temperature, top_k, top_p, eos_id
+        _decode_cfg(config, params), n_tokens, temperature, top_k, top_p,
+        eos_id
     )
 
     last_logits, cache = prefill(params, prompt)

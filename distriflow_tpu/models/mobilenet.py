@@ -93,6 +93,71 @@ class FrozenBatchNorm(nn.Module):
         return x * inv + shift
 
 
+class _OnePassGroupNorm(nn.Module):
+    """GroupNorm(group_size=8) via single-pass E[x]/E[x^2] statistics.
+
+    flax's GroupNorm computes two passes (mean, then centered variance)
+    over the [B, H*W, G, 8] view; the one-pass form halves the stats
+    reads and XLA fuses the normalize into the same sweep. Numerics: f32
+    accumulation, variance = max(E[x^2] - E[x]^2, 0) + eps — equivalent
+    within bf16 activation noise (tests/test_mobilenet.py).
+    """
+
+    eps: float = 1e-6  # flax GroupNorm default
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, h, w, c = x.shape
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        xg = x.reshape(b, h * w, c // 8, 8).astype(jnp.float32)
+        m = xg.mean(axis=(1, 3), keepdims=True)
+        m2 = (xg * xg).mean(axis=(1, 3), keepdims=True)
+        inv = jax.lax.rsqrt(jnp.maximum(m2 - m * m, 0.0) + self.eps)
+        y = ((xg - m) * inv).reshape(b, h, w, c)
+        return (y * scale + bias).astype(self.dtype)
+
+
+def _depthwise3x3_shift(x: jnp.ndarray, w: jnp.ndarray, stride: int) -> jnp.ndarray:
+    """Depthwise 3x3 as nine shifted multiply-accumulates.
+
+    A depthwise conv carries ~3% of MobileNet's FLOPs but ~38% of its
+    step time on the MXU (the systolic array has nothing to contract
+    over: one input channel per output channel). Expressed as nine
+    shift-MACs the op is pure VPU elementwise work over the NHWC lanes —
+    each term is ``x`` shifted by (ky, kx) times a per-channel scalar,
+    which XLA fuses into one pass over the activation.
+
+    Matches ``nn.Conv(padding="SAME", feature_group_count=C)`` bitwise in
+    f32 (tests/test_mobilenet.py): SAME semantics for k=3 are pad (1, 1)
+    at stride 1 and pad (0, 1) at stride 2 (even inputs).
+
+    ``w``: flax conv kernel, HWIO with I=1 — shape [3, 3, 1, C].
+    """
+    b, h, wd, c = x.shape
+    if stride == 1:
+        pads = ((1, 1), (1, 1))
+    else:
+        pads = ((0, 1), (0, 1))
+    xp = jnp.pad(x, ((0, 0), pads[0], pads[1], (0, 0)))
+    out_h = (h + sum(pads[0]) - 3) // stride + 1
+    out_w = (wd + sum(pads[1]) - 3) // stride + 1
+    acc = None
+    for ky in range(3):
+        for kx in range(3):
+            sl = jax.lax.slice(
+                xp,
+                (0, ky, kx, 0),
+                (b, ky + (out_h - 1) * stride + 1,
+                 kx + (out_w - 1) * stride + 1, c),
+                (1, stride, stride, 1),
+            )
+            term = sl * w[ky, kx, 0]
+            acc = term if acc is None else acc + term
+    return acc
+
+
 class _ConvNorm(nn.Module):
     """conv -> norm (GroupNorm | frozen BatchNorm) -> optional relu6."""
 
@@ -103,22 +168,39 @@ class _ConvNorm(nn.Module):
     act: bool = True
     norm: str = "group"
     dtype: Any = jnp.float32
+    depthwise_impl: str = "conv"  # "conv" | "shift" (9 shift-MACs, VPU)
+    gn_impl: str = "flax"  # "flax" | "onepass" (single-sweep statistics)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = nn.Conv(
-            self.features,
-            kernel_size=self.kernel,
-            strides=(self.stride, self.stride),
-            padding="SAME",
-            feature_group_count=self.groups,
-            use_bias=False,
-            dtype=self.dtype,
-        )(x)
+        in_ch = x.shape[-1]
+        if (self.depthwise_impl == "shift" and self.kernel == (3, 3)
+                and self.groups == in_ch and self.features == in_ch):
+            w = self.param(
+                "kernel",
+                nn.initializers.lecun_normal(),
+                (3, 3, 1, in_ch),
+                jnp.float32,
+            ).astype(self.dtype)
+            x = _depthwise3x3_shift(x.astype(self.dtype), w, self.stride)
+        else:
+            x = nn.Conv(
+                self.features,
+                kernel_size=self.kernel,
+                strides=(self.stride, self.stride),
+                padding="SAME",
+                feature_group_count=self.groups,
+                use_bias=False,
+                dtype=self.dtype,
+            )(x)
         if self.norm == "batch":
             x = FrozenBatchNorm(dtype=self.dtype)(x)
         elif self.norm == "group":
-            x = nn.GroupNorm(num_groups=None, group_size=8, dtype=self.dtype)(x)
+            if self.gn_impl == "onepass":
+                x = _OnePassGroupNorm(dtype=self.dtype)(x)
+            else:
+                x = nn.GroupNorm(num_groups=None, group_size=8,
+                                 dtype=self.dtype)(x)
         else:  # validate here too: the module classes are public
             raise ValueError(f"norm must be 'group' or 'batch', got {self.norm!r}")
         return nn.relu6(x) if self.act else x
@@ -132,13 +214,16 @@ class InvertedResidual(nn.Module):
     expand: int = 6
     norm: str = "group"
     dtype: Any = jnp.float32
+    depthwise_impl: str = "conv"
+    gn_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         in_ch = x.shape[-1]
         h = x
         if self.expand != 1:
-            h = _ConvNorm(in_ch * self.expand, norm=self.norm, dtype=self.dtype)(h)
+            h = _ConvNorm(in_ch * self.expand, norm=self.norm,
+                          dtype=self.dtype, gn_impl=self.gn_impl)(h)
         h = _ConvNorm(
             h.shape[-1],
             kernel=(3, 3),
@@ -146,8 +231,11 @@ class InvertedResidual(nn.Module):
             groups=h.shape[-1],
             norm=self.norm,
             dtype=self.dtype,
+            depthwise_impl=self.depthwise_impl,
+            gn_impl=self.gn_impl,
         )(h)
-        h = _ConvNorm(self.out_ch, act=False, norm=self.norm, dtype=self.dtype)(h)
+        h = _ConvNorm(self.out_ch, act=False, norm=self.norm,
+                      dtype=self.dtype, gn_impl=self.gn_impl)(h)
         if self.stride == 1 and in_ch == self.out_ch:
             h = h + x
         return h
@@ -159,13 +247,15 @@ class MobileNetV2(nn.Module):
     schedule: Sequence[Tuple[int, int, int, int]] = V2_SCHEDULE
     norm: str = "group"
     dtype: Any = jnp.float32
+    depthwise_impl: str = "conv"
+    gn_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x.astype(self.dtype)
         x = _ConvNorm(
             _make_divisible(32 * self.width), kernel=(3, 3), stride=2,
-            norm=self.norm, dtype=self.dtype
+            norm=self.norm, dtype=self.dtype, gn_impl=self.gn_impl
         )(x)
         for t, c, n, s in self.schedule:
             out_ch = _make_divisible(c * self.width)
@@ -176,9 +266,12 @@ class MobileNetV2(nn.Module):
                     expand=t,
                     norm=self.norm,
                     dtype=self.dtype,
+                    depthwise_impl=self.depthwise_impl,
+                    gn_impl=self.gn_impl,
                 )(x)
         head = _make_divisible(1280 * max(1.0, self.width))
-        x = _ConvNorm(head, norm=self.norm, dtype=self.dtype)(x)
+        x = _ConvNorm(head, norm=self.norm, dtype=self.dtype,
+                      gn_impl=self.gn_impl)(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.classes, dtype=self.dtype)(x)
         return x
@@ -190,6 +283,8 @@ def mobilenet_v2(
     width: float = 1.0,
     norm: str = "group",
     dtype: Any = jnp.float32,
+    depthwise_impl: str = "conv",
+    gn_impl: str = "flax",
 ) -> ModelSpec:
     """BASELINE config #5 model (ImageNet-subset, sync-SGD, v4-32 stretch).
 
@@ -199,8 +294,14 @@ def mobilenet_v2(
     """
     if norm not in ("group", "batch"):
         raise ValueError(f"norm must be 'group' or 'batch', got {norm!r}")
+    if depthwise_impl not in ("conv", "shift"):
+        raise ValueError(
+            f"depthwise_impl must be 'conv' or 'shift', got {depthwise_impl!r}")
+    if gn_impl not in ("flax", "onepass"):
+        raise ValueError(f"gn_impl must be 'flax' or 'onepass', got {gn_impl!r}")
     return spec_from_flax(
-        MobileNetV2(classes=classes, width=width, norm=norm, dtype=dtype),
+        MobileNetV2(classes=classes, width=width, norm=norm, dtype=dtype,
+                    depthwise_impl=depthwise_impl, gn_impl=gn_impl),
         input_shape=(image_size, image_size, 3),
         output_shape=(classes,),
         name="mobilenet_v2",

@@ -90,11 +90,19 @@ class TransformerConfig:
     loss: Optional[str] = None
     # decode-time KV cache precision. None = cfg.dtype. "int8" halves the
     # cache's HBM footprint AND the per-token read traffic — decode at long
-    # context is KV-read bandwidth-bound (measured at ~peak HBM BW on v5e,
-    # docs/PERFORMANCE.md §8), so this is the lever that actually moves
-    # per-token latency there. Symmetric per-(position, head) absmax
-    # quantization; scales stored alongside in float32.
+    # context is KV-read bandwidth-bound (docs/PERFORMANCE.md §8), so this
+    # is the lever that moves per-token latency there. Symmetric
+    # per-(position, head) absmax quantization; scales stored alongside in
+    # float32. Pays off through the flash-decode kernel (in-VMEM dequant);
+    # the XLA fallback materializes the dequantized cache and loses.
     kv_cache_dtype: Optional[str] = None
+    # single-token decode attention via the Pallas flash-decode kernel
+    # (ops/flash_decode.py): one fused pass over the KV cache instead of
+    # XLA's matvec/softmax/matvec round trips (~25% of HBM peak measured).
+    # None = auto: on where the flash kernels compile (TPU), off for
+    # mesh-sharded params (pallas_call has no GSPMD rule — generate()
+    # auto-detects and disables so TP decode keeps its collective layout).
+    use_flash_decode: Optional[bool] = None
 
     def __post_init__(self):
         if self.n_experts > 0 and not 1 <= self.moe_top_k <= self.n_experts:
@@ -320,6 +328,31 @@ class Attention(nn.Module):
                 cv.value, v.astype(cfg.dtype), (0, 0, idx, 0))
             keys, vals = ck.value, cv.value
         ci.value = idx + s
+
+        use_fd = cfg.use_flash_decode
+        if use_fd is None:
+            use_fd = _default_use_flash()
+        if use_fd and s == 1:
+            # flash-decode kernel: one fused pass over the cache (online
+            # softmax in VMEM scratch); int8 caches dequantize per tile
+            # IN VMEM — see ops/flash_decode.py
+            from distriflow_tpu.ops.flash_decode import flash_decode
+
+            qf = q[:, :, 0, :]  # [B, H, D]
+            if quant:
+                ctx = flash_decode(
+                    qf, ck.value, cv.value, idx + s,
+                    k_scale=sk.value, v_scale=sv.value,
+                )
+            else:
+                ctx = flash_decode(qf, keys, vals, idx + s)
+            out = ctx[:, :, None, :].astype(cfg.dtype)
+            out = out.transpose(0, 2, 1, 3)
+            return nn.DenseGeneral(
+                cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype,
+                use_bias=False,
+            )(out)
+
         scores = jnp.einsum(
             "bhqd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32
         ) / math.sqrt(head_dim)  # [B, H, s, max_seq]

@@ -1,0 +1,184 @@
+"""Single-token decode attention as a Pallas TPU kernel (flash-decode).
+
+The XLA decode path (``models/transformer.py::_decode_attend``) computes
+``softmax(q·K^T)·V`` against the full ``[B, H, max_seq, D]`` cache with
+three separate HLO ops (QK^T matvec, softmax, PV matvec) — measured at
+only ~25% of HBM peak on v5e (BENCH decode rows: ~200 GB/s implied of
+819), because the [B, H, 1, S] f32 score tensor round-trips HBM between
+them and the matvecs under-fill the MXU. Decode at long context is
+KV-read bandwidth-bound, so the kernel's job is simple: stream K and V
+through VMEM exactly once, with the online-softmax recurrence in
+scratch, touching HBM only for the inputs and the [B, H, D] output.
+
+Shapes and grid:
+
+- q ``[B, H, D]`` (one token per batch row), K/V ``[B, H, S, D]``;
+- grid ``(B, S // BLOCK_K)`` — ALL heads ride in one tile (the head dim
+  is the sublane axis: H=8 fills a TPU tile exactly), so a 4k-context
+  B=8 token is 32 grid steps of ~2 MB DMA each, not 512 tiny ones (the
+  first cut used grid ``(B*H, ...)`` and lost its bandwidth win to
+  per-step overhead);
+- the KV axis is a sequential ("arbitrary") online reduction — running
+  max ``m``, exp-sum ``l``, and the context accumulator ``acc [H, D]``
+  live in VMEM scratch;
+- ``valid_len`` rides in as a scalar-prefetch operand: positions
+  ``>= valid_len`` (the cache tail past the write index) are masked.
+
+**int8 cache support**: with ``k_scale``/``v_scale`` operands
+(``[B, H, S, 1]`` f32, symmetric absmax per position), the kernel
+dequantizes per tile IN VMEM — the XLA path materializes the whole
+dequantized cache to HBM every token, which made int8 *slower* than
+bf16 (measured); in-kernel dequant is what converts the 2x byte saving
+into a time saving.
+
+Inference-only: no VJP (decode never backprops).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_K = 1024  # KV positions per tile (K+V tiles at H=8, D=64, bf16:
+# ~2 MB — two tiles double-buffered sit well inside VMEM)
+NEG_INF = -1e30
+
+
+def _attend_tile(len_ref, q_ref, o_ref, m_ref, l_ref, acc_ref,
+                 j, n_kv, block_k, k_tile, v_tile):
+    """Shared online-softmax tile update (K/V already dequantized)."""
+    q = q_ref[0].astype(jnp.float32)  # [H, D]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    # VPU formulation: Mosaic cannot lower batched dot_general, and the
+    # per-head contractions are matvecs the MXU cannot fill anyway —
+    # broadcast-multiply + reduce keeps everything in vector registers
+    s = jnp.sum(q[:, None, :] * k_tile, axis=-1) * scale  # [H, BK]
+    col = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < len_ref[0], s, NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)  # [H, BK]
+    l_ref[:] = jnp.broadcast_to(
+        l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+    pv = jnp.sum(p[:, :, None] * v_tile, axis=1)  # [H, D]
+    acc_ref[:] = acc_ref[:] * corr + pv
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def _init_scratch(j, m_ref, l_ref, acc_ref):
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, block_k, n_kv):
+    j = pl.program_id(1)
+    _init_scratch(j, m_ref, l_ref, acc_ref)
+    _attend_tile(len_ref, q_ref, o_ref, m_ref, l_ref, acc_ref,
+                 j, n_kv, block_k,
+                 k_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32))
+
+
+def _decode_kernel_quant(len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                         o_ref, m_ref, l_ref, acc_ref, *, block_k, n_kv):
+    j = pl.program_id(1)
+    _init_scratch(j, m_ref, l_ref, acc_ref)
+    k_tile = k_ref[0].astype(jnp.float32) * ks_ref[0].astype(jnp.float32)
+    v_tile = v_ref[0].astype(jnp.float32) * vs_ref[0].astype(jnp.float32)
+    _attend_tile(len_ref, q_ref, o_ref, m_ref, l_ref, acc_ref,
+                 j, n_kv, block_k, k_tile, v_tile)
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        from distriflow_tpu.ops import default_interpret
+
+        return default_interpret()
+    return interpret
+
+
+def flash_decode(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    block_k: int = BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Decode attention for ONE query token per batch row.
+
+    ``q``: [B, H, D]; ``k``/``v``: [B, H, S, D] (bf16/f32, or int8 with
+    ``k_scale``/``v_scale`` [B, H, S, 1] f32); ``valid_len``: int32
+    scalar — attend to positions [0, valid_len). Returns [B, H, D] in
+    ``q``'s dtype.
+    """
+    interpret = _resolve_interpret(interpret)
+    b, h, s, d = k.shape
+    block_k = min(block_k, s)
+    if s % block_k:
+        raise ValueError(f"seq {s} not a multiple of block_k {block_k}")
+    n_kv = s // block_k
+    quant = k_scale is not None
+    len1 = jnp.reshape(valid_len.astype(jnp.int32), (1,))
+
+    # index maps under PrefetchScalarGridSpec receive the scalar refs last
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda bi, j, lens: (bi, 0, 0)),
+        pl.BlockSpec((1, h, block_k, d), lambda bi, j, lens: (bi, 0, j, 0)),
+    ]
+    arrays = [q, k]
+    if quant:
+        in_specs.append(
+            pl.BlockSpec((1, h, block_k, 1), lambda bi, j, lens: (bi, 0, j, 0)))
+        arrays.append(k_scale)
+    in_specs.append(
+        pl.BlockSpec((1, h, block_k, d), lambda bi, j, lens: (bi, 0, j, 0)))
+    arrays.append(v)
+    if quant:
+        in_specs.append(
+            pl.BlockSpec((1, h, block_k, 1), lambda bi, j, lens: (bi, 0, j, 0)))
+        arrays.append(v_scale)
+
+    kernel = (
+        functools.partial(_decode_kernel_quant, block_k=block_k, n_kv=n_kv)
+        if quant else
+        functools.partial(_decode_kernel, block_k=block_k, n_kv=n_kv)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, n_kv),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, h, d), lambda bi, j, lens: (bi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((h, 128), jnp.float32),
+                pltpu.VMEM((h, 128), jnp.float32),
+                pltpu.VMEM((h, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(len1, *arrays)
+    return out

@@ -494,3 +494,42 @@ def test_int8_kv_cache_shapes_and_validation():
     assert any(v == jnp.int8 for v in kinds.values())
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         dataclasses.replace(CFG, kv_cache_dtype="fp4")
+
+
+def test_flash_decode_matches_xla_decode_path():
+    """use_flash_decode=True (Pallas single-token decode attention,
+    round-4) must reproduce the XLA decode path's generations exactly
+    (same math, fused; interpret mode on CPU), for both cache precisions."""
+    for kv in (None, "int8"):
+        cfg = dataclasses.replace(CFG, kv_cache_dtype=kv)
+        fcfg = dataclasses.replace(cfg, use_flash_decode=True)
+        params = _params(cfg)
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 6)), jnp.int32)
+        base = np.asarray(generate(cfg, params, x, 8))
+        flash = np.asarray(generate(fcfg, params, x, 8))
+        np.testing.assert_array_equal(base, flash)
+
+
+def test_flash_decode_auto_disabled_for_sharded_params(devices):
+    """generate()'s auto gate: mesh-sharded params keep the XLA decode
+    path (pallas_call has no GSPMD rule)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distriflow_tpu.models.generate import _decode_cfg, _tp_sharded
+
+    params = _params(CFG)
+    assert not _tp_sharded(params)
+    assert _decode_cfg(CFG, params).use_flash_decode is None  # auto stays
+
+    mesh = Mesh(np.array(devices), ("model",))
+    sharded = jax.tree.map(
+        lambda v: jax.device_put(
+            v, NamedSharding(mesh, P(*("model",) + (None,) * (v.ndim - 1))))
+        if v.ndim >= 1 and v.shape[0] % 8 == 0 else v,
+        params)
+    assert _tp_sharded(sharded)
+    assert _decode_cfg(CFG, sharded).use_flash_decode is False
+    # an explicit opt-in is honored verbatim (the user owns the tradeoff)
+    explicit = dataclasses.replace(CFG, use_flash_decode=True)
+    assert _decode_cfg(explicit, sharded).use_flash_decode is True

@@ -256,3 +256,45 @@ def test_frozen_mask_is_leaf_prefix_not_substring():
     assert mask["UnfrozenEncoder"]["unfrozen_bias"] is True
     assert mask["bn"]["frozen_mean"] is False
     assert mask["bn"]["scale"] is True
+
+
+def test_depthwise_shift_matches_conv():
+    """depthwise_impl="shift" (9 shift-MACs on the VPU, round-4) must be
+    numerically equivalent to the grouped-conv lowering, strides 1 and 2,
+    including flax's SAME padding asymmetry at stride 2."""
+    import flax.linen as nn
+
+    from distriflow_tpu.models.mobilenet import _depthwise3x3_shift
+
+    rng = np.random.RandomState(0)
+    for stride in (1, 2):
+        for hw in (8, 12):
+            x = jnp.asarray(rng.randn(2, hw, hw, 16).astype(np.float32))
+            conv = nn.Conv(16, kernel_size=(3, 3), strides=(stride, stride),
+                           padding="SAME", feature_group_count=16,
+                           use_bias=False)
+            params = conv.init(jax.random.PRNGKey(1), x)
+            want = conv.apply(params, x)
+            got = _depthwise3x3_shift(x, params["params"]["kernel"], stride)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-5, atol=1e-5)
+
+
+def test_mobilenet_shift_impl_trains(devices):
+    from distriflow_tpu.models.mobilenet import mobilenet_v2
+    from distriflow_tpu.train.sync import SyncTrainer
+    from distriflow_tpu.parallel import data_parallel_mesh
+
+    spec = mobilenet_v2(image_size=32, classes=10, depthwise_impl="shift")
+    mesh = data_parallel_mesh(jax.devices())
+    t = SyncTrainer(spec, mesh=mesh, learning_rate=0.05)
+    t.init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 32, 32, 3).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+    l0 = t.step((x, y))
+    for _ in range(3):
+        l = t.step((x, y))
+    assert np.isfinite(l)
+    with pytest.raises(ValueError, match="depthwise_impl"):
+        mobilenet_v2(image_size=32, depthwise_impl="winograd")
