@@ -130,16 +130,20 @@ def _depthwise3x3_shift(x: jnp.ndarray, w: jnp.ndarray, stride: int) -> jnp.ndar
     which XLA fuses into one pass over the activation.
 
     Matches ``nn.Conv(padding="SAME", feature_group_count=C)`` bitwise in
-    f32 (tests/test_mobilenet.py): SAME semantics for k=3 are pad (1, 1)
-    at stride 1 and pad (0, 1) at stride 2 (even inputs).
+    f32 (tests/test_mobilenet.py). SAME pads are computed from the input
+    parity — ``total = max((ceil(d/s)-1)*s + 3 - d, 0)`` split low/high —
+    so odd spatial dims at stride 2 (e.g. a 75-wide stage from
+    image_size=150) pad (1, 1) like XLA does, not the even-dim (0, 1).
 
     ``w``: flax conv kernel, HWIO with I=1 — shape [3, 3, 1, C].
     """
     b, h, wd, c = x.shape
-    if stride == 1:
-        pads = ((1, 1), (1, 1))
-    else:
-        pads = ((0, 1), (0, 1))
+
+    def same_pads(d):
+        total = max((-(-d // stride) - 1) * stride + 3 - d, 0)
+        return (total // 2, total - total // 2)
+
+    pads = (same_pads(h), same_pads(wd))
     xp = jnp.pad(x, ((0, 0), pads[0], pads[1], (0, 0)))
     out_h = (h + sum(pads[0]) - 3) // stride + 1
     out_w = (wd + sum(pads[1]) - 3) // stride + 1

@@ -276,25 +276,35 @@ class Attention(nn.Module):
 
         The first call (prefill, any ``s``) fills positions ``[0, s)``; each
         later call appends at the running index. q/k get RoPE at their
-        absolute positions. Decoding is matvec-bound, so this is the plain
-        XLA path (flash kernels buy nothing at query length 1) — and at
-        long context it runs at ~peak HBM bandwidth reading the cache
-        (docs/PERFORMANCE.md §8), which is why the only real lever here is
-        ``kv_cache_dtype="int8"``: the cache stores symmetric
-        per-(position, head) absmax-quantized int8 K/V plus float32
-        scales, halving both the footprint and the per-token read traffic;
-        dequantization fuses into the attention einsums' read stream.
+        absolute positions.
+
+        **Token-major packed cache** (round 5): K/V are stored
+        ``[B, max_seq, H*D]`` — each position's all-head features
+        contiguous — not the torch-style ``[B, H, S, D]``. At head_dim 64
+        the head-major layout half-fills every 128-lane TPU vector
+        register and capped the decode kernel's DMA at ~300 GB/s; the
+        packed tiles stream at ~690 GB/s (measured on v5e — see
+        ops/flash_decode.py). It is also write-natural: the projections
+        produce ``[B, S, H, D]``, so appending a token is one contiguous
+        ``[B, s, H*D]`` dynamic_update_slice with no transpose.
+
+        Long-context per-token cost is KV-read-bound, so the second lever
+        is ``kv_cache_dtype="int8"``: symmetric per-(position, head)
+        absmax-quantized K/V (``[B, max_seq, H]`` f32 scales), halving
+        footprint and read traffic; the flash kernel folds the scales
+        into its score/prob tensors in VMEM.
         """
         cfg = self.config
         quant = cfg.kv_cache_dtype == "int8"
-        cache_shape = (b, cfg.n_heads, cfg.max_seq, head_dim)
+        hd = cfg.n_heads * head_dim
+        cache_shape = (b, cfg.max_seq, hd)
         store_dtype = jnp.int8 if quant else cfg.dtype
         ck = self.variable("cache", "cached_k", jnp.zeros, cache_shape,
                            store_dtype)
         cv = self.variable("cache", "cached_v", jnp.zeros, cache_shape,
                            store_dtype)
         if quant:
-            scale_shape = (b, cfg.n_heads, cfg.max_seq, 1)
+            scale_shape = (b, cfg.max_seq, cfg.n_heads)
             sk = self.variable("cache", "k_scale", jnp.zeros, scale_shape,
                                jnp.float32)
             sv = self.variable("cache", "v_scale", jnp.zeros, scale_shape,
@@ -304,38 +314,55 @@ class Attention(nn.Module):
         idx = ci.value
         if cfg.use_rope:
             q, k = apply_rope(q, k, base=cfg.rope_base, offset=idx)
+        # q/k/v arrive [B, H, s, D]; the cache wants token-major [B, s, H*D]
+        k_tok = k.transpose(0, 2, 1, 3).reshape(b, s, hd)
+        v_tok = v.transpose(0, 2, 1, 3).reshape(b, s, hd)
 
-        def _quantize(t):
-            tf = t.astype(jnp.float32)
-            scale = jnp.max(jnp.abs(tf), axis=-1, keepdims=True) / 127.0
+        def _quantize(t):  # t: [B, s, H*D] -> int8 + [B, s, H] scales
+            tf = t.astype(jnp.float32).reshape(b, s, cfg.n_heads, head_dim)
+            scale = jnp.max(jnp.abs(tf), axis=-1) / 127.0  # [B, s, H]
             safe = jnp.maximum(scale, 1e-20)
-            q8 = jnp.clip(jnp.round(tf / safe), -127, 127).astype(jnp.int8)
-            return q8, scale
+            q8 = jnp.clip(jnp.round(tf / safe[..., None]), -127, 127)
+            return q8.astype(jnp.int8).reshape(b, s, hd), scale
 
         if quant:
-            k8, ks = _quantize(k)
-            v8, vs = _quantize(v)
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k8, (0, 0, idx, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v8, (0, 0, idx, 0))
-            sk.value = jax.lax.dynamic_update_slice(sk.value, ks, (0, 0, idx, 0))
-            sv.value = jax.lax.dynamic_update_slice(sv.value, vs, (0, 0, idx, 0))
-            keys = ck.value.astype(cfg.dtype) * sk.value.astype(cfg.dtype)
-            vals = cv.value.astype(cfg.dtype) * sv.value.astype(cfg.dtype)
+            k8, ks = _quantize(k_tok)
+            v8, vs = _quantize(v_tok)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k8, (0, idx, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v8, (0, idx, 0))
+            sk.value = jax.lax.dynamic_update_slice(sk.value, ks, (0, idx, 0))
+            sv.value = jax.lax.dynamic_update_slice(sv.value, vs, (0, idx, 0))
+            # dequantize in f32 and cast the PRODUCT, matching the flash
+            # kernel's in-VMEM dequant — casting the scales to bf16 first
+            # would diverge the two decode paths' numerics
+            keys = (ck.value.astype(jnp.float32).reshape(
+                b, cfg.max_seq, cfg.n_heads, head_dim)
+                * sk.value[..., None]).astype(cfg.dtype)
+            vals = (cv.value.astype(jnp.float32).reshape(
+                b, cfg.max_seq, cfg.n_heads, head_dim)
+                * sv.value[..., None]).astype(cfg.dtype)
         else:
             ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, 0, idx, 0))
+                ck.value, k_tok.astype(cfg.dtype), (0, idx, 0))
             cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, 0, idx, 0))
-            keys, vals = ck.value, cv.value
+                cv.value, v_tok.astype(cfg.dtype), (0, idx, 0))
+            keys = ck.value.reshape(b, cfg.max_seq, cfg.n_heads, head_dim)
+            vals = cv.value.reshape(b, cfg.max_seq, cfg.n_heads, head_dim)
         ci.value = idx + s
 
         use_fd = cfg.use_flash_decode
         if use_fd is None:
-            use_fd = _default_use_flash()
+            # auto-enable only when the kernel can actually tile this
+            # cache shape (no sublane-aligned divisor fitting VMEM ->
+            # XLA fallback instead of raising mid-trace)
+            from distriflow_tpu.ops.flash_decode import supports_seq
+
+            use_fd = _default_use_flash() and supports_seq(
+                cfg.max_seq, hd=hd, quant=quant)
         if use_fd and s == 1:
-            # flash-decode kernel: one fused pass over the cache (online
-            # softmax in VMEM scratch); int8 caches dequantize per tile
-            # IN VMEM — see ops/flash_decode.py
+            # flash-decode kernel: one fused full-lane pass over the
+            # packed cache (online softmax in VMEM scratch); int8 scales
+            # fold in-kernel — see ops/flash_decode.py
             from distriflow_tpu.ops.flash_decode import flash_decode
 
             qf = q[:, :, 0, :]  # [B, H, D]
@@ -345,16 +372,15 @@ class Attention(nn.Module):
                     k_scale=sk.value, v_scale=sv.value,
                 )
             else:
-                ctx = flash_decode(qf, keys, vals, idx + s)
-            out = ctx[:, :, None, :].astype(cfg.dtype)
-            out = out.transpose(0, 2, 1, 3)
+                ctx = flash_decode(qf, ck.value, cv.value, idx + s)
+            out = ctx[:, None, :, :].astype(cfg.dtype)  # [B, 1, H, D]
             return nn.DenseGeneral(
                 cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype,
                 use_bias=False,
             )(out)
 
         scores = jnp.einsum(
-            "bhqd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32
+            "bhqd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
         ) / math.sqrt(head_dim)  # [B, H, s, max_seq]
         k_pos = jnp.arange(cfg.max_seq)[None, :]
         q_pos = idx + jnp.arange(s)[:, None]
@@ -366,9 +392,8 @@ class Attention(nn.Module):
         scores = jnp.where(visible, scores, -1e30)
         p = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vals, preferred_element_type=jnp.float32
-        ).astype(cfg.dtype)
-        out = out.transpose(0, 2, 1, 3)
+            "bhqk,bkhd->bqhd", p, vals, preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)  # [B, s, H, D]
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype, use_bias=False
         )(out)

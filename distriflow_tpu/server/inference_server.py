@@ -199,7 +199,11 @@ class InferenceServer:
             if not item.done.wait(timeout=600.0):
                 raise RuntimeError(
                     "batched generate timed out awaiting the dispatcher")
-            if item.error is not None:
+            # prefer result over error: the stop()-race path above can set
+            # error while a still-draining dispatcher concurrently serves
+            # the item — a request that actually computed must not be
+            # reported as "server stopped"
+            if item.result is None and item.error is not None:
                 raise item.error
             out = item.result
         else:

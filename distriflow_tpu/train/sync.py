@@ -408,12 +408,18 @@ class SyncTrainer:
             if ce is not None and data_degree > 1:
                 for field in ("flops", "bytes_accessed", "transcendentals"):
                     tally[field] -= ce[field] * (1.0 - 1.0 / data_degree)
+                    # keep the category breakdown consistent with the
+                    # corrected top-level tally (round-4 advisor: a
+                    # by_category consumer saw pre-correction numbers)
+                    ce[field] /= data_degree
             # correction (b): with grad_accum > 1 every model Pallas call
             # sits inside the micro-step scan body — traced once (at
             # micro-batch shapes), executed grad_accum times
             if self.grad_accum > 1:
                 for field in ("flops", "bytes_accessed", "transcendentals"):
                     tally[field] *= self.grad_accum
+                    for cat in tally["by_category"].values():
+                        cat[field] *= self.grad_accum
             analysis["xla_flops"] = float(analysis.get("flops", 0.0))
             analysis["pallas_flops"] = tally["flops"]
             from distriflow_tpu.ops import default_interpret

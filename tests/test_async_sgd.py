@@ -68,13 +68,15 @@ def test_admission_control_prevents_all_rejections(devices):
 
 def test_phase_accounting_accumulates(devices):
     """phase_ms carries the per-phase breakdown (stage/snapshot/fit/
-    submit/admission_wait) the round-3 verdict asked for."""
+    submit/admission_wait — round 3) plus the device-queue drain the
+    round-5 bench accounting sums against the wall clock."""
     t, _ = _trainer(n=128, bs=32, profile_phases=True)
     t.train(num_workers=2)
     assert set(t.phase_ms) == {"stage", "snapshot", "fit", "submit",
-                               "admission_wait"}
+                               "admission_wait", "drain"}
     assert t.phase_ms["fit"] > 0
     assert t.phase_ms["stage"] > 0
+    assert t.phase_ms["drain"] >= 0
 
 
 def test_stale_submit_rejected_manually(devices):

@@ -77,8 +77,9 @@ def test_tp_beam_search_token_for_token(tp_setup):
 
 
 def test_tp_cache_is_model_sharded(tp_setup):
-    """The KV cache must be REALLY sharded over 'model' on the heads dim
-    (GSPMD propagation from the column-sharded k/v projections) — a
+    """The KV cache must be REALLY sharded over 'model' on the packed
+    feature dim (GSPMD propagation from the column-sharded k/v
+    projections through the [B, S, H*D] token-major cache) — a
     replicated cache would silently erase the memory benefit."""
     _, params_tp, _ = tp_setup
     prefill, _, _ = _build_fns(CFG, 6, 0.0, None, None, None)
@@ -89,8 +90,8 @@ def test_tp_cache_is_model_sharded(tp_setup):
     assert k_leaves
     for leaf in k_leaves:
         assert "model" in (leaf.sharding.spec or ()), leaf.sharding
-        # heads dim (axis 1) physically split
-        assert leaf.addressable_shards[0].data.shape[1] == leaf.shape[1] // 2
+        # packed head*dim axis (axis 2) physically split
+        assert leaf.addressable_shards[0].data.shape[2] == leaf.shape[2] // 2
 
 
 def test_inference_server_serves_tp_sharded_params(tp_setup):
