@@ -358,7 +358,8 @@ class Attention(nn.Module):
             from distriflow_tpu.ops.flash_decode import supports_seq
 
             use_fd = _default_use_flash() and supports_seq(
-                cfg.max_seq, hd=hd, quant=quant)
+                cfg.max_seq, hd=hd,
+                kv_item=jnp.dtype(store_dtype).itemsize)
         if use_fd and s == 1:
             # flash-decode kernel: one fused full-lane pass over the
             # packed cache (online softmax in VMEM scratch); int8 scales

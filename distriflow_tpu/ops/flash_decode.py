@@ -64,17 +64,18 @@ VMEM_LIMIT_BYTES = 16 * 1024 * 1024  # TPU scoped-vmem compile limit
 NEG_INF = -1e30
 
 
-def pick_block_k(s: int, hd: int = 512, quant: bool = False,
+def pick_block_k(s: int, hd: int = 512, kv_item: int = 2,
                  limit: int = BLOCK_K) -> Optional[int]:
-    """KV tile length for a cache of ``s`` positions and packed feature
-    width ``hd``: the largest candidate that (a) divides ``s``, (b) is
+    """KV tile length for a cache of ``s`` positions, packed feature
+    width ``hd``, and cache itemsize ``kv_item`` (1=int8, 2=bf16,
+    4=f32): the largest candidate that (a) divides ``s``, (b) is
     sublane-aligned (multiple of 8, or ``s`` itself — Mosaic accepts a
     block equal to the array dim), and (c) fits the scoped-VMEM model —
-    wide-head configs shrink the tile instead of dying in the Mosaic
-    compiler. None when no candidate qualifies: callers fall back to the
-    XLA decode path rather than crash at trace time."""
+    wide-head or f32 configs shrink the tile instead of dying in the
+    Mosaic compiler. None when no candidate qualifies: callers fall
+    back to the XLA decode path rather than crash at trace time."""
     def fits(bk):
-        return _vmem_estimate_bytes(bk, hd, quant) <= VMEM_LIMIT_BYTES
+        return _vmem_estimate_bytes(bk, hd, kv_item) <= VMEM_LIMIT_BYTES
 
     if s <= limit and fits(s):
         return s
@@ -84,21 +85,22 @@ def pick_block_k(s: int, hd: int = 512, quant: bool = False,
     return None
 
 
-def supports_seq(s: int, hd: int = 512, quant: bool = False) -> bool:
+def supports_seq(s: int, hd: int = 512, kv_item: int = 2) -> bool:
     """True when :func:`flash_decode` can tile a cache of length ``s``
-    at packed width ``hd`` — the gate ``models/transformer.py`` uses
-    before auto-enabling the kernel (an unsupported shape falls back to
-    XLA decode instead of raising mid-trace)."""
-    return pick_block_k(s, hd, quant) is not None
+    at packed width ``hd`` and itemsize ``kv_item`` — the gate
+    ``models/transformer.py`` uses before auto-enabling the kernel (an
+    unsupported shape falls back to XLA decode instead of raising
+    mid-trace)."""
+    return pick_block_k(s, hd, kv_item) is not None
 
 
-def _vmem_estimate_bytes(block_k: int, hd: int, quant: bool) -> int:
+def _vmem_estimate_bytes(block_k: int, hd: int, kv_item: int) -> int:
     """Scoped-VMEM cost for one grid step: double-buffered K/V input
-    tiles, the int8 path's bf16 MXU casts, and the [BK, H]-class f32
+    tiles at the cache's OWN itemsize, the bf16 MXU cast copies any
+    non-bf16 cache pays (int8 and f32 alike), and the [BK, H]-class f32
     score/prob working set (small; folded into a 10% margin)."""
-    kv_item = 1 if quant else 2
     tiles = 2 * 2 * block_k * hd * kv_item  # K+V, double-buffered
-    casts = 2 * block_k * hd * 2 if quant else 0  # int8 -> bf16 for MXU
+    casts = 0 if kv_item == 2 else 2 * block_k * hd * 2  # -> bf16 for MXU
     return int((tiles + casts) * 1.1)
 
 
@@ -232,8 +234,9 @@ def flash_decode(
         raise ValueError(
             f"packed cache feature dim {hd} != n_heads*head_dim {h * d}")
     quant = k_scale is not None
+    kv_item = jnp.dtype(k.dtype).itemsize
     if block_k is None:
-        block_k = pick_block_k(s, hd, quant)
+        block_k = pick_block_k(s, hd, kv_item)
         if block_k is None:
             raise ValueError(
                 f"flash_decode: no tile for seq {s} at packed width {hd} "
@@ -245,14 +248,14 @@ def flash_decode(
         block_k = min(block_k, s)
         if s % block_k:
             raise ValueError(f"seq {s} not a multiple of block_k {block_k}")
-    est = _vmem_estimate_bytes(block_k, hd, quant)
+    est = _vmem_estimate_bytes(block_k, hd, kv_item)
     if not interpret and est > VMEM_LIMIT_BYTES:
         raise ValueError(
             f"flash_decode: estimated scoped-VMEM {est / 1e6:.1f} MB for "
-            f"block_k={block_k}, packed dim {hd}, quant={quant} exceeds "
-            f"the {VMEM_LIMIT_BYTES / 1e6:.0f} MB TPU limit — pass a "
-            "smaller block_k (a divisor of the cache length, multiple of "
-            "8), or let block_k=None pick one")
+            f"block_k={block_k}, packed dim {hd}, itemsize {kv_item} "
+            f"exceeds the {VMEM_LIMIT_BYTES / 1e6:.0f} MB TPU limit — "
+            "pass a smaller block_k (a divisor of the cache length, "
+            "multiple of 8), or let block_k=None pick one")
     n_kv = s // block_k
     len1 = jnp.reshape(valid_len.astype(jnp.int32), (1,))
 
