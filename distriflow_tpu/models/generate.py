@@ -22,14 +22,17 @@ tokens training itself dropped: zero with ample ``capacity_factor``,
 quantified in tests/test_generate.py for tight capacity. Dense-FFN configs
 decode exactly (teacher-forcing logits match the training forward).
 
-**TP-sharded decoding** (round 3): pass Megatron-sharded params (the
-``TRANSFORMER_TP_RULES`` layout) and the SAME jit-cached programs decode
-tensor-parallel — no bespoke path. GSPMD propagates the column-sharded
-q/k/v projections into a heads-sharded KV cache, keeps the attention
-einsums head-parallel, and row-shards + psums ``o_proj``; output is
-token-for-token identical to single-device decode (greedy, sampled, and
-beam — tests/test_tp_decode.py). The ``InferenceServer`` therefore serves
-model-sharded params unchanged.
+**TP-sharded decoding** (round 3; flash under TP round 5): pass
+Megatron-sharded params (the ``TRANSFORMER_TP_RULES`` layout) and the
+SAME jit-cached programs decode tensor-parallel — no bespoke path.
+GSPMD propagates the column-sharded q/k/v projections into a
+heads-sharded KV cache, keeps the attention einsums head-parallel, and
+row-shards + psums ``o_proj``; the flash-decode kernel participates via
+its own heads-sharded ``custom_partitioning`` rule
+(``ops/flash_decode.py::flash_decode_sharded``). Output is
+token-for-token identical to single-device decode (greedy, sampled,
+beam, and flash — tests/test_tp_decode.py). The ``InferenceServer``
+therefore serves model-sharded params unchanged.
 """
 
 from __future__ import annotations
@@ -95,31 +98,6 @@ def _decode_module(config: TransformerConfig) -> TransformerLM:
         moe_dense_dispatch=config.n_experts > 0 or config.moe_dense_dispatch,
     )
     return TransformerLM(cfg, mesh=None, decode=True)
-
-
-def _tp_sharded(params) -> bool:
-    """True when any param leaf is sharded across devices (not fully
-    replicated) — the flash-decode kernel has no GSPMD rule, so TP-sharded
-    decoding must keep the XLA attention path (GSPMD propagates the
-    heads-sharded cache through its einsums; a pallas_call would force an
-    all-gather)."""
-    for leaf in jax.tree.leaves(params):
-        sharding = getattr(leaf, "sharding", None)
-        if sharding is None:
-            continue
-        try:
-            if len(sharding.device_set) > 1 and not sharding.is_fully_replicated:
-                return True
-        except Exception:  # non-jax leaves (e.g. numpy): host-side, fine
-            continue
-    return False
-
-
-def _decode_cfg(config: TransformerConfig, params) -> TransformerConfig:
-    """Resolve the flash-decode auto gate against the actual params."""
-    if config.use_flash_decode is None and _tp_sharded(params):
-        return dataclasses.replace(config, use_flash_decode=False)
-    return config
 
 
 def _check_fits(p: int, n_tokens: int, config: TransformerConfig) -> None:
@@ -314,8 +292,7 @@ def beam_search(
         return prompt, jnp.zeros((b,), jnp.float32)
     _check_fits(p, n_tokens, config)
     search = _build_beam_fns(
-        _decode_cfg(config, params), n_tokens, beam_size, length_penalty,
-        eos_id)
+        config, n_tokens, beam_size, length_penalty, eos_id)
     return search(params, jnp.asarray(prompt, jnp.int32))
 
 
@@ -419,8 +396,7 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
     prefill, pick, decode_steps = _build_fns(
-        _decode_cfg(config, params), n_tokens, temperature, top_k, top_p,
-        eos_id
+        config, n_tokens, temperature, top_k, top_p, eos_id
     )
 
     last_logits, cache = prefill(params, prompt)

@@ -363,17 +363,20 @@ class Attention(nn.Module):
         if use_fd and s == 1:
             # flash-decode kernel: one fused full-lane pass over the
             # packed cache (online softmax in VMEM scratch); int8 scales
-            # fold in-kernel — see ops/flash_decode.py
-            from distriflow_tpu.ops.flash_decode import flash_decode
+            # fold in-kernel. The _sharded wrapper carries the
+            # heads-sharded GSPMD rule, so TP-sharded decode runs the
+            # kernel per model shard with no gather — see
+            # ops/flash_decode.py
+            from distriflow_tpu.ops.flash_decode import flash_decode_sharded
 
             qf = q[:, :, 0, :]  # [B, H, D]
             if quant:
-                ctx = flash_decode(
+                ctx = flash_decode_sharded(
                     qf, ck.value, cv.value, idx + s,
                     k_scale=sk.value, v_scale=sv.value,
                 )
             else:
-                ctx = flash_decode(qf, ck.value, cv.value, idx + s)
+                ctx = flash_decode_sharded(qf, ck.value, cv.value, idx + s)
             out = ctx[:, None, :, :].astype(cfg.dtype)  # [B, 1, H, D]
             return nn.DenseGeneral(
                 cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype,

@@ -226,6 +226,9 @@ def flash_decode(
     ``block_k`` raises a Python error with a remedy instead of a Mosaic
     compile crash — round-4's int8 kernel died with a 20 MB > 16 MB
     compiler internal that only surfaced on real hardware).
+
+    For GSPMD/TP contexts use :func:`flash_decode_sharded`, which wraps
+    this local kernel in a heads-sharded ``custom_partitioning`` rule.
     """
     interpret = _resolve_interpret(interpret)
     b, h, d = q.shape
@@ -310,3 +313,97 @@ def flash_decode(
         interpret=interpret,
     )(len1, *arrays)
     return out.reshape(b, h, d)
+
+
+# -- GSPMD partitioning ----------------------------------------------------
+#
+# Decode attention is HEAD-independent: each head attends to its own slice
+# of the packed cache. Under Megatron-style tensor parallelism the q/k/v
+# projections are column-sharded, so q arrives [B, H(model), D] and the
+# cache [B, S, (H*D)(model)] — exactly a per-shard instance of the same
+# kernel. custom_partitioning declares that (mirroring ops/fused_ce.py's
+# rows-sharded rule), which is what lets TP-sharded decoding keep the
+# flash kernel instead of the round-4 behavior (auto-gate OFF because a
+# bare pallas_call has no GSPMD rule and would force an all-gather).
+
+
+def _head_axis_degree(mesh, axes) -> int:
+    if axes is None:
+        return 1
+    names = (axes,) if isinstance(axes, str) else tuple(axes)
+    deg = 1
+    for a in names:
+        deg *= int(dict(mesh.shape)[a])
+    return deg
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_fd(quant: bool, interpret: bool):
+    """custom_partitioning-wrapped local kernel for one (quant, interpret)
+    signature. Head-sharded: q's axis-1 sharding drives everything; the
+    packed H*D cache axis and the [B, S, H] scale axis co-shard with it
+    (whole heads per shard), S stays replicated."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def fn(q, k, v, len1, *scales):
+        ks, vs = scales if quant else (None, None)
+        return flash_decode(q, k, v, len1, k_scale=ks, v_scale=vs,
+                            interpret=interpret)
+
+    wrapped = custom_partitioning(fn)
+
+    def _q_spec(mesh, arg_infos):
+        """(batch_axes, head_axes) from q's sharding — with the
+        crooked-head fallback applied HERE so infer and partition can
+        never disagree (a mismatch would make the partitioner insert a
+        reshard after every decode step)."""
+        spec = getattr(arg_infos[0].sharding, "spec", None) or P()
+        b = spec[0] if len(spec) >= 1 else None
+        hx = spec[1] if len(spec) >= 2 else None
+        h_total = arg_infos[0].shape[1]
+        if h_total % max(_head_axis_degree(mesh, hx), 1):
+            hx = None  # crooked head split: replicate heads instead
+        return b, hx
+
+    def infer(mesh, arg_infos, result_infos):
+        b, hx = _q_spec(mesh, arg_infos)
+        return NamedSharding(mesh, P(b, hx, None))
+
+    def partition(mesh, arg_infos, result_infos):
+        b, hx = _q_spec(mesh, arg_infos)
+        q_sh = NamedSharding(mesh, P(b, hx, None))
+        kv_sh = NamedSharding(mesh, P(b, None, hx))
+        arg_sh = [q_sh, kv_sh, kv_sh, NamedSharding(mesh, P(None))]
+        if quant:
+            arg_sh += [kv_sh, kv_sh]  # [B, S, H] scales co-shard on H
+        return mesh, fn, NamedSharding(mesh, P(b, hx, None)), tuple(arg_sh)
+
+    rule = ("b h d, b s k, b s k, l -> b h d" if not quant else
+            "b h d, b s k, b s k, l, b s j, b s j -> b h d")
+    wrapped.def_partition(
+        partition=partition, infer_sharding_from_operands=infer,
+        sharding_rule=rule)
+    return wrapped
+
+
+def flash_decode_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """:func:`flash_decode` with a heads-sharded GSPMD partitioning rule —
+    safe (and a no-op) on unsharded operands; under tensor parallelism
+    each model shard runs the kernel on its own heads with no gather.
+    Head counts not divisible by the sharding degree replicate heads
+    (correct, just not sharded)."""
+    interpret = _resolve_interpret(interpret)
+    len1 = jnp.reshape(valid_len.astype(jnp.int32), (1,))
+    fn = _sharded_fd(k_scale is not None, bool(interpret))
+    if k_scale is not None:
+        return fn(q, k, v, len1, k_scale, v_scale)
+    return fn(q, k, v, len1)

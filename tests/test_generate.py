@@ -511,25 +511,8 @@ def test_flash_decode_matches_xla_decode_path():
         np.testing.assert_array_equal(base, flash)
 
 
-def test_flash_decode_auto_disabled_for_sharded_params(devices):
-    """generate()'s auto gate: mesh-sharded params keep the XLA decode
-    path (pallas_call has no GSPMD rule)."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    from distriflow_tpu.models.generate import _decode_cfg, _tp_sharded
-
-    params = _params(CFG)
-    assert not _tp_sharded(params)
-    assert _decode_cfg(CFG, params).use_flash_decode is None  # auto stays
-
-    mesh = Mesh(np.array(devices), ("model",))
-    sharded = jax.tree.map(
-        lambda v: jax.device_put(
-            v, NamedSharding(mesh, P(*("model",) + (None,) * (v.ndim - 1))))
-        if v.ndim >= 1 and v.shape[0] % 8 == 0 else v,
-        params)
-    assert _tp_sharded(sharded)
-    assert _decode_cfg(CFG, sharded).use_flash_decode is False
-    # an explicit opt-in is honored verbatim (the user owns the tradeoff)
-    explicit = dataclasses.replace(CFG, use_flash_decode=True)
-    assert _decode_cfg(explicit, sharded).use_flash_decode is True
+# Round 5: the round-4 TP auto-disable gate (_decode_cfg/_tp_sharded) is
+# gone — the flash-decode kernel carries its own heads-sharded
+# custom_partitioning rule, so TP-sharded params decode on the flash path
+# directly. Coverage:
+# tests/test_tp_decode.py::test_tp_flash_decode_token_for_token.

@@ -76,6 +76,23 @@ def test_tp_beam_search_token_for_token(tp_setup):
     np.testing.assert_allclose(np.asarray(tp_s), np.asarray(ref_s), rtol=1e-5)
 
 
+def test_tp_flash_decode_token_for_token(tp_setup):
+    """Round 5: the flash-decode kernel's heads-sharded
+    custom_partitioning rule (ops/flash_decode.py::flash_decode_sharded)
+    lets TP-sharded decoding keep the kernel — output must match the
+    replicated flash decode token for token, for both cache dtypes."""
+    import dataclasses
+
+    params, params_tp, _ = tp_setup
+    prompt = _prompt(seed=7)
+    for kv in (None, "int8"):
+        cfg = dataclasses.replace(CFG, use_flash_decode=True,
+                                  kv_cache_dtype=kv)
+        ref = np.asarray(generate(cfg, params, prompt, 8))
+        tp = np.asarray(generate(cfg, params_tp, prompt, 8))
+        np.testing.assert_array_equal(tp, ref)
+
+
 def test_tp_cache_is_model_sharded(tp_setup):
     """The KV cache must be REALLY sharded over 'model' on the packed
     feature dim (GSPMD propagation from the column-sharded k/v
