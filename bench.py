@@ -958,7 +958,7 @@ def bench_transformer_large(n_chips):
     squeeze = time_left() < 90
     return _bench_lm(n_chips, name="large", d_model=1024, n_layers=12,
                      d_ff=4096, batch=8, steps=3 if squeeze else 4,
-                     rounds=2, reps=2 if squeeze else 3)
+                     rounds=2 if squeeze else 3, reps=2 if squeeze else 3)
 
 
 # -- record assembly -------------------------------------------------------

@@ -189,6 +189,14 @@ def _default_use_flash() -> bool:
     return default_use_flash()
 
 
+def _flash_enabled(cfg) -> bool:
+    """THE flash-attention enable predicate — every attention site
+    (training __call__, prefill) resolves the tri-state config through
+    this one helper so the auto-enable policy cannot fork."""
+    return cfg.use_flash_attention or (
+        cfg.use_flash_attention is None and _default_use_flash())
+
+
 def _sharded_flash_attention(q, k, v, causal, mesh):
     """Flash attention that stays partitioned on a multi-device mesh.
 
@@ -260,16 +268,18 @@ class Attention(nn.Module):
             out = ulysses_attention(q, k, v, self.mesh, axis="seq",
                                     causal=cfg.causal,
                                     use_flash=cfg.use_flash_attention)
-        elif cfg.use_flash_attention or (
-            cfg.use_flash_attention is None and _default_use_flash()
-        ):
+        elif _flash_enabled(cfg):
             out = _sharded_flash_attention(q, k, v, cfg.causal, self.mesh)
         else:
             out = blockwise_attention(q, k, v, causal=cfg.causal)
         out = out.transpose(0, 2, 1, 3)  # [B, S, H, D]
+        return self._o_proj()(out)
+
+    def _o_proj(self):
+        cfg = self.config
         return nn.DenseGeneral(
-            cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype, use_bias=False
-        )(out)
+            cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype,
+            use_bias=False)
 
     def _decode_attend(self, q, k, v, b, s, head_dim):
         """Incremental attention against the mutable KV cache.
@@ -299,6 +309,15 @@ class Attention(nn.Module):
         hd = cfg.n_heads * head_dim
         cache_shape = (b, cfg.max_seq, hd)
         store_dtype = jnp.int8 if quant else cfg.dtype
+        # STATIC initial-prefill signal: the apply() that CREATES the cache
+        # variables (generate's prefill) sees has_variable == False at
+        # trace time — so the prompt-wide attention below can statically
+        # take the flash/blockwise path over the PROMPT instead of the
+        # dense einsum over max_seq (which materializes [B, H, s, max_seq]
+        # f32 — 68 GB at 16k context; the OOM that capped long-context
+        # serving). Continuations (decode steps, chunked prefill against a
+        # pre-existing cache) see True and keep the exact cache-wide paths.
+        fresh_cache = not self.has_variable("cache", "cached_k")
         ck = self.variable("cache", "cached_k", jnp.zeros, cache_shape,
                            store_dtype)
         cv = self.variable("cache", "cached_v", jnp.zeros, cache_shape,
@@ -350,6 +369,29 @@ class Attention(nn.Module):
             vals = cv.value.reshape(b, cfg.max_seq, cfg.n_heads, head_dim)
         ci.value = idx + s
 
+        if s > 1 and fresh_cache:
+            # initial prefill: the cache held only zeros, so attention
+            # over the prompt tokens IS the full answer — run the
+            # training-path kernels (O(s * block) VMEM tiles) on the
+            # exact pre-quantization projections. The dense einsum below
+            # would build [B, H, s, max_seq] f32 scores: 68 GB at 16k
+            # context. int8 configs quantize for STORAGE only — prefill
+            # quality is full-precision, like production engines. The
+            # _sharded kernel wrapper carries the batch/heads GSPMD rule
+            # so TP-sharded prefill stays sharded (a bare pallas_call
+            # would all-gather and replicate the whole prompt's
+            # attention on every chip).
+            if _flash_enabled(cfg):
+                from distriflow_tpu.ops.flash_attention import (
+                    flash_attention_sharded,
+                )
+
+                out = flash_attention_sharded(q, k, v, causal=cfg.causal)
+            else:
+                out = blockwise_attention(q, k, v, causal=cfg.causal)
+            out = out.transpose(0, 2, 1, 3)  # [B, s, H, D]
+            return self._o_proj()(out)
+
         use_fd = cfg.use_flash_decode
         if use_fd is None:
             # auto-enable only when the kernel can actually tile this
@@ -378,11 +420,22 @@ class Attention(nn.Module):
             else:
                 ctx = flash_decode_sharded(qf, ck.value, cv.value, idx + s)
             out = ctx[:, None, :, :].astype(cfg.dtype)  # [B, 1, H, D]
-            return nn.DenseGeneral(
-                cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype,
-                use_bias=False,
-            )(out)
+            return self._o_proj()(out)
 
+        if quant and s == 1:
+            # mirror the flash kernel's per-head absmax q quantization
+            # (ops/flash_decode.py scores int8 x int8 on the MXU): the
+            # XLA fallback is the kernel's reference implementation, so
+            # the two single-token paths stay numerically aligned —
+            # without this the kernel quantizes q and the fallback does
+            # not, a systematic divergence rather than rounding noise
+            # (tests assert argmax-stable token equality between them)
+            qf32 = q.astype(jnp.float32)
+            qsc = jnp.maximum(
+                jnp.max(jnp.abs(qf32), axis=-1, keepdims=True) / 127.0,
+                1e-20)
+            q = (jnp.clip(jnp.round(qf32 / qsc), -127, 127) * qsc).astype(
+                q.dtype)
         scores = jnp.einsum(
             "bhqd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
         ) / math.sqrt(head_dim)  # [B, H, s, max_seq]
@@ -398,9 +451,7 @@ class Attention(nn.Module):
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", p, vals, preferred_element_type=jnp.float32
         ).astype(cfg.dtype)  # [B, s, H, D]
-        return nn.DenseGeneral(
-            cfg.d_model, axis=(-2, -1), name="o_proj", dtype=cfg.dtype, use_bias=False
-        )(out)
+        return self._o_proj()(out)
 
 
 class DenseFFN(nn.Module):

@@ -477,3 +477,66 @@ def _bwd(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+# -- GSPMD partitioning (inference forward) --------------------------------
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_fa(causal: bool, interpret: Optional[bool]):
+    """custom_partitioning-wrapped forward for one (causal, interpret)
+    signature. Attention is embarrassingly parallel over batch and heads;
+    S and D stay replicated. Mirrors ops/flash_decode.py's heads-sharded
+    rule — without it, a bare pallas_call under TP-sharded activations
+    forces an all-gather and runs the whole prompt's attention replicated
+    on every chip."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def fn(q, k, v):
+        return flash_attention(q, k, v, causal=causal, interpret=interpret)
+
+    wrapped = custom_partitioning(fn)
+
+    def _bh_spec(mesh, arg_infos):
+        spec = getattr(arg_infos[0].sharding, "spec", None) or P()
+        b = spec[0] if len(spec) >= 1 else None
+        hx = spec[1] if len(spec) >= 2 else None
+        h_total = arg_infos[0].shape[1]
+        deg = 1
+        if hx is not None:
+            names = (hx,) if isinstance(hx, str) else tuple(hx)
+            for a in names:
+                deg *= int(dict(mesh.shape)[a])
+        if h_total % max(deg, 1):
+            hx = None  # crooked head split: replicate heads instead
+        return b, hx
+
+    def infer(mesh, arg_infos, result_infos):
+        b, hx = _bh_spec(mesh, arg_infos)
+        return NamedSharding(mesh, P(b, hx, None, None))
+
+    def partition(mesh, arg_infos, result_infos):
+        b, hx = _bh_spec(mesh, arg_infos)
+        sh = NamedSharding(mesh, P(b, hx, None, None))
+        return mesh, fn, sh, (sh, sh, sh)
+
+    wrapped.def_partition(
+        partition=partition, infer_sharding_from_operands=infer,
+        sharding_rule="b h s d, b h s d, b h s d -> b h s d")
+    return wrapped
+
+
+def flash_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """:func:`flash_attention` with a batch/heads-sharded GSPMD rule —
+    a no-op on unsharded operands; under tensor/data parallelism each
+    shard runs the kernel on its own batch rows and heads with no
+    gather. Inference-only (no VJP through the wrapper): the training
+    path uses shard_map via models/transformer.py instead."""
+    return _sharded_fa(bool(causal), interpret)(q, k, v)

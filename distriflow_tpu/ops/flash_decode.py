@@ -96,11 +96,13 @@ def supports_seq(s: int, hd: int = 512, kv_item: int = 2) -> bool:
 
 def _vmem_estimate_bytes(block_k: int, hd: int, kv_item: int) -> int:
     """Scoped-VMEM cost for one grid step: double-buffered K/V input
-    tiles at the cache's OWN itemsize, the bf16 MXU cast copies any
-    non-bf16 cache pays (int8 and f32 alike), and the [BK, H]-class f32
-    score/prob working set (small; folded into a 10% margin)."""
+    tiles at the cache's OWN itemsize, the bf16 MXU cast copies the
+    non-bf16 tiles pay, and the [BK, H]-class f32 score/prob working set
+    (small; folded into a 10% margin). int8 K contracts natively on the
+    s8 MXU — only V casts; f32 caches cast both K and V."""
     tiles = 2 * 2 * block_k * hd * kv_item  # K+V, double-buffered
-    casts = 0 if kv_item == 2 else 2 * block_k * hd * 2  # -> bf16 for MXU
+    cast_tiles = {2: 0, 1: 1, 4: 2}.get(kv_item, 2)
+    casts = cast_tiles * block_k * hd * 2  # -> bf16 for the MXU
     return int((tiles + casts) * 1.1)
 
 
@@ -181,16 +183,30 @@ def _decode_kernel(len_ref, qbd_ref, k_ref, v_ref, o_ref,
                  m_ref, l_ref, acc_ref, j, n_kv, block_k, h, s2)
 
 
-def _decode_kernel_quant(len_ref, qbd_ref, k_ref, ks_ref, v_ref, vs_ref,
-                         o_ref, m_ref, l_ref, acc_ref, *, block_k, n_kv, h):
-    """int8 tile update WITHOUT materializing dequantized K/V tiles: the
-    per-(position, head) scales factor out of the D contraction, so they
-    fold into the [BK, H] score/prob tensors — two [BK, H] multiplies
-    instead of two [BK, H*D] dequant products."""
+def _decode_kernel_quant(len_ref, qbd_ref, qs_ref, k_ref, ks_ref, v_ref,
+                         vs_ref, o_ref, m_ref, l_ref, acc_ref, *, block_k,
+                         n_kv, h):
+    """int8 tile update WITHOUT materializing dequantized K/V tiles.
+
+    Scores ride the native s8 MXU: ``qbd`` arrives pre-quantized
+    (per-head absmax int8, built by the caller), so ``K8 . Qbd8``
+    contracts int8 x int8 -> int32 with NO [BK, HD] cast copy of K — the
+    int8->bf16 relayout of both tiles was the single largest exposed
+    cost of the first packed int8 kernel (measured ~35 us/call at 4k on
+    v5e against a 41 us DMA floor). All three per-(position, head)
+    scales (q, K, V) factor out of the D contraction and fold into the
+    [BK, H] score/prob tensors. V still casts to bf16 for the PV matmul:
+    quantizing the probabilities as well measured 3.6% error (the
+    per-tile absmax under-resolves peaked softmax rows), so exact f32
+    probabilities are kept and only V pays a cast."""
     j = pl.program_id(1)
     _init_scratch(j, m_ref, l_ref, acc_ref)
     d = k_ref.shape[-1] // h
-    s2 = _qk_scores(qbd_ref, k_ref[0].astype(jnp.bfloat16), d) * ks_ref[0]
+    s_i32 = jax.lax.dot_general(
+        k_ref[0], qbd_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)  # [BK, H] on the s8 MXU
+    scale = 1.0 / (d ** 0.5)
+    s2 = s_i32.astype(jnp.float32) * ks_ref[0] * (qs_ref[0] * scale)
     _attend_tile(len_ref, v_ref[0].astype(jnp.bfloat16), o_ref,
                  m_ref, l_ref, acc_ref, j, n_kv, block_k, h, s2,
                  p_scale=vs_ref[0])
@@ -263,17 +279,35 @@ def flash_decode(
     len1 = jnp.reshape(valid_len.astype(jnp.int32), (1,))
 
     # block-diagonal query [B, HD, H]: head h's query in rows h*D:(h+1)*D
-    # of column h — the operand that turns all-head scores into ONE matmul
-    qbd = jnp.einsum(
-        "bhd,hg->bhdg", q.astype(jnp.float32),
-        jnp.eye(h, dtype=jnp.float32)).reshape(b, hd, h).astype(jnp.bfloat16)
+    # of column h — the operand that turns all-head scores into ONE
+    # matmul. The int8 path quantizes it per head (symmetric absmax) so
+    # the score contraction runs int8 x int8 on the MXU with no K cast;
+    # the q scale folds into the kernel's [BK, H] score multiply.
+    eye = jnp.eye(h, dtype=jnp.float32)
+    qf32 = q.astype(jnp.float32)
+    if quant:
+        qs = jnp.max(jnp.abs(qf32), axis=-1, keepdims=True) / 127.0
+        qs = jnp.maximum(qs, 1e-20)  # [B, H, 1]
+        q8 = jnp.clip(jnp.round(qf32 / qs), -127, 127)
+        qbd = jnp.einsum("bhd,hg->bhdg", q8, eye).reshape(
+            b, hd, h).astype(jnp.int8)
+        qs_row = qs[:, :, 0][:, None, :]  # [B, 1, H]
+    else:
+        qbd = jnp.einsum("bhd,hg->bhdg", qf32, eye).reshape(
+            b, hd, h).astype(jnp.bfloat16)
 
     # index maps under PrefetchScalarGridSpec receive the scalar refs last
     in_specs = [
         pl.BlockSpec((1, hd, h), lambda bi, j, lens: (bi, 0, 0)),
-        pl.BlockSpec((1, block_k, hd), lambda bi, j, lens: (bi, j, 0)),
     ]
-    arrays = [qbd, k]
+    arrays = [qbd]
+    if quant:
+        in_specs.append(
+            pl.BlockSpec((1, 1, h), lambda bi, j, lens: (bi, 0, 0)))
+        arrays.append(qs_row)
+    in_specs.append(
+        pl.BlockSpec((1, block_k, hd), lambda bi, j, lens: (bi, j, 0)))
+    arrays.append(k)
     if quant:
         in_specs.append(
             pl.BlockSpec((1, block_k, h), lambda bi, j, lens: (bi, j, 0)))
