@@ -93,6 +93,22 @@ def test_tp_flash_decode_token_for_token(tp_setup):
         np.testing.assert_array_equal(tp, ref)
 
 
+def test_tp_flash_prefill_and_decode_token_for_token(tp_setup):
+    """Round 5: with flash ATTENTION also enabled, the initial prefill
+    takes the fresh-cache fast path through flash_attention_sharded
+    (batch/heads custom_partitioning) — TP output must still match the
+    replicated run token for token."""
+    import dataclasses
+
+    params, params_tp, _ = tp_setup
+    prompt = _prompt(seed=11)
+    cfg = dataclasses.replace(CFG, use_flash_attention=True,
+                              use_flash_decode=True)
+    ref = np.asarray(generate(cfg, params, prompt, 6))
+    tp = np.asarray(generate(cfg, params_tp, prompt, 6))
+    np.testing.assert_array_equal(tp, ref)
+
+
 def test_tp_cache_is_model_sharded(tp_setup):
     """The KV cache must be REALLY sharded over 'model' on the packed
     feature dim (GSPMD propagation from the column-sharded k/v
