@@ -380,12 +380,16 @@ class Attention(nn.Module):
             # _sharded kernel wrapper carries the batch/heads GSPMD rule
             # so TP-sharded prefill stays sharded (a bare pallas_call
             # would all-gather and replicate the whole prompt's
-            # attention on every chip).
-            if _flash_enabled(cfg):
-                from distriflow_tpu.ops.flash_attention import (
-                    flash_attention_sharded,
-                )
+            # attention on every chip). Crooked prompt lengths the
+            # kernel cannot tile within VMEM (no sublane-aligned block
+            # divisor) take the pure-XLA blockwise path instead.
+            from distriflow_tpu.ops.flash_attention import (
+                flash_attention_sharded,
+                flash_seq_supported,
+            )
 
+            if _flash_enabled(cfg) and flash_seq_supported(
+                    s, head_dim, jnp.dtype(cfg.dtype).itemsize):
                 out = flash_attention_sharded(q, k, v, causal=cfg.causal)
             else:
                 out = blockwise_attention(q, k, v, causal=cfg.causal)

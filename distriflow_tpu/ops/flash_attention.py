@@ -35,7 +35,41 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from distriflow_tpu.ops.flop_count import record_pallas_cost
-from distriflow_tpu.parallel.ring_attention import _auto_block
+
+
+def _aligned_block(s: int, target: int) -> int:
+    """Largest SUBLANE-ALIGNED (multiple-of-8) divisor of ``s`` that is
+    ``<= target``, or ``s`` itself when it fits in one block — Mosaic
+    requires block dims divisible by 8 or equal to the array dim.
+    ring_attention's ``_auto_block`` (any divisor) is fine for its pure-XLA
+    blockwise path but produced e.g. 1022 for a 32,704-token prompt here,
+    which the Pallas lowering rejects (round-5 32k-context prefill)."""
+    if s <= target:
+        return s
+    for blk in range((target // 8) * 8, 0, -8):
+        if s % blk == 0:
+            return blk
+    # s > target with no aligned divisor (s itself not a multiple of 8):
+    # one whole-length block is the only Mosaic-legal tiling left
+    return s
+
+
+def flash_seq_supported(s: int, d: int, itemsize: int = 2,
+                        target: int = 1024) -> bool:
+    """True when the forward kernel can tile length ``s`` within VMEM.
+
+    Crooked lengths with no sublane-aligned divisor fall back to ONE
+    whole-length block — legal, but its q/k/v/o blocks plus the
+    ``(block_q, 128)`` f32 m/l/acc scratch scale linearly with ``s`` and
+    blow the ~16 MB scoped-VMEM budget somewhere around s~9k at D=64
+    (e.g. a 32,700-token prompt would need ~50 MB of scratch alone).
+    Callers with arbitrary sequence lengths (the decode-mode prefill)
+    consult this gate and use the pure-XLA blockwise path instead of
+    crashing in the Mosaic compiler."""
+    bq = _aligned_block(s, target)
+    est = 3 * bq * 128 * 4 + 4 * bq * d * itemsize  # m/l/acc + q/k/v/o
+    return int(est * 1.2) <= 16 * 1024 * 1024
+
 
 NEG_INF = -1e30
 _LANES = 128  # f32 tile width; m/l scratch is replicated across lanes
@@ -234,8 +268,8 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     fwd_cap, _ = _block_caps(q.dtype)
-    bq = _auto_block(s, min(block_q, fwd_cap))
-    bk = _auto_block(s, min(block_k, fwd_cap))
+    bq = _aligned_block(s, min(block_q, fwd_cap))
+    bk = _aligned_block(s, min(block_k, fwd_cap))
     n_q, n_kv = s // bq, s // bk
 
     # model FLOPs: QK^T + PV, each 2*B*H*S*S*D, halved by causal tile-skip —
@@ -319,8 +353,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     _, bwd_cap = _block_caps(q.dtype)
-    bq = _auto_block(s, min(block_q, bwd_cap))
-    bk = _auto_block(s, min(block_k, bwd_cap))
+    bq = _aligned_block(s, min(block_q, bwd_cap))
+    bk = _aligned_block(s, min(block_k, bwd_cap))
     n_q, n_kv = s // bq, s // bk
 
     # model FLOPs of the attention backward: dV = P^T dO, dP = dO V^T,

@@ -527,3 +527,47 @@ def test_fused_dense_ce_partitioned_and_vmap(devices):
     np.testing.assert_allclose(
         np.asarray(got_v),
         np.asarray(optax.softmax_cross_entropy(bl, bt)), rtol=1e-5)
+
+
+def test_flash_attention_crooked_length_blocks_are_sublane_aligned():
+    """Round-5 regression: a 32,704-token prompt (32k minus the generate
+    budget) made the old any-divisor block picker choose 1022, which the
+    Pallas lowering rejects (blocks must be multiples of 8 or the whole
+    dim). The aligned picker must find a multiple-of-8 divisor — and the
+    kernel must run end to end on such lengths."""
+    from distriflow_tpu.ops.flash_attention import (
+        _aligned_block,
+        flash_attention,
+    )
+
+    assert _aligned_block(32704, 1024) == 584  # 8*73, not 1022
+    assert _aligned_block(16256, 1024) == 1016
+    assert _aligned_block(4096, 1024) == 1024
+    assert _aligned_block(1000, 1024) == 1000  # one whole block
+    assert _aligned_block(2044, 1024) == 2044  # no aligned divisor: whole
+
+    from distriflow_tpu.ops.flash_attention import flash_seq_supported
+    from distriflow_tpu.parallel.ring_attention import blockwise_attention
+
+    rng = np.random.RandomState(0)
+    # whole-block fallback path (1022: no aligned divisor, fits VMEM)
+    q = jnp.asarray(rng.randn(1, 2, 1022, 32), jnp.float32)
+    out = flash_attention(q, q, q, causal=True, interpret=True)
+    want = blockwise_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # aligned MULTI-block path at a crooked length — the actual round-5
+    # bug shape class: 1168 -> two 584-wide tiles (review follow-up: the
+    # first regression test only exercised the whole-block fallback)
+    assert _aligned_block(1168, 1024) == 584
+    q2 = jnp.asarray(rng.randn(1, 2, 1168, 32), jnp.float32)
+    out2 = flash_attention(q2, q2, q2, causal=True, block_q=584,
+                           block_k=584, interpret=True)
+    want2 = blockwise_attention(q2, q2, q2, causal=True)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(want2),
+                               rtol=2e-5, atol=2e-5)
+    # VMEM gate: huge crooked lengths are unsupported -> callers (the
+    # prefill path) fall back to blockwise instead of a Mosaic crash
+    assert flash_seq_supported(32704, 64)   # aligned divisor exists
+    assert not flash_seq_supported(32700, 64)  # whole-block would be 50 MB
+    assert flash_seq_supported(5001, 64)    # small whole-block: fine
