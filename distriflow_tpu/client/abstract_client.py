@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 import uuid as uuid_lib
 from typing import Any, Callable, Dict, List, Optional
 
@@ -27,13 +28,17 @@ from distriflow_tpu.comm.transport import (
     CONNECT_TIMEOUT_S,
     HEARTBEAT_INTERVAL_S,
     HEARTBEAT_TIMEOUT_S,
+    AckTimeout,
     ClientTransport,
+    ConnectionLost,
+    FaultPlan,
 )
 from distriflow_tpu.models.base import DistributedModel, ModelSource, fetch_model
 from distriflow_tpu.utils.config import (
     COMPRESSION_DTYPES,
     DEFAULT_CLIENT_HYPERPARAMS,
     ClientHyperparams,
+    RetryPolicy,
     client_hyperparams,
 )
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
@@ -45,7 +50,15 @@ IDENTITY_FILE = ".distriflow-learner-uuid"  # cookie-equivalent persistence
 
 @dataclasses.dataclass
 class DistributedClientConfig:
-    """Reference ``DistributedClientConfig`` (``abstract_client.ts:22-28``)."""
+    """Reference ``DistributedClientConfig`` (``abstract_client.ts:22-28``).
+
+    The retry/reconnect knobs have no reference counterpart — the reference
+    client dies on the first ack timeout or dropped websocket. Uploads carry
+    a client-generated ``update_id`` so retrying after an ambiguous ack
+    timeout is safe (the server dedups), and a lost connection triggers a
+    background re-dial loop (``reconnect_retry``) that re-runs the handshake
+    and resumes the worker loop.
+    """
 
     client_id: Optional[str] = None
     hyperparams: Optional[Dict[str, Any]] = None
@@ -57,6 +70,22 @@ class DistributedClientConfig:
     upload_timeout_s: float = 60.0
     heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S  # 0 disables
     heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S  # server-loss detection
+    # upload retry: per-attempt ack timeout stays upload_timeout_s; these
+    # delays only pace the re-sends of the SAME UploadMsg/update_id
+    upload_retry: RetryPolicy = dataclasses.field(
+        default_factory=lambda: RetryPolicy(
+            max_retries=3, initial_backoff_s=0.1, max_backoff_s=2.0
+        )
+    )
+    reconnect: bool = True  # auto re-dial on server loss
+    reconnect_retry: RetryPolicy = dataclasses.field(
+        default_factory=lambda: RetryPolicy(
+            max_retries=8, initial_backoff_s=0.2, max_backoff_s=5.0
+        )
+    )
+    # fault injection (tests / chaos drills): consulted by the client's
+    # transport at every frame boundary
+    fault_plan: Optional[FaultPlan] = None
 
 
 def resolve_client_id(config: DistributedClientConfig) -> str:
@@ -96,12 +125,24 @@ class AbstractClient:
         self.client_id = resolve_client_id(self.config)
         self.logger = VerboseLogger(f"{type(self).__name__}[{self.client_id[:8]}]",
                                     self.config.verbose)
-        self.callbacks = CallbackRegistry("download", "new_version", "upload")
+        self.callbacks = CallbackRegistry("download", "new_version", "upload", "reconnect")
         self.transport: Optional[ClientTransport] = None
         self.msg: Optional[DownloadMsg] = None  # last Download
         self.version_update_counts: Dict[str, int] = {}  # reference :36,112-122
         self._first_download = threading.Event()
         self._download_lock = threading.Lock()
+        # reconnect machinery: _transport_ready is set while a dialed
+        # transport is (believed) usable; upload retries park on it instead
+        # of hammering a dead connection. _resumed is set by the first
+        # Download/trainingComplete after a dial, telling the reconnect loop
+        # the handshake completed. connection_failed latches when the
+        # re-dial budget is exhausted (worker loops check it and bail).
+        self._transport_ready = threading.Event()
+        self._resumed = threading.Event()
+        self._reconnect_lock = threading.Lock()
+        self._disposed = False
+        self.reconnects = 0
+        self.connection_failed = threading.Event()
         # int8 gradient compression: per-leaf quantization residual carried
         # into the next upload (error feedback); lazily keyed by tree path
         self._quant_error: Optional[Dict[str, Any]] = None
@@ -110,6 +151,10 @@ class AbstractClient:
 
     def on_new_version(self, fn: Callable[..., Any]) -> None:
         self.callbacks.register("new_version", fn)
+
+    def on_reconnect(self, fn: Callable[..., Any]) -> None:
+        """``fn(reconnects)`` fires after a successful re-dial + handshake."""
+        self.callbacks.register("reconnect", fn)
 
     def log(self, *args: Any) -> None:
         self.logger.log(*args)
@@ -122,18 +167,82 @@ class AbstractClient:
     def setup(self, timeout: float = CONNECT_TIMEOUT_S) -> None:
         """Connect and await the first Download (reference ``:166-173``)."""
         self.model.setup()
-        self.transport = ClientTransport(
+        self._dial(timeout)
+        if not self._first_download.wait(timeout):
+            raise AckTimeout(f"no initial Download within {timeout}s")
+
+    def _dial(self, timeout: float = CONNECT_TIMEOUT_S) -> None:
+        """Build + connect a fresh transport and wire up all handlers.
+
+        Used by both the initial :meth:`setup` and the background reconnect
+        loop — reconnection re-runs the full handshake (the server treats a
+        re-dialed client as a fresh connection and pushes a new Download).
+        """
+        transport = ClientTransport(
             self.server_address,
             heartbeat_interval=self.config.heartbeat_interval_s,
             heartbeat_timeout=self.config.heartbeat_timeout_s,
+            fault_plan=self.config.fault_plan,
         )
-        self.transport.on(Events.Download.value, self._on_download)
-        self.transport.on("trainingComplete", self._on_training_complete)
-        self.transport.connect(timeout)
-        if not self._first_download.wait(timeout):
-            raise TimeoutError(f"no initial Download within {timeout}s")
+        transport.on(Events.Download.value, self._on_download)
+        transport.on("trainingComplete", self._on_training_complete)
+        transport.on_server_lost = self._handle_server_lost
+        transport.connect(timeout)
+        self.transport = transport
+        self._transport_ready.set()
+
+    def _handle_server_lost(self) -> None:
+        """Transport-thread callback: connection dropped or server silent."""
+        self._transport_ready.clear()
+        if self._disposed or not self.config.reconnect:
+            self.connection_failed.set()
+            return
+        threading.Thread(
+            target=self._reconnect_loop, name="client-reconnect", daemon=True
+        ).start()
+
+    def _reconnect_loop(self) -> None:
+        """Re-dial with exponential backoff + jitter until the handshake
+        completes (a fresh Download — or trainingComplete — arrives) or the
+        retry budget runs out. At most one loop runs at a time; a second
+        ``on_server_lost`` while we're already reconnecting is a no-op."""
+        if not self._reconnect_lock.acquire(blocking=False):
+            return
+        try:
+            old, self.transport = self.transport, None
+            if old is not None:
+                old.close()
+            policy = self.config.reconnect_retry.validate()
+            for attempt, delay in enumerate(policy.delays(), start=1):
+                if self._disposed:
+                    return
+                time.sleep(delay)
+                self._resumed.clear()
+                try:
+                    self._dial()
+                except Exception as exc:  # noqa: BLE001 - retry any dial failure
+                    self.log(f"reconnect attempt {attempt} failed: {exc!r}")
+                    continue
+                # handshake: the server pushes a Download (or, if the run
+                # finished while we were gone, a trainingComplete) on connect
+                if not self._resumed.wait(CONNECT_TIMEOUT_S):
+                    self.log(f"reconnect attempt {attempt}: no Download after dial")
+                    self.transport.close()
+                    self._transport_ready.clear()
+                    continue
+                self.reconnects += 1
+                self.log(f"reconnected to {self.server_address} "
+                         f"(attempt {attempt}, total reconnects {self.reconnects})")
+                self.callbacks.fire("reconnect", self.reconnects)
+                return
+            self.log("reconnect budget exhausted; giving up")
+            self.connection_failed.set()
+        finally:
+            self._reconnect_lock.release()
 
     def dispose(self) -> None:
+        self._disposed = True
+        self._transport_ready.clear()
         if self.transport is not None:
             self.transport.close()
 
@@ -146,11 +255,15 @@ class AbstractClient:
             self.set_params_from(msg)
         first = not self._first_download.is_set()
         self._first_download.set()
+        self._resumed.set()  # reconnect handshake complete
         self.callbacks.fire("download", msg)
         self.callbacks.fire("new_version", msg.model.version)
         self.handle_download(msg, first=first)
 
     def _on_training_complete(self, payload: Any) -> None:
+        # also counts as a completed handshake: a client reconnecting after
+        # the dataset ran dry gets only trainingComplete, never a Download
+        self._resumed.set()
         self.handle_training_complete()
 
     def set_params_from(self, msg: DownloadMsg) -> None:
@@ -165,10 +278,50 @@ class AbstractClient:
     # -- upload -------------------------------------------------------------
 
     def upload(self, msg: UploadMsg, timeout: Optional[float] = None) -> Any:
-        """Emit with ack + timeout (reference ``uploadVars``, ``:148-158``)."""
+        """Emit with ack + timeout (reference ``uploadVars``, ``:148-158``),
+        retrying on ack timeout / connection loss.
+
+        Retries are safe because every upload carries a stable ``update_id``
+        (stamped here if the caller didn't): an ack timeout is ambiguous —
+        the server may or may not have applied the gradient — so we resend
+        the *same* message and let the server's dedup cache make the second
+        delivery a no-op. Between attempts we park on ``_transport_ready``
+        so a retry rides the reconnected transport instead of the dead one.
+        Raises the last :class:`AckTimeout` / :class:`ConnectionLost` when
+        the retry budget is exhausted.
+        """
         if timeout is None:
             timeout = self.config.upload_timeout_s
-        result = self.transport.request(Events.Upload.value, msg.to_wire(), timeout)
+        if msg.update_id is None:
+            msg.update_id = uuid_lib.uuid4().hex
+        wire = msg.to_wire()
+        policy = self.config.upload_retry.validate()
+        last_exc: Optional[Exception] = None
+        delays = [None, *policy.delays()]  # first attempt is immediate
+        for attempt, delay in enumerate(delays):
+            if self._disposed:
+                raise last_exc or ConnectionLost("client disposed")
+            if delay is not None:
+                time.sleep(delay)
+                # if a reconnect is in flight, wait (bounded) for the fresh
+                # transport rather than burning the attempt on a dead one
+                self._transport_ready.wait(timeout)
+            transport = self.transport
+            if transport is None:
+                last_exc = ConnectionLost("not connected")
+                continue
+            try:
+                result = transport.request(Events.Upload.value, wire, timeout)
+                break
+            except (AckTimeout, ConnectionLost) as exc:
+                last_exc = exc
+                self.log(
+                    f"upload attempt {attempt + 1}/{len(delays)} failed "
+                    f"({type(exc).__name__}: {exc}); update_id={msg.update_id}"
+                )
+        else:
+            assert last_exc is not None
+            raise last_exc
         version = msg.gradients.version if msg.gradients is not None else None
         if version is not None:
             self.version_update_counts[version] = (

@@ -10,14 +10,23 @@ bookkeeping. The loop ends when the server signals ``trainingComplete``.
 
 from __future__ import annotations
 
+import collections
 import threading
-from typing import Any, List, Optional
+import time
+import uuid as uuid_lib
+from typing import Any, List, Optional, Tuple
 
 import jax.numpy as jnp
 
 from distriflow_tpu.client.abstract_client import AbstractClient
+from distriflow_tpu.comm.transport import ConnectionLost
 from distriflow_tpu.utils.messages import DownloadMsg, GradientMsg, UploadMsg
 from distriflow_tpu.utils.serialization import deserialize_array
+
+# how many (epoch, batch, version) -> UploadMsg entries a worker remembers
+# for reconnect reconciliation; a worker only ever holds one batch at a time,
+# so this comfortably covers redelivery races
+_RECENT_UPLOADS = 16
 
 
 class AsynchronousSGDClient(AbstractClient):
@@ -25,6 +34,15 @@ class AsynchronousSGDClient(AbstractClient):
         super().__init__(*args, **kw)
         self.batches_processed = 0
         self.training_complete = threading.Event()
+        self._update_lock = threading.Lock()
+        # reconnect reconciliation: after a reset the server may redeliver a
+        # batch whose gradients we already computed (its requeue races our
+        # retried upload). Re-uploading the CACHED message — same update_id —
+        # lets the server's dedup cache absorb the duplicate instead of the
+        # model absorbing a double-counted gradient.
+        self._recent_uploads: "collections.OrderedDict[Tuple[int, int, str], UploadMsg]" = (
+            collections.OrderedDict()
+        )
 
     def handle_download(self, msg: DownloadMsg, first: bool) -> None:
         """Weights are already installed by the base class; train on the
@@ -38,31 +56,61 @@ class AsynchronousSGDClient(AbstractClient):
         self.training_complete.set()
 
     def distributed_update(self, msg: DownloadMsg) -> None:
-        """One fit+upload round (reference ``DistributedUpdate``, ``:44-83``)."""
-        x = jnp.asarray(deserialize_array(msg.data.x))
-        y = jnp.asarray(deserialize_array(msg.data.y))
-        metrics: Optional[List[float]] = None
-        if self.config.send_metrics:
-            metrics = self.model.evaluate(x, y)
-        with self.time("fit"):
-            grads = self.model.fit(x, y)
-        # count before the upload ack: the server may emit trainingComplete
-        # the instant it receives this upload, racing the ack back to us
-        self.batches_processed += 1
-        self.upload(
-            UploadMsg(
-                client_id=self.client_id,
-                batch=msg.data.batch,
-                gradients=GradientMsg(
-                    version=msg.model.version,
-                    vars=self.serialize_grads(grads),
-                ),
-                metrics=metrics,
-            )
-        )
+        """One fit+upload round (reference ``DistributedUpdate``, ``:44-83``).
+
+        A redelivered batch (reconnect reconciliation, see
+        ``_recent_uploads``) is answered from the cache: same gradients,
+        same ``update_id``, no recompute, no ``batches_processed`` bump.
+        """
+        key = (msg.data.epoch, msg.data.batch, msg.model.version)
+        # downloads dispatch on concurrent executor threads, so a duplicate-
+        # delivered frame can race the original: the whole check-compute-
+        # insert is one critical section, and the update_id is stamped here
+        # (not lazily in upload()) so both racers send the same id
+        with self._update_lock:
+            upload = self._recent_uploads.get(key)
+            if upload is not None:
+                self.log(f"re-upload of already-computed batch {key}")
+            else:
+                x = jnp.asarray(deserialize_array(msg.data.x))
+                y = jnp.asarray(deserialize_array(msg.data.y))
+                metrics: Optional[List[float]] = None
+                if self.config.send_metrics:
+                    metrics = self.model.evaluate(x, y)
+                with self.time("fit"):
+                    grads = self.model.fit(x, y)
+                upload = UploadMsg(
+                    client_id=self.client_id,
+                    batch=msg.data.batch,
+                    gradients=GradientMsg(
+                        version=msg.model.version,
+                        vars=self.serialize_grads(grads),
+                    ),
+                    metrics=metrics,
+                    update_id=uuid_lib.uuid4().hex,
+                )
+                self._recent_uploads[key] = upload
+                while len(self._recent_uploads) > _RECENT_UPLOADS:
+                    self._recent_uploads.popitem(last=False)
+                # count before the upload ack: the server may emit
+                # trainingComplete the instant it receives this upload,
+                # racing the ack back to us
+                self.batches_processed += 1
+        self.upload(upload)
 
     def train_until_complete(self, timeout: float = 300.0) -> int:
-        """Block until the server signals completion; returns batches done."""
-        if not self.training_complete.wait(timeout):
-            raise TimeoutError(f"training did not complete within {timeout}s")
-        return self.batches_processed
+        """Block until the server signals completion; returns batches done.
+
+        Raises :class:`ConnectionLost` if the reconnect budget ran out —
+        a worker whose server is gone for good should fail loudly, not
+        sit out the timeout.
+        """
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.training_complete.wait(0.1):
+                return self.batches_processed
+            if self.connection_failed.is_set():
+                raise ConnectionLost(
+                    "server connection lost and reconnect budget exhausted"
+                )
+        raise TimeoutError(f"training did not complete within {timeout}s")

@@ -1,19 +1,41 @@
-"""Comm layer: binary codec + asyncio TCP transport (socket.io replacement)."""
+"""Comm layer: binary codec + asyncio TCP transport (socket.io replacement).
 
-from distriflow_tpu.comm.codec import CodecError, decode, encode
+Robustness surface (see ``docs/ROBUSTNESS.md``): typed transport errors
+(:class:`TransportError` and friends), CRC32-checked frames, and the
+deterministic :class:`FaultPlan` chaos injector.
+"""
+
+from distriflow_tpu.comm.codec import CodecError, checksum, decode, encode
 from distriflow_tpu.comm.transport import (
     ACK_TIMEOUT_S,
     CONNECT_TIMEOUT_S,
+    AckTimeout,
     ClientTransport,
+    ConnectionLost,
+    FaultDecision,
+    FaultPlan,
+    FrameCorruptionError,
+    ScriptedFault,
     ServerTransport,
+    TransportError,
+    frame_bytes,
 )
 
 __all__ = [
     "CodecError",
+    "checksum",
     "decode",
     "encode",
     "ACK_TIMEOUT_S",
     "CONNECT_TIMEOUT_S",
+    "AckTimeout",
     "ClientTransport",
+    "ConnectionLost",
+    "FaultDecision",
+    "FaultPlan",
+    "FrameCorruptionError",
+    "ScriptedFault",
     "ServerTransport",
+    "TransportError",
+    "frame_bytes",
 ]

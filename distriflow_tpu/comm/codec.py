@@ -13,7 +13,16 @@ Supported value types: None, bool, int, float, str, bytes, list, dict
 from __future__ import annotations
 
 import struct
+import zlib
 from typing import Any
+
+
+def checksum(payload: bytes) -> int:
+    """CRC32 of a frame payload (unsigned 32-bit), the integrity check the
+    transport stamps into every frame header: a flipped wire byte surfaces
+    as :class:`distriflow_tpu.comm.transport.FrameCorruptionError` instead
+    of decoding garbage into a protocol message."""
+    return zlib.crc32(payload) & 0xFFFFFFFF
 
 # type tags
 _NONE = b"N"

@@ -4,7 +4,9 @@ The reference's cross-process story is socket.io 2.x over WebSocket
 (hub-and-spoke, server-centric, binary payloads, emit-with-ack;
 SURVEY.md §2.4). This module provides the same primitives natively:
 
-- length-prefixed binary frames (codec.py payloads) over TCP;
+- length-prefixed, CRC32-checksummed binary frames (codec.py payloads)
+  over TCP — a corrupted frame raises :class:`FrameCorruptionError` and
+  resets the connection instead of decoding garbage;
 - ``emit(event, payload)`` fire-and-forget and ``request`` (emit + ack)
   with timeouts — the reference's 5 s upload-ack and 10 s connect
   timeouts are preserved as defaults (``src/client/abstract_client.ts:12-13``);
@@ -15,7 +17,16 @@ SURVEY.md §2.4). This module provides the same primitives natively:
   liveness checks at all): clients ping every ``heartbeat_interval``, the
   server echoes and evicts clients silent past ``heartbeat_timeout`` —
   eviction runs the normal disconnect path, so outstanding batches are
-  requeued; clients detect a vanished server via ``on_server_lost``.
+  requeued; clients detect a vanished server via ``on_server_lost``;
+- a typed error hierarchy (:class:`TransportError` and friends) so
+  callers can tell retryable failures (ack timeout, connection lost)
+  from fatal ones;
+- deterministic fault injection (:class:`FaultPlan`): either endpoint
+  can be configured to drop, delay, duplicate, or corrupt outbound
+  frames — or reset the connection — at seeded per-fault rates and/or
+  at scripted points ("reset after the 3rd Upload"), which is how the
+  retry/reconnect/dedup machinery above is proven in tests
+  (``tests/test_chaos.py``) without flaky real-network failures.
 
 Both endpoints run their event loop in a background thread so the public
 API is synchronous (trainers and tests are synchronous; the reference's
@@ -30,15 +41,18 @@ movement never goes through here — that is ICI's job (see
 from __future__ import annotations
 
 import asyncio
-import itertools
+import collections
+import concurrent.futures
+import dataclasses
+import random
 import struct
 import threading
 import sys
 import time
 import uuid
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
-from distriflow_tpu.comm.codec import decode, encode
+from distriflow_tpu.comm.codec import checksum, decode, encode
 
 CONNECT_TIMEOUT_S = 10.0  # reference abstract_client.ts:12
 ACK_TIMEOUT_S = 5.0  # reference abstract_client.ts:13
@@ -50,35 +64,237 @@ HEARTBEAT_INTERVAL_S = 2.0
 HEARTBEAT_TIMEOUT_S = 10.0
 _HB_EVENT = "__hb__"
 
-_LEN = struct.Struct("<Q")
+
+# -- typed errors ----------------------------------------------------------
+# Multiple inheritance keeps every pre-hierarchy except clause working:
+# code catching TimeoutError still catches AckTimeout, code catching
+# ConnectionError/OSError still catches ConnectionLost.
+
+
+class TransportError(Exception):
+    """Base of all transport-layer failures."""
+
+
+class AckTimeout(TransportError, TimeoutError):
+    """A request's ack did not arrive in time. Retryable: the peer may have
+    processed the message (retry with the same ``update_id`` — the server
+    dedups)."""
+
+
+class ConnectionLost(TransportError, ConnectionError):
+    """The connection dropped (reset, EOF, refused, or deliberately torn
+    down by fault injection). Retryable after a reconnect."""
+
+
+class FrameCorruptionError(TransportError):
+    """A frame failed its CRC32 check. The connection is reset — a stream
+    that has lost framing cannot be resynchronized."""
+
+
+# -- framing ---------------------------------------------------------------
+
+_HDR = struct.Struct("<QI")  # payload length + CRC32 of the payload
 MAX_FRAME = 1 << 33  # 8 GiB safety bound
 
 
-async def _write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
-    writer.write(_LEN.pack(len(payload)) + payload)
+def frame_bytes(payload: bytes) -> bytes:
+    """Header + payload for one wire frame (exposed for tests/tools that
+    speak the protocol over a raw socket)."""
+    return _HDR.pack(len(payload), checksum(payload)) + payload
+
+
+async def _write_frame(
+    writer: asyncio.StreamWriter, payload: bytes, corrupt: bool = False
+) -> None:
+    header = _HDR.pack(len(payload), checksum(payload))
+    if corrupt:  # fault injection: flip a payload byte AFTER the CRC is
+        # computed, so the receiver's check must catch it
+        payload = payload[:-1] + bytes([payload[-1] ^ 0xFF]) if payload else b"\x00"
+    writer.write(header + payload)
     await writer.drain()
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> bytes:
-    header = await reader.readexactly(_LEN.size)
-    (n,) = _LEN.unpack(header)
+    header = await reader.readexactly(_HDR.size)
+    n, crc = _HDR.unpack(header)
     if n > MAX_FRAME:
         raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME")
-    return await reader.readexactly(n)
+    payload = await reader.readexactly(n)
+    if checksum(payload) != crc:
+        raise FrameCorruptionError(
+            f"frame CRC mismatch ({n} bytes): wire corruption or protocol desync"
+        )
+    return payload
+
+
+# -- fault injection -------------------------------------------------------
+
+FAULT_ACTIONS = ("drop", "delay", "duplicate", "corrupt", "reset")
+
+
+@dataclasses.dataclass
+class FaultDecision:
+    """What the transport should do with one outbound frame."""
+
+    drop: bool = False
+    delay_s: float = 0.0
+    duplicate: bool = False
+    corrupt: bool = False
+    reset: bool = False
+
+
+_NO_FAULT = FaultDecision()
+
+
+@dataclasses.dataclass
+class ScriptedFault:
+    """One deterministic fault: apply ``action`` to the ``nth`` (1-based)
+    outbound frame carrying ``event`` — e.g.
+    ``ScriptedFault(event="uploadVars", nth=3, action="reset")`` tears the
+    connection down exactly when the 3rd Upload is being sent."""
+
+    event: str
+    nth: int
+    action: str
+    delay_s: float = 0.05
+
+    def __post_init__(self) -> None:
+        if self.action not in FAULT_ACTIONS:
+            raise ValueError(f"action must be one of {FAULT_ACTIONS}, got {self.action!r}")
+        if self.nth < 1:
+            raise ValueError(f"nth is 1-based, got {self.nth}")
+
+
+class FaultPlan:
+    """Seeded, deterministic fault injector consulted at frame boundaries.
+
+    Install on either endpoint (``ServerTransport(..., fault_plan=...)`` /
+    ``ClientTransport(..., fault_plan=...)``); every outbound frame (except
+    ``exempt`` events — heartbeats by default) gets one decision:
+
+    - ``drop``: the frame is silently not sent (a lost packet);
+    - ``delay``: the frame is sent after ``delay_s`` (network latency spike);
+    - ``duplicate``: the frame is sent twice (at-least-once delivery);
+    - ``corrupt``: a payload byte is flipped after the CRC is computed
+      (wire corruption — the receiver resets the connection);
+    - ``reset``: the connection is closed instead of sending (peer crash).
+
+    Rates are per-fault-type probabilities sampled from a private seeded
+    RNG — the same seed and frame sequence always yields the same fault
+    sequence (one RNG draw per fault type per frame, so decisions stay
+    aligned regardless of which faults fire). ``schedule`` adds exact
+    scripted faults on top (see :class:`ScriptedFault`); scripted entries
+    take precedence over rates for their frame. Thread-safe.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        drop: float = 0.0,
+        delay: float = 0.0,
+        duplicate: float = 0.0,
+        corrupt: float = 0.0,
+        reset: float = 0.0,
+        delay_s: float = 0.02,
+        schedule: Sequence[ScriptedFault] = (),
+        exempt: Iterable[str] = (_HB_EVENT,),
+    ):
+        self.rates = {"drop": drop, "delay": delay, "duplicate": duplicate,
+                      "corrupt": corrupt, "reset": reset}
+        for name, rate in self.rates.items():
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"{name} rate must be in [0, 1], got {rate}")
+        self.delay_s = delay_s
+        self.schedule = list(schedule)
+        self.exempt = frozenset(exempt)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._counts: collections.Counter = collections.Counter()  # frames seen
+        self.injected: collections.Counter = collections.Counter()  # faults fired
+
+    def frames_seen(self, event: str) -> int:
+        with self._lock:
+            return self._counts[event]
+
+    def decide(self, event: str) -> FaultDecision:
+        """One decision for one outbound frame carrying ``event``."""
+        if event in self.exempt:
+            return _NO_FAULT
+        with self._lock:
+            self._counts[event] += 1
+            n = self._counts[event]
+            for s in self.schedule:
+                if s.event == event and s.nth == n:
+                    self.injected[s.action] += 1
+                    d = FaultDecision()
+                    if s.action == "delay":
+                        d.delay_s = s.delay_s
+                    else:
+                        setattr(d, s.action, True)
+                    return d
+            # fixed draw count per frame: the RNG stream stays aligned with
+            # the frame sequence no matter which faults fire
+            draws = {a: self._rng.random() for a in FAULT_ACTIONS}
+        d = FaultDecision()
+        if self.rates["reset"] and draws["reset"] < self.rates["reset"]:
+            d.reset = True  # precludes everything else
+        elif self.rates["drop"] and draws["drop"] < self.rates["drop"]:
+            d.drop = True
+        else:
+            if self.rates["delay"] and draws["delay"] < self.rates["delay"]:
+                d.delay_s = self.delay_s
+            if self.rates["duplicate"] and draws["duplicate"] < self.rates["duplicate"]:
+                d.duplicate = True
+            if self.rates["corrupt"] and draws["corrupt"] < self.rates["corrupt"]:
+                d.corrupt = True
+        fired = [a for a in ("drop", "duplicate", "corrupt", "reset") if getattr(d, a)]
+        if d.delay_s > 0:
+            fired.append("delay")
+        if fired:
+            with self._lock:
+                self.injected.update(fired)
+        return d
 
 
 class _Endpoint:
     """Shared emit/ack machinery for one connection."""
 
-    def __init__(self, loop: asyncio.AbstractEventLoop, writer: asyncio.StreamWriter):
+    def __init__(
+        self,
+        loop: asyncio.AbstractEventLoop,
+        writer: asyncio.StreamWriter,
+        fault_plan: Optional[FaultPlan] = None,
+    ):
         self.loop = loop
         self.writer = writer
+        self.fault_plan = fault_plan
         self._acks: Dict[str, asyncio.Future] = {}
         self._write_lock = asyncio.Lock()
 
     async def _send(self, msg: Dict[str, Any]) -> None:
+        copies, corrupt = 1, False
+        if self.fault_plan is not None:
+            d = self.fault_plan.decide(str(msg.get("event", "")))
+            if d.reset:
+                self.writer.close()
+                raise ConnectionLost("fault injection: connection reset")
+            if d.drop:
+                return  # the frame vanishes; acks/retries must recover
+            if d.delay_s > 0:
+                await asyncio.sleep(d.delay_s)
+            copies = 2 if d.duplicate else 1
+            corrupt = d.corrupt
         async with self._write_lock:
-            await _write_frame(self.writer, encode(msg))
+            for _ in range(copies):
+                await _write_frame(self.writer, encode(msg), corrupt=corrupt)
+
+    def fail_pending(self, exc: BaseException) -> None:
+        """Fail every in-flight request (connection torn down): retryable
+        callers see ConnectionLost immediately instead of burning out their
+        full ack timeout against a dead socket."""
+        for fut in list(self._acks.values()):
+            if not fut.done():
+                fut.set_exception(exc)
 
     async def emit_async(self, event: str, payload: Any) -> None:
         await self._send({"event": event, "payload": payload})
@@ -108,11 +324,13 @@ class ServerTransport:
         port: int = 0,
         heartbeat_interval: float = HEARTBEAT_INTERVAL_S,
         heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         self.host = host
         self.port = port
         self.heartbeat_interval = heartbeat_interval
         self.heartbeat_timeout = heartbeat_timeout  # 0 disables reaping
+        self.fault_plan = fault_plan  # chaos testing: shared by all connections
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -155,15 +373,18 @@ class ServerTransport:
             self._loop.close()
 
     def stop(self) -> None:
-        if self._loop is None:
-            return
+        if self._loop is None or self._loop.is_closed():
+            return  # idempotent: second stop (test teardown) is a no-op
         loop = self._loop
 
         def _shutdown():
             for task in asyncio.all_tasks(loop):
                 task.cancel()
 
-        loop.call_soon_threadsafe(_shutdown)
+        try:
+            loop.call_soon_threadsafe(_shutdown)
+        except RuntimeError:
+            return  # loop closed between the check and the call
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -197,7 +418,7 @@ class ServerTransport:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         client_id = uuid.uuid4().hex
-        endpoint = _Endpoint(self._loop, writer)
+        endpoint = _Endpoint(self._loop, writer, fault_plan=self.fault_plan)
         self._clients[client_id] = endpoint
         self._last_seen[client_id] = time.monotonic()
         if self.on_connect:
@@ -249,6 +470,12 @@ class ServerTransport:
                 self._loop.create_task(dispatch(msg))
         except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
             pass
+        except FrameCorruptionError as e:
+            # a desynced stream cannot be resynchronized: reset the
+            # connection (the finally below closes it; the client's
+            # reconnect machinery re-establishes a clean session)
+            print(f"[transport] resetting client {client_id[:8]}: {e}",
+                  file=sys.stderr, flush=True)
         except ValueError as e:
             # malformed frame (port scanner, protocol mismatch): drop quietly
             print(f"[transport] closing client {client_id[:8]}: {e}", file=sys.stderr, flush=True)
@@ -296,12 +523,14 @@ class ClientTransport:
         address: str,
         heartbeat_interval: float = HEARTBEAT_INTERVAL_S,
         heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
         self.heartbeat_interval = heartbeat_interval  # 0 disables heartbeats
         self.heartbeat_timeout = heartbeat_timeout  # 0 disables loss detection
+        self.fault_plan = fault_plan
         self.on_server_lost: Optional[Callable[[], None]] = None
         self._last_server_frame = time.monotonic()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -320,18 +549,26 @@ class ClientTransport:
         # the same object (the failed attempt's loop thread has exited)
         self._connect_error = None
         self._connected.clear()
+        self._stopped = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         ok = self._connected.wait(timeout)
         if self._connect_error is not None:
-            # fail fast with the real error (e.g. ConnectionRefusedError)
-            # instead of burning the whole timeout; the loop thread has
-            # already exited cleanly
+            # fail fast with the real error instead of burning the whole
+            # timeout; the loop thread has already exited cleanly. Dial
+            # failures (refused/unreachable/reset) surface as the typed
+            # retryable ConnectionLost; anything else stays loud and fatal.
             err = self._connect_error
             self._thread.join(timeout=1)
+            if isinstance(err, (OSError, asyncio.TimeoutError)) and not isinstance(
+                err, TransportError
+            ):
+                raise ConnectionLost(
+                    f"could not connect to {self.host}:{self.port}: {err!r}"
+                ) from err
             raise err
         if not ok:
-            raise TimeoutError(f"could not connect to {self.host}:{self.port}")
+            raise ConnectionLost(f"could not connect to {self.host}:{self.port}")
         return self
 
     def _run(self) -> None:
@@ -340,7 +577,7 @@ class ClientTransport:
 
         async def main():
             reader, writer = await asyncio.open_connection(self.host, self.port)
-            self._endpoint = _Endpoint(self._loop, writer)
+            self._endpoint = _Endpoint(self._loop, writer, fault_plan=self.fault_plan)
             self._last_server_frame = time.monotonic()
             self._connected.set()
 
@@ -393,15 +630,31 @@ class ClientTransport:
                 if not self._stopped and self.on_server_lost is not None:
                     print("[transport] server connection lost", file=sys.stderr, flush=True)
                     await self._loop.run_in_executor(None, self.on_server_lost)
+            except FrameCorruptionError as e:
+                # desynced stream: reset and let the reconnect machinery
+                # re-establish a clean session
+                print(f"[transport] resetting connection: {e}", file=sys.stderr, flush=True)
+                if not self._stopped and self.on_server_lost is not None:
+                    await self._loop.run_in_executor(None, self.on_server_lost)
             except asyncio.CancelledError:
                 pass
             except ValueError as e:
                 print(f"[transport] closing connection: {e}", file=sys.stderr, flush=True)
             finally:
+                if self._endpoint is not None:
+                    # in-flight requests fail fast with a retryable error
+                    # instead of waiting out their full ack timeout
+                    self._endpoint.fail_pending(
+                        ConnectionLost("connection closed with requests in flight"))
                 writer.close()
 
         try:
             self._loop.run_until_complete(main())
+        except asyncio.CancelledError:
+            # close() cancelled us mid-await (e.g. while the read loop was
+            # running the on_server_lost callback): a deliberate teardown,
+            # not an error — BaseException, so the clause below misses it
+            pass
         except Exception as e:
             if not self._connected.is_set():
                 # connection never came up (refused/unreachable): hand the
@@ -415,20 +668,44 @@ class ClientTransport:
             self._loop.close()
 
     def request(self, event: str, payload: Any, timeout: float = ACK_TIMEOUT_S) -> Any:
-        """Emit with ack (reference ``uploadVars``' 5 s reject timer)."""
+        """Emit with ack (reference ``uploadVars``' 5 s reject timer).
+
+        Raises :class:`AckTimeout` when no ack arrives in ``timeout`` and
+        :class:`ConnectionLost` when the connection is (or goes) down —
+        both retryable, unlike a codec/protocol error."""
         if self._endpoint is None:
-            raise RuntimeError("not connected")
-        fut = asyncio.run_coroutine_threadsafe(
-            self._endpoint.request_async(event, payload, timeout), self._loop
-        )
-        return fut.result(timeout + 1.0)
+            raise ConnectionLost("not connected")
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                self._endpoint.request_async(event, payload, timeout), self._loop
+            )
+        except RuntimeError as e:  # event loop already closed (connection died)
+            raise ConnectionLost(f"transport loop closed: {e}") from e
+        try:
+            return fut.result(timeout + 1.0)
+        except (TimeoutError, asyncio.TimeoutError, concurrent.futures.TimeoutError) as e:
+            if self._stopped or self._loop is None or self._loop.is_closed():
+                # the ack never came because the connection died under us —
+                # can't cancel a future on a closed loop; report the truth
+                raise ConnectionLost("transport closed while awaiting ack") from e
+            fut.cancel()
+            raise AckTimeout(f"no ack for {event!r} within {timeout}s") from e
+        except ConnectionLost:
+            raise
+        except (ConnectionError, concurrent.futures.CancelledError,
+                asyncio.CancelledError) as e:
+            raise ConnectionLost(f"connection lost mid-request: {e!r}") from e
 
     def emit(self, event: str, payload: Any) -> None:
         if self._endpoint is None:
-            raise RuntimeError("not connected")
-        asyncio.run_coroutine_threadsafe(
-            self._endpoint.emit_async(event, payload), self._loop
-        ).result(ACK_TIMEOUT_S)
+            raise ConnectionLost("not connected")
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                self._endpoint.emit_async(event, payload), self._loop
+            )
+        except RuntimeError as e:
+            raise ConnectionLost(f"transport loop closed: {e}") from e
+        fut.result(ACK_TIMEOUT_S)
 
     def close(self) -> None:
         self._stopped = True  # deliberate close: suppress on_server_lost

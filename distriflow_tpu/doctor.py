@@ -10,8 +10,11 @@ silent socket.io hang). Checks, in order:
    dispatch latency;
 4. a tiny train step (MLP, one optimizer update, loss finite);
 5. loopback transport round trip (server + client + ack);
-6. native C++ host library presence (optional — numpy fallback is fine);
-7. checkpoint write/read round trip in a temp dir.
+6. chaos self-test: a loopback train run under a seeded 10% frame-drop +
+   duplicate FaultPlan, asserting every upload applies exactly once
+   (retry + dedup machinery, see ``docs/ROBUSTNESS.md``);
+7. native C++ host library presence (optional — numpy fallback is fine);
+8. checkpoint write/read round trip in a temp dir.
 
 Exit code 0 when every mandatory check passes; each check prints
 ``ok``/``FAIL`` with a one-line detail, so CI and humans read the same
@@ -107,6 +110,106 @@ def main() -> int:
         return f"loopback ack on {srv.address}"
 
     ok &= _check("wire transport", transport)
+
+    def chaos():
+        import numpy as np
+
+        from distriflow_tpu.client.abstract_client import DistributedClientConfig
+        from distriflow_tpu.client.async_client import AsynchronousSGDClient
+        from distriflow_tpu.comm.transport import FaultPlan
+        from distriflow_tpu.data.dataset import DistributedDataset
+        from distriflow_tpu.models.base import DistributedModel
+        from distriflow_tpu.server.abstract_server import DistributedServerConfig
+        from distriflow_tpu.server.async_server import AsynchronousSGDServer
+        from distriflow_tpu.server.models import DistributedServerInMemoryModel
+        from distriflow_tpu.utils.config import RetryPolicy
+
+        class TinyModel(DistributedModel):
+            """Protocol-level fake: fixed 'gradients', no ML."""
+
+            def __init__(self):
+                self._params = {"w": np.ones((4,), np.float32)}
+
+            def setup(self):
+                pass
+
+            def fit(self, x, y):
+                return {"w": np.full((4,), 0.1, np.float32)}
+
+            def update(self, grads):
+                self._params = {
+                    "w": np.asarray(self._params["w"] - grads["w"], np.float32)
+                }
+
+            def predict(self, x):
+                return np.zeros((len(x), 2), np.float32)
+
+            def evaluate(self, x, y):
+                return [0.0]
+
+            def get_params(self):
+                return self._params
+
+            def set_params(self, params):
+                self._params = {k: np.asarray(v, np.float32) for k, v in params.items()}
+
+            @property
+            def input_shape(self):
+                return (1,)
+
+            @property
+            def output_shape(self):
+                return (2,)
+
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+        dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+        applied = []
+        with tempfile.TemporaryDirectory() as d:
+            server = AsynchronousSGDServer(
+                DistributedServerInMemoryModel(TinyModel()),
+                dataset,
+                DistributedServerConfig(
+                    save_dir=d,
+                    heartbeat_interval_s=0.1,
+                    heartbeat_timeout_s=2.0,
+                    fault_plan=FaultPlan(seed=5, duplicate=0.1),
+                ),
+            )
+            server.setup()
+            server.on_upload(lambda m: applied.append(m.update_id))
+            client = AsynchronousSGDClient(
+                server.address,
+                TinyModel(),
+                DistributedClientConfig(
+                    heartbeat_interval_s=0.1,
+                    heartbeat_timeout_s=2.0,
+                    upload_timeout_s=2.0,
+                    upload_retry=RetryPolicy(
+                        max_retries=6, initial_backoff_s=0.05, max_backoff_s=0.5, seed=3
+                    ),
+                    fault_plan=FaultPlan(seed=3, drop=0.1, duplicate=0.1),
+                ),
+            )
+            try:
+                client.setup(timeout=10.0)
+                client.train_until_complete(timeout=60.0)
+            finally:
+                client.dispose()
+                server.stop()
+        assert server.applied_updates == 4, (
+            f"expected 4 applied updates, got {server.applied_updates}"
+        )
+        assert len(applied) == len(set(applied)) == 4, (
+            f"updates not applied exactly once: {applied}"
+        )
+        injected = dict(client.config.fault_plan.injected)
+        injected.update({f"srv_{k}": v for k, v in server.config.fault_plan.injected.items()})
+        return ("4 uploads exactly-once under 10% drop+duplicate "
+                f"(injected: {injected or 'none'}, "
+                f"duplicates suppressed: {server.duplicate_uploads})")
+
+    ok &= _check("chaos self-test (drop+duplicate faults)", chaos)
 
     def native():
         from distriflow_tpu import native

@@ -27,7 +27,7 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from distriflow_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distriflow_tpu.models.base import ModelSpec

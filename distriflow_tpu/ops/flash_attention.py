@@ -35,6 +35,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from distriflow_tpu.ops.flop_count import record_pallas_cost
+from distriflow_tpu.utils.compat import pallas_tpu_compiler_params
 
 
 def _aligned_block(s: int, target: int) -> int:
@@ -316,7 +317,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         interpret=interpret,
         # batch*head and Q-block axes are independent -> parallel; only the
         # K axis is a sequential reduction (the scratch recurrence)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -403,7 +404,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(qf, kf, vf, dof, lsef, delta)
@@ -435,7 +436,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
             pltpu.VMEM((bk, d), jnp.float32),  # dv accumulator
         ],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(kf, vf, qf, dof, lsef, delta)

@@ -57,6 +57,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distriflow_tpu.utils import compat
+from distriflow_tpu.utils.compat import pallas_tpu_compiler_params
+
 BLOCK_K = 2048  # KV positions per tile: [2048, 512] bf16 K+V tiles are
 # 2 MB each, double-buffered 8 MB — inside the 16 MB scoped-VMEM limit
 # with room for the [BK, H] f32 score/prob tensors
@@ -341,7 +344,7 @@ def flash_decode(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, 1, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -415,8 +418,8 @@ def _sharded_fd(quant: bool, interpret: bool):
 
     rule = ("b h d, b s k, b s k, l -> b h d" if not quant else
             "b h d, b s k, b s k, l, b s j, b s j -> b h d")
-    wrapped.def_partition(
-        partition=partition, infer_sharding_from_operands=infer,
+    compat.def_partition(
+        wrapped, partition=partition, infer_sharding_from_operands=infer,
         sharding_rule=rule)
     return wrapped
 

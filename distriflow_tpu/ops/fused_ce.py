@@ -42,6 +42,8 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distriflow_tpu.ops.flop_count import record_pallas_cost
+from distriflow_tpu.utils import compat
+from distriflow_tpu.utils.compat import pallas_tpu_compiler_params
 
 BLOCK_N = 256   # 256 x 4096 f32 = 4 MB tiles: the measured sweet spot on
 BLOCK_V = 4096  # v5e (2 MB tiles ran 5x slower; 8 MB tiles blow scoped VMEM)
@@ -161,7 +163,7 @@ def _ce_call(kernel, n_outs, out_dtypes, out_cols, block_n, block_v,
         # forward (scratch recurrence) and independent in backward — keep it
         # 'arbitrary' (sequential) in both: correct everywhere, and backward
         # row tiles still parallelize
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -260,8 +262,8 @@ def _cp_wrap(fn, sharding_rule, out_specs_fn, vocab_args=(0,)):
                     NamedSharding(mesh, P(row, *([None] * (ndim - 1)))))
         return mesh, fn, out_specs_fn(mesh, row), tuple(arg_sh)
 
-    wrapped.def_partition(
-        partition=partition, infer_sharding_from_operands=infer,
+    compat.def_partition(
+        wrapped, partition=partition, infer_sharding_from_operands=infer,
         sharding_rule=sharding_rule)
     return _rows_vmappable(wrapped)
 

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from distriflow_tpu.utils.compat import shard_map
 
 AxisName = Union[str, Sequence[str]]
 
@@ -36,7 +36,11 @@ def pvary(tree: Any, axis: AxisName) -> Any:
     cast = getattr(lax, "pcast", None)
     if cast is not None:
         return jax.tree.map(lambda x: cast(x, axis, to="varying"), tree)
-    return jax.tree.map(lambda x: lax.pvary(x, axis), tree)
+    if hasattr(lax, "pvary"):
+        return jax.tree.map(lambda x: lax.pvary(x, axis), tree)
+    # legacy jax (< 0.5): no varying-manual-axes type system, every value
+    # inside shard_map is already per-device — the cast is an identity
+    return tree
 
 
 def psum(tree: Any, axis: AxisName) -> Any:

@@ -14,6 +14,7 @@ directly — weights never leave the devices there.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 from typing import Any, Dict, List, Optional
@@ -22,6 +23,7 @@ from distriflow_tpu.models.base import DistributedModel
 from distriflow_tpu.comm.transport import (
     HEARTBEAT_INTERVAL_S,
     HEARTBEAT_TIMEOUT_S,
+    FaultPlan,
     ServerTransport,
 )
 from distriflow_tpu.server.models import (
@@ -60,6 +62,13 @@ class DistributedServerConfig:
     # silent for heartbeat_timeout_s, requeueing their outstanding work
     heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S
     heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S  # 0 disables
+    # idempotent uploads: how many applied update_ids the server remembers
+    # for duplicate suppression; sized >> the number of uploads any client
+    # fleet can have in flight during one ack-timeout window
+    dedup_cache_size: int = 1024
+    # fault injection (tests / chaos drills): consulted by the server's
+    # per-client endpoints at every frame boundary
+    fault_plan: Optional[FaultPlan] = None
 
 
 class AbstractServer:
@@ -95,6 +104,7 @@ class AbstractServer:
             self.config.port,
             heartbeat_interval=self.config.heartbeat_interval_s,
             heartbeat_timeout=self.config.heartbeat_timeout_s,
+            fault_plan=self.config.fault_plan,
         )
         self.logger = VerboseLogger(type(self).__name__, self.config.verbose)
         self.callbacks = CallbackRegistry("new_version", "upload", "connect", "disconnect")
@@ -108,6 +118,14 @@ class AbstractServer:
         self.updating = False  # re-entrancy flag, reference :42
         self._lock = threading.Lock()
         self.download_msg: Optional[DownloadMsg] = None
+        # idempotent uploads: bounded LRU of applied update_id -> ack result,
+        # plus in-flight gating so two concurrent deliveries of the same
+        # update apply exactly once (the loser waits and re-acks the cached
+        # result). duplicate_uploads counts suppressed re-applies.
+        self._applied_ids: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+        self._dedup_inflight: Dict[str, threading.Event] = {}
+        self._dedup_lock = threading.Lock()
+        self.duplicate_uploads = 0
 
     # -- observability (reference abstract_server.ts:67-103) ---------------
 
@@ -179,11 +197,49 @@ class AbstractServer:
         self.handle_disconnection(client_id)
 
     def _on_upload_wire(self, client_id: str, payload: Any) -> Any:
+        """Wire entry for uploads: decode, dedup by ``update_id``, apply.
+
+        A retried upload (client resent after an ambiguous ack timeout) or a
+        duplicate-delivered frame carries an ``update_id`` the server has
+        already applied — it is acked with the cached result and NOT
+        re-applied, and the "upload" callback does not re-fire. An update
+        still mid-apply on another handler thread gates the duplicate until
+        the owner finishes, so concurrent deliveries also apply exactly once.
+        """
         msg = UploadMsg.from_wire(payload)
         if msg.metrics is not None:
             self.log(f"client {msg.client_id} metrics: {msg.metrics}")
-        self.callbacks.fire("upload", msg)
-        return self.handle_upload(client_id, msg)
+        uid = msg.update_id
+        if uid is None:  # legacy client: no dedup possible
+            self.callbacks.fire("upload", msg)
+            return self.handle_upload(client_id, msg)
+        while True:
+            with self._dedup_lock:
+                if uid in self._applied_ids:
+                    self._applied_ids.move_to_end(uid)
+                    self.duplicate_uploads += 1
+                    self.log(f"duplicate upload {uid[:8]} acked without re-apply")
+                    return self._applied_ids[uid]
+                gate = self._dedup_inflight.get(uid)
+                if gate is None:
+                    gate = threading.Event()
+                    self._dedup_inflight[uid] = gate
+                    break  # we own the apply
+            # same update_id mid-apply on another thread: wait, then re-check
+            # the cache (if the owner failed, the loop makes us the new owner)
+            gate.wait(timeout=60.0)
+        try:
+            self.callbacks.fire("upload", msg)
+            result = self.handle_upload(client_id, msg)
+            with self._dedup_lock:
+                self._applied_ids[uid] = result
+                while len(self._applied_ids) > self.config.dedup_cache_size:
+                    self._applied_ids.popitem(last=False)
+            return result
+        finally:
+            with self._dedup_lock:
+                self._dedup_inflight.pop(uid, None)
+            gate.set()
 
     def handle_connection(self, client_id: str) -> None:
         raise NotImplementedError

@@ -20,6 +20,7 @@ reference lacks — lost batches there are only re-served on epoch wrap).
 
 from __future__ import annotations
 
+import collections
 from typing import Dict, Optional
 
 import jax
@@ -60,6 +61,25 @@ class AsynchronousSGDServer(AbstractServer):
         self._completion_sent = False
         self.applied_updates = 0
         self.rejected_updates = 0
+        # reconnect reconciliation: model-version string -> the counter value
+        # when that version was published. A gradient from a client that
+        # reconnected mid-flight has no per-connection dispatch record, but
+        # it still names the version it was computed against — staleness is
+        # judged from the GRADIENT's version, not the connection's history.
+        self._version_tokens: "collections.OrderedDict[str, int]" = collections.OrderedDict()
+
+    _VERSION_TOKEN_WINDOW = 64  # comfortably > any sane maximum_staleness
+
+    def _note_version_token(self) -> None:
+        """Record the current (version string, counter) pair; call with
+        ``self._lock`` held (or before the transport starts)."""
+        self._version_tokens[self.model.version] = self.version_counter
+        while len(self._version_tokens) > self._VERSION_TOKEN_WINDOW:
+            self._version_tokens.popitem(last=False)
+
+    def setup(self) -> None:
+        super().setup()
+        self._note_version_token()  # the initial weights are version 0
 
     # -- dispatch ----------------------------------------------------------
 
@@ -146,7 +166,14 @@ class AsynchronousSGDServer(AbstractServer):
 
     def _apply(self, client_id: str, msg: UploadMsg) -> bool:
         with self._lock:
-            sent_version = self._client_versions.get(client_id, self.version_counter)
+            # the gradient's own version is the ground truth for staleness:
+            # after a reconnect the connection's dispatch record is gone (or
+            # fresh), but the upload still names the weights it was computed
+            # against. Fall back to the per-connection record only for
+            # versions older than the token window.
+            sent_version = self._version_tokens.get(msg.gradients.version)
+            if sent_version is None:
+                sent_version = self._client_versions.get(client_id, self.version_counter)
             staleness = self.version_counter - sent_version
             if staleness > self.hyperparams.maximum_staleness:
                 self.rejected_updates += 1
@@ -174,5 +201,6 @@ class AsynchronousSGDServer(AbstractServer):
                 self.version_counter += 1
                 self.applied_updates += 1
                 self.download_msg = self.compute_download_msg()
+                self._note_version_token()
         self.callbacks.fire("new_version", self.model.version)
         return True

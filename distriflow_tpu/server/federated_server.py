@@ -22,6 +22,11 @@ from distriflow_tpu.utils.serialization import SerializedArray, mean_serialized
 
 
 class FederatedServer(AbstractServer):
+    #: uploads dropped without buffering (unknown version, too stale,
+    #: mid-aggregation, malformed) — the federated analog of the async
+    #: server's ``rejected_updates``; chaos drills assert on it
+    dropped_uploads = 0
+
     def handle_connection(self, client_id: str) -> None:
         # send current weights (reference :69)
         self.transport.emit_to(client_id, Events.Download.value, self.download_msg.to_wire())
@@ -30,7 +35,12 @@ class FederatedServer(AbstractServer):
         """Buffer or drop one gradient upload; maybe aggregate.
 
         Returns the ack value (the reference acks ``true`` unconditionally at
-        ``:72``; we ack whether the gradient was accepted)."""
+        ``:72``; we ack whether the gradient was accepted). A gradient naming
+        a version this server has never published — e.g. computed against a
+        pre-restart incarnation of the server — is dropped here, which is
+        what makes client reconnect-across-server-restart safe: the stale
+        work is refused, the client gets a clean ``False`` ack, and its next
+        round trains against the fresh weights."""
         if msg.gradients is None:
             return False
         with self._lock:
@@ -38,9 +48,11 @@ class FederatedServer(AbstractServer):
                 staleness = self._staleness(msg.gradients.version)
             except ValueError:
                 self.log(f"dropping upload with unknown version {msg.gradients.version!r}")
+                self.dropped_uploads += 1
                 return False
             if staleness > self.hyperparams.maximum_staleness or self.updating:
                 # reference drop rule :73 (exact-version + !updating), generalized
+                self.dropped_uploads += 1
                 return False
             decay = self.hyperparams.staleness_decay**staleness
             vars_ = msg.gradients.vars
@@ -50,6 +62,7 @@ class FederatedServer(AbstractServer):
             # choose gradient_compression independently)
             if not self._well_formed(vars_):
                 self.log(f"dropping malformed upload from {msg.client_id}")
+                self.dropped_uploads += 1
                 return False
             # decay folds into aggregation as a per-contribution weight
             # (mean_serialized(weights=...)) — no deserialize/re-serialize

@@ -27,7 +27,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from distriflow_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distriflow_tpu.models.base import ModelSpec, _optimizer, init_params

@@ -395,9 +395,19 @@ class SyncTrainer:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self.state
             )
             with tally_pallas_cost() as tally:
-                # eval_shape always traces (jit lowering may be cached and
-                # skip the Python-level kernel wrappers)
+                # eval_shape re-traces the raw step body, but inner
+                # custom_vjp/jit sub-traces are memoized — a warm cache
+                # (a prior step() or the compile above) replays the cached
+                # jaxpr and skips the Python kernel wrappers entirely
                 jax.eval_shape(self._one_step, state_structs, structs)
+            if tally["flops"] == 0.0:
+                # either a genuinely Pallas-free program or a poisoned
+                # trace cache — clearing and retracing once disambiguates
+                # (cost: the next step() recompiles; analysis is cached
+                # per batch signature so this happens at most once each)
+                jax.clear_caches()
+                with tally_pallas_cost() as tally:
+                    jax.eval_shape(self._one_step, state_structs, structs)
             # correction (a): the fused CE's rows are split over the data
             # axis at compile time but recorded at global N — rescale its
             # category share to the per-device convention

@@ -5,6 +5,7 @@ from distriflow_tpu.utils.config import (
     CompileConfig,
     DatasetConfig,
     MeshConfig,
+    RetryPolicy,
     ServerHyperparams,
     UnknownConfigKeyError,
     asdict,
@@ -45,6 +46,7 @@ __all__ = [
     "CompileConfig",
     "DatasetConfig",
     "MeshConfig",
+    "RetryPolicy",
     "ServerHyperparams",
     "UnknownConfigKeyError",
     "asdict",

@@ -1,0 +1,57 @@
+"""JAX version compatibility shims.
+
+The codebase targets the modern ``jax.shard_map`` API (top-level export,
+``check_vma``/``axis_names`` kwargs). Older jax (< 0.5) ships the same
+machinery as ``jax.experimental.shard_map.shard_map`` with the ``check_rep``
+kwarg and an ``auto`` axis set instead of ``axis_names``. Import
+``shard_map`` from here and both resolve to the same call shape:
+
+    shard_map(f, mesh=..., in_specs=..., out_specs=...,
+              check_vma=..., axis_names=...)
+"""
+
+from __future__ import annotations
+
+try:  # modern jax: top-level export with check_vma/axis_names
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: experimental module with check_rep/auto
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f=None, /, *, mesh, in_specs, out_specs,
+                  check_vma: bool = True, axis_names=None):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+        if axis_names is not None:
+            # modern: "these axes are manual"; legacy: "these axes are auto"
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if f is None:  # decorator-with-kwargs usage
+            return lambda fn: _legacy_shard_map(fn, **kw)
+        return _legacy_shard_map(f, **kw)
+
+
+def def_partition(wrapped, *, partition, infer_sharding_from_operands,
+                  sharding_rule=None):
+    """``custom_partitioning.def_partition`` across jax versions: older jax
+    (< 0.5, pre-shardy) has no ``sharding_rule`` kwarg — the callbacks carry
+    the same information, so it is safe to drop there."""
+    try:
+        wrapped.def_partition(
+            partition=partition,
+            infer_sharding_from_operands=infer_sharding_from_operands,
+            sharding_rule=sharding_rule)
+    except TypeError:
+        wrapped.def_partition(
+            partition=partition,
+            infer_sharding_from_operands=infer_sharding_from_operands)
+
+
+def pallas_tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` across the rename: older jax (< 0.5) ships
+    the same dataclass as ``pltpu.TPUCompilerParams``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+__all__ = ["def_partition", "pallas_tpu_compiler_params", "shard_map"]

@@ -17,8 +17,9 @@ enforced by the async-SGD trainer.
 from __future__ import annotations
 
 import dataclasses
+import random
 from dataclasses import dataclass, field
-from typing import Any, Dict, Mapping, Optional, Sequence, Type, TypeVar
+from typing import Any, Dict, Iterator, Mapping, Optional, Sequence, Type, TypeVar
 
 T = TypeVar("T")
 
@@ -67,6 +68,48 @@ COMPRESSION_DTYPES = ("none", "float16", "bfloat16", "int8")
 # quantization error on WEIGHTS compounds every round, unlike gradients
 # where client-side error feedback absorbs it
 WEIGHT_COMPRESSION_DTYPES = ("none", "float16", "bfloat16")
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with jitter, shared by the client's upload-retry
+    and reconnect loops (no reference counterpart — the reference dies on
+    the first transient failure; SURVEY §5).
+
+    ``delays()`` yields ``max_retries`` sleep durations: the base doubles
+    (``multiplier``) from ``initial_backoff_s`` up to ``max_backoff_s``,
+    and each delay is stretched by up to ``jitter`` of itself so a fleet
+    of clients re-dialing a restarted server doesn't stampede in lockstep.
+    A set ``seed`` makes the schedule fully deterministic (chaos tests).
+    """
+
+    max_retries: int = 8
+    initial_backoff_s: float = 0.2
+    max_backoff_s: float = 10.0
+    multiplier: float = 2.0
+    jitter: float = 0.5  # fraction of the base delay, uniformly sampled
+    seed: Optional[int] = None
+
+    def validate(self) -> "RetryPolicy":
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {self.max_retries}")
+        if self.initial_backoff_s < 0 or self.max_backoff_s < self.initial_backoff_s:
+            raise ValueError(
+                f"need 0 <= initial_backoff_s <= max_backoff_s, got "
+                f"{self.initial_backoff_s} / {self.max_backoff_s}"
+            )
+        if self.multiplier < 1.0:
+            raise ValueError(f"multiplier must be >= 1, got {self.multiplier}")
+        if self.jitter < 0:
+            raise ValueError(f"jitter must be >= 0, got {self.jitter}")
+        return self
+
+    def delays(self) -> Iterator[float]:
+        rng = random.Random(self.seed)
+        base = self.initial_backoff_s
+        for _ in range(self.max_retries):
+            yield base * (1.0 + self.jitter * rng.random())
+            base = min(base * self.multiplier, self.max_backoff_s)
 
 
 @dataclass

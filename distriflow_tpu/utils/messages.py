@@ -78,12 +78,22 @@ class DataMsg:
 
 @dataclass
 class UploadMsg:
-    """Client -> server (reference ``UploadMsg``, ``utils.ts:144-149``)."""
+    """Client -> server (reference ``UploadMsg``, ``utils.ts:144-149``).
+
+    ``update_id`` (beyond the reference) is a client-generated unique id
+    for the update carried by this message. Servers keep a bounded LRU of
+    recently applied ids and ack duplicates without re-applying, which is
+    what makes upload *retries* safe: an ack that timed out may or may not
+    have been applied, so the client resends the same message — same
+    ``update_id`` — and the gradient lands exactly once either way.
+    ``AbstractClient.upload`` stamps one automatically when unset.
+    """
 
     client_id: str
     gradients: Optional[GradientMsg] = None
     batch: Optional[int] = None
     metrics: Optional[List[float]] = None
+    update_id: Optional[str] = None
 
     def to_wire(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"client_id": self.client_id}
@@ -93,6 +103,8 @@ class UploadMsg:
             d["batch"] = self.batch
         if self.metrics is not None:
             d["metrics"] = list(self.metrics)
+        if self.update_id is not None:
+            d["update_id"] = self.update_id
         return d
 
     @staticmethod
@@ -102,6 +114,7 @@ class UploadMsg:
             gradients=ModelMsg.from_wire(d["gradients"]) if "gradients" in d else None,
             batch=d.get("batch"),
             metrics=d.get("metrics"),
+            update_id=d.get("update_id"),
         )
 
 

@@ -1,0 +1,516 @@
+"""Chaos-transport tests: fault injection, retry, reconnect, dedup.
+
+No reference counterpart — the reference dies on the first dropped frame or
+ack timeout (SURVEY.md §5). Every test here uses a seeded
+:class:`FaultPlan` (deterministic fault sequences), tiny heartbeats
+(≤ 0.2 s), and fixed retry seeds, so the failure scenarios replay exactly.
+
+The headline test (``test_chaos_acceptance_run``) drives a full async-SGD
+training run through random drops, duplicate deliveries, and one scripted
+mid-run connection reset, and asserts the run completes with every update
+applied exactly once and the model version strictly increasing.
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.client.abstract_client import DistributedClientConfig
+from distriflow_tpu.client.async_client import AsynchronousSGDClient
+from distriflow_tpu.comm.codec import encode
+from distriflow_tpu.comm.transport import (
+    AckTimeout,
+    ClientTransport,
+    ConnectionLost,
+    FaultPlan,
+    FrameCorruptionError,
+    ScriptedFault,
+    ServerTransport,
+    TransportError,
+    _read_frame,
+    frame_bytes,
+)
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.server.abstract_server import AbstractServer, DistributedServerConfig
+from distriflow_tpu.server.async_server import AsynchronousSGDServer
+from distriflow_tpu.server.models import DistributedServerInMemoryModel
+from distriflow_tpu.utils.config import RetryPolicy
+from distriflow_tpu.utils.messages import GradientMsg, UploadMsg
+from tests.mock_model import MockModel
+
+pytestmark = pytest.mark.chaos
+
+
+def _wait_for(cond, timeout=10.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _xy(n=16):
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    y = np.eye(2, dtype=np.float32)[np.arange(n) % 2]
+    return x, y
+
+
+def _server(tmp_path, dataset, port=0, fault_plan=None, **kw):
+    return AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        dataset,
+        DistributedServerConfig(
+            save_dir=str(tmp_path / "models"),
+            port=port,
+            heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=kw.pop("heartbeat_timeout_s", 2.0),
+            fault_plan=fault_plan,
+            **kw,
+        ),
+    )
+
+
+def _client_config(fault_plan=None, **kw):
+    kw.setdefault("heartbeat_interval_s", 0.1)
+    kw.setdefault("heartbeat_timeout_s", 2.0)
+    kw.setdefault("upload_timeout_s", 2.0)
+    kw.setdefault(
+        "upload_retry",
+        RetryPolicy(max_retries=8, initial_backoff_s=0.05, max_backoff_s=0.5, seed=1),
+    )
+    kw.setdefault(
+        "reconnect_retry",
+        RetryPolicy(
+            max_retries=30, initial_backoff_s=0.1, max_backoff_s=0.3, jitter=0.2, seed=2
+        ),
+    )
+    return DistributedClientConfig(fault_plan=fault_plan, **kw)
+
+
+# -- determinism ------------------------------------------------------------
+
+
+def test_retry_policy_deterministic():
+    a = list(RetryPolicy(max_retries=6, seed=42).delays())
+    b = list(RetryPolicy(max_retries=6, seed=42).delays())
+    c = list(RetryPolicy(max_retries=6, seed=43).delays())
+    assert a == b, "same seed must yield the same backoff schedule"
+    assert a != c, "different seeds must jitter differently"
+    assert len(a) == 6
+    # base doubles under the jitter, capped at max_backoff_s * (1 + jitter)
+    policy = RetryPolicy(max_retries=6, initial_backoff_s=0.2, max_backoff_s=1.0,
+                         jitter=0.5, seed=0)
+    ds = list(policy.delays())
+    bases = [0.2, 0.4, 0.8, 1.0, 1.0, 1.0]
+    for d, base in zip(ds, bases):
+        assert base <= d <= base * 1.5
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_retries=-1).validate()
+    with pytest.raises(ValueError):
+        RetryPolicy(initial_backoff_s=5.0, max_backoff_s=1.0).validate()
+    with pytest.raises(ValueError):
+        RetryPolicy(multiplier=0.5).validate()
+
+
+def test_fault_plan_deterministic():
+    def run():
+        p = FaultPlan(seed=123, drop=0.3, delay=0.2, duplicate=0.2, corrupt=0.1,
+                      reset=0.05)
+        return [
+            (d.drop, d.delay_s, d.duplicate, d.corrupt, d.reset)
+            for d in (p.decide("uploadVars") for _ in range(50))
+        ]
+
+    assert run() == run(), "same seed + frame sequence must replay identically"
+
+
+def test_fault_plan_scripted_nth_and_exempt():
+    p = FaultPlan(
+        seed=0,
+        schedule=[ScriptedFault(event="uploadVars", nth=3, action="reset")],
+    )
+    # heartbeats are exempt by default and don't advance any frame count
+    assert not p.decide("__hb__").reset
+    decisions = [p.decide("uploadVars") for _ in range(4)]
+    assert [d.reset for d in decisions] == [False, False, True, False]
+    assert p.injected["reset"] == 1
+    assert p.frames_seen("uploadVars") == 4
+    with pytest.raises(ValueError):
+        ScriptedFault(event="x", nth=0, action="drop")
+    with pytest.raises(ValueError):
+        ScriptedFault(event="x", nth=1, action="explode")
+
+
+def test_error_hierarchy_backwards_compatible():
+    # pre-hierarchy except clauses must keep working
+    assert issubclass(AckTimeout, TimeoutError)
+    assert issubclass(AckTimeout, TransportError)
+    assert issubclass(ConnectionLost, ConnectionError)
+    assert issubclass(ConnectionLost, OSError)
+    assert issubclass(FrameCorruptionError, TransportError)
+
+
+# -- CRC frames -------------------------------------------------------------
+
+
+def test_crc_detects_flipped_byte():
+    payload = encode({"event": "x", "payload": 7})
+    frame = bytearray(frame_bytes(payload))
+    frame[-1] ^= 0xFF  # flip one payload byte in transit
+
+    async def read(buf):
+        reader = asyncio.StreamReader()
+        reader.feed_data(bytes(buf))
+        reader.feed_eof()
+        return await _read_frame(reader)
+
+    assert asyncio.run(read(frame_bytes(payload))) == payload
+    with pytest.raises(FrameCorruptionError):
+        asyncio.run(read(frame))
+
+
+def test_corrupt_frame_resets_connection():
+    """A client whose stream corrupts is reset by the server (desynced
+    framing cannot be resynchronized), running the normal disconnect path."""
+    server = ServerTransport(heartbeat_interval=0.1, heartbeat_timeout=5.0).start()
+    gone = []
+    server.on_disconnect = gone.append
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port))
+        sock.sendall(frame_bytes(encode({"event": "hello", "payload": None})))
+        assert _wait_for(lambda: server.num_clients == 1)
+        bad = bytearray(frame_bytes(encode({"event": "hello", "payload": 1})))
+        bad[-1] ^= 0xFF
+        sock.sendall(bytes(bad))
+        assert _wait_for(lambda: server.num_clients == 0), "corrupt frame not reset"
+        assert _wait_for(lambda: len(gone) == 1)
+        sock.close()
+    finally:
+        server.stop()
+
+
+class _SlowFitModel(MockModel):
+    """MockModel with a per-batch compute delay, so a mid-run server kill
+    reliably lands while training is still in progress (the plain MockModel
+    finishes 8 loopback batches in well under the kill window)."""
+
+    def __init__(self, *args, fit_delay_s=0.15, **kw):
+        super().__init__(*args, **kw)
+        self.fit_delay_s = fit_delay_s
+
+    def fit(self, x, y):
+        time.sleep(self.fit_delay_s)
+        return super().fit(x, y)
+
+
+# -- idempotent uploads -----------------------------------------------------
+
+
+class _CountingServer(AbstractServer):
+    """Minimal AbstractServer: counts handle_upload calls per update_id."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.handled = []
+        self.apply_delay_s = 0.0
+
+    def handle_connection(self, client_id):
+        pass
+
+    def handle_upload(self, client_id, msg):
+        if self.apply_delay_s:
+            time.sleep(self.apply_delay_s)
+        self.handled.append(msg.update_id)
+        return {"applied": len(self.handled)}
+
+
+def _upload_wire(update_id):
+    return UploadMsg(
+        client_id="c1",
+        batch=0,
+        gradients=GradientMsg(version="v0", vars={}),
+        update_id=update_id,
+    ).to_wire()
+
+
+def test_duplicate_upload_applied_once(tmp_path):
+    server = _CountingServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(save_dir=str(tmp_path / "m")),
+    )
+    first = server._on_upload_wire("c1", _upload_wire("u-1"))
+    dup = server._on_upload_wire("c1", _upload_wire("u-1"))
+    assert server.handled == ["u-1"], "duplicate must not re-apply"
+    assert dup == first, "duplicate must be acked with the cached result"
+    assert server.duplicate_uploads == 1
+    server._on_upload_wire("c1", _upload_wire("u-2"))
+    assert server.handled == ["u-1", "u-2"]
+
+
+def test_concurrent_duplicate_uploads_gate(tmp_path):
+    """Two deliveries of the same update racing on handler threads: exactly
+    one applies; the other waits on the in-flight gate and re-acks."""
+    server = _CountingServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(save_dir=str(tmp_path / "m")),
+    )
+    server.apply_delay_s = 0.2
+    results = []
+
+    def deliver():
+        results.append(server._on_upload_wire("c1", _upload_wire("u-race")))
+
+    threads = [threading.Thread(target=deliver) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert server.handled == ["u-race"], "concurrent duplicates must apply once"
+    assert results == [{"applied": 1}] * 3
+    assert server.duplicate_uploads == 2
+
+
+def test_dedup_cache_bounded(tmp_path):
+    server = _CountingServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(save_dir=str(tmp_path / "m"), dedup_cache_size=4),
+    )
+    for i in range(8):
+        server._on_upload_wire("c1", _upload_wire(f"u-{i}"))
+    assert len(server._applied_ids) == 4
+    # an evicted id re-applies (the bounded-memory tradeoff, documented)
+    server._on_upload_wire("c1", _upload_wire("u-0"))
+    assert server.handled.count("u-0") == 2
+
+
+def test_legacy_upload_without_update_id(tmp_path):
+    """Uploads from clients that never set update_id still work (no dedup)."""
+    server = _CountingServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(save_dir=str(tmp_path / "m")),
+    )
+    wire = UploadMsg(client_id="c1", batch=0,
+                     gradients=GradientMsg(version="v0", vars={})).to_wire()
+    assert "update_id" not in wire
+    server._on_upload_wire("c1", wire)
+    server._on_upload_wire("c1", wire)
+    assert server.handled == [None, None]
+    assert server.duplicate_uploads == 0
+
+
+# -- retry over the wire ----------------------------------------------------
+
+
+def test_scripted_ack_drop_triggers_retry_and_dedup(tmp_path):
+    """The server's very first ack vanishes: the client cannot know whether
+    its upload was applied, so it retries the same update_id, and the server
+    acks the duplicate from cache — the gradient lands exactly once."""
+    x, y = _xy(8)
+    dataset = DistributedDataset(x, y, {"batch_size": 4, "epochs": 1})
+    server = _server(
+        tmp_path,
+        dataset,
+        fault_plan=FaultPlan(
+            seed=0, schedule=[ScriptedFault(event="__ack__", nth=1, action="drop")]
+        ),
+    )
+    server.setup()
+    applied = []
+    server.on_upload(lambda m: applied.append(m.update_id))
+    client = AsynchronousSGDClient(
+        server.address, MockModel(), _client_config(upload_timeout_s=0.5)
+    )
+    try:
+        client.setup(timeout=10.0)
+        done = client.train_until_complete(timeout=60.0)
+        # training completes without waiting on the lost ack (the server
+        # applied the upload and kept dispatching) — but the upload whose
+        # ack vanished is still retrying in the background; it must land,
+        # be recognized as a duplicate, and NOT re-apply
+        assert _wait_for(lambda: server.duplicate_uploads >= 1, timeout=30.0), (
+            "the retried upload was never deduped"
+        )
+    finally:
+        client.dispose()
+        server.stop()
+    assert done == 2
+    assert server.applied_updates == 2, "retried upload double-applied"
+    assert len(applied) == len(set(applied)) == 2, "retried upload double-applied"
+    assert server.config.fault_plan.injected["drop"] == 1
+
+
+# -- reconnect --------------------------------------------------------------
+
+
+def test_reconnect_after_server_restart(tmp_path):
+    """Kill the server mid-training, restart it on the same port with the
+    same model/dataset state: the client auto-reconnects, re-runs the
+    handshake, and the run completes with the version still advancing."""
+    x, y = _xy(32)
+    dataset = DistributedDataset(x, y, {"batch_size": 4, "epochs": 1})
+    model = DistributedServerInMemoryModel(MockModel())
+
+    def make_server(port):
+        return AsynchronousSGDServer(
+            model,
+            dataset,
+            DistributedServerConfig(
+                save_dir=str(tmp_path / "models"),
+                port=port,
+                heartbeat_interval_s=0.1,
+                heartbeat_timeout_s=0.5,
+            ),
+        )
+
+    server1 = make_server(0)
+    server1.setup()
+    port = server1.transport.port
+    reconnected = threading.Event()
+    client = AsynchronousSGDClient(
+        server1.address,
+        _SlowFitModel(),
+        _client_config(heartbeat_timeout_s=0.5, upload_timeout_s=1.0),
+    )
+    client.on_reconnect(lambda n: reconnected.set())
+    server2 = None
+    try:
+        client.setup(timeout=10.0)
+        assert _wait_for(lambda: client.batches_processed >= 2, timeout=30.0)
+        applied_before = server1.applied_updates
+        version_before = server1.version_counter
+        server1.stop()  # hard kill mid-training
+        # what a restart-from-checkpoint does operationally: outstanding
+        # batches (dispatch records died with the server) go back in the queue
+        for b in list(dataset.outstanding_batches):
+            dataset.requeue(b)
+        server2 = make_server(port)
+        server2.version_counter = version_before  # restored state
+        server2.applied_updates = applied_before
+        server2.setup()
+        done = client.train_until_complete(timeout=60.0)
+    finally:
+        client.dispose()
+        if server2 is not None:
+            server2.stop()
+    assert reconnected.is_set() and client.reconnects >= 1
+    # At-least-once across a cold restart: the dedup cache died with server1,
+    # so the single batch in flight at kill time may legitimately be
+    # recomputed once after the requeue. Exhaustion proves full coverage.
+    assert 8 <= done <= 9, f"all 8 batches must complete across the restart, got {done}"
+    assert server2.version_counter > version_before, "version must keep advancing"
+    assert dataset.exhausted
+
+
+def test_reconnect_budget_exhaustion_surfaces(tmp_path):
+    """When the server never comes back, the client fails loudly with a
+    typed ConnectionLost instead of hanging out the full training timeout."""
+    x, y = _xy(32)
+    dataset = DistributedDataset(x, y, {"batch_size": 4, "epochs": 1})
+    server = _server(tmp_path, dataset, heartbeat_timeout_s=0.5)
+    server.setup()
+    client = AsynchronousSGDClient(
+        server.address,
+        _SlowFitModel(),  # slow batches: the kill must land mid-training
+        _client_config(
+            heartbeat_timeout_s=0.5,
+            upload_timeout_s=0.5,
+            upload_retry=RetryPolicy(max_retries=1, initial_backoff_s=0.05,
+                                     max_backoff_s=0.1, seed=1),
+            reconnect_retry=RetryPolicy(max_retries=2, initial_backoff_s=0.05,
+                                        max_backoff_s=0.1, seed=2),
+        ),
+    )
+    try:
+        client.setup(timeout=10.0)
+        assert _wait_for(lambda: client.batches_processed >= 1, timeout=30.0)
+        server.stop()  # and never restart
+        with pytest.raises(ConnectionLost):
+            client.train_until_complete(timeout=30.0)
+        assert client.connection_failed.is_set()
+    finally:
+        client.dispose()
+        server.stop()
+
+
+def test_client_transport_raises_typed_errors():
+    # connect to a dead port -> ConnectionLost (not bare OSError)
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    with pytest.raises(ConnectionLost):
+        ClientTransport(f"127.0.0.1:{dead_port}").connect(timeout=2.0)
+    # a handler that outlives the ack window -> AckTimeout (catchable as
+    # the old TimeoutError too, by inheritance)
+    server = ServerTransport(heartbeat_interval=0).start()
+    server.on("slow", lambda cid, payload: time.sleep(3.0))
+    try:
+        client = ClientTransport(server.address, heartbeat_interval=0).connect()
+        with pytest.raises(AckTimeout):
+            client.request("slow", None, timeout=0.3)
+    finally:
+        server.stop()
+
+
+# -- the headline: full run under chaos -------------------------------------
+
+
+def test_chaos_acceptance_run(tmp_path):
+    """Async-SGD training under a seeded FaultPlan with drops, duplicate
+    deliveries, and one scripted mid-run connection reset. The run must
+    complete, each update_id must be applied exactly once, and the model
+    version must be strictly increasing (one bump per applied update)."""
+    x, y = _xy(24)  # 12 batches of 2
+    dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    server = _server(
+        tmp_path,
+        dataset,
+        heartbeat_timeout_s=1.0,
+        # at-least-once delivery on the server's frames (Downloads, acks)
+        fault_plan=FaultPlan(seed=5, duplicate=0.1),
+    )
+    server.setup()
+    applied_ids = []
+    versions = []
+    server.on_upload(lambda m: applied_ids.append(m.update_id))
+    server.on_new_version(lambda v: versions.append(v))
+    client_plan = FaultPlan(
+        seed=3,
+        drop=0.1,
+        duplicate=0.1,
+        schedule=[ScriptedFault(event="uploadVars", nth=3, action="reset")],
+    )
+    client = AsynchronousSGDClient(
+        server.address,
+        MockModel(),
+        _client_config(
+            heartbeat_timeout_s=1.0, upload_timeout_s=1.0, fault_plan=client_plan
+        ),
+    )
+    try:
+        client.setup(timeout=10.0)
+        done = client.train_until_complete(timeout=120.0)
+    finally:
+        client.dispose()
+        server.stop()
+    # every batch trained exactly once on the client...
+    assert done == 12, f"expected 12 batches processed, got {done}"
+    # ...and applied exactly once on the server, despite retries/duplicates
+    assert len(applied_ids) == 12, f"expected 12 applied updates, got {applied_ids}"
+    assert len(set(applied_ids)) == 12, "an update_id was applied more than once"
+    assert server.applied_updates == 12 and server.version_counter == 12
+    # strictly increasing version: one new distinct version per applied update
+    assert len(versions) == 12 and len(set(versions)) == 12
+    # the scripted reset fired and forced a reconnect
+    assert client_plan.injected["reset"] == 1
+    assert client.reconnects >= 1, "the scripted reset must trigger a reconnect"
+    assert server.duplicate_uploads >= 1, "the reset's retry must be deduped"
+    assert dataset.exhausted

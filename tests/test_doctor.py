@@ -19,5 +19,6 @@ def test_doctor_passes_on_cpu():
     assert out.returncode == 0, out.stdout + out.stderr
     assert "all checks passed" in out.stdout
     for name in ("backend/devices", "mesh construction", "allreduce",
-                 "train step", "wire transport", "checkpoint store"):
+                 "train step", "wire transport", "chaos self-test",
+                 "checkpoint store"):
         assert f"ok   {name}" in out.stdout, (name, out.stdout)

@@ -190,6 +190,9 @@ def test_lm_train_then_serve():
     import time
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # don't inherit the suite's 8-virtual-device XLA_FLAGS: the child
+    # trains batch-size 4, which cannot shard over a data=8 mesh
+    env.pop("XLA_FLAGS", None)
     proc = subprocess.Popen(
         [_sys.executable, "-m", "experiments.lm.train",
          "--steps", "4", "--seq", "32", "--batch-size", "4",

@@ -8,14 +8,13 @@ detect a vanished server via ``on_server_lost``.
 """
 
 import socket
-import struct
 import threading
 import time
 
 import numpy as np
 
 from distriflow_tpu.comm.codec import encode
-from distriflow_tpu.comm.transport import ClientTransport, ServerTransport
+from distriflow_tpu.comm.transport import ClientTransport, ServerTransport, frame_bytes
 
 
 def _wait_for(cond, timeout=10.0, step=0.05):
@@ -35,8 +34,7 @@ def test_silent_client_is_reaped():
         # raw socket that connects, says hello, then goes silent (a hung
         # worker: TCP stays open, no frames flow)
         sock = socket.create_connection(("127.0.0.1", server.port))
-        payload = encode({"event": "hello", "payload": None})
-        sock.sendall(struct.pack("<Q", len(payload)) + payload)
+        sock.sendall(frame_bytes(encode({"event": "hello", "payload": None})))
         assert _wait_for(lambda: server.num_clients == 1)
         assert _wait_for(lambda: server.num_clients == 0), "silent client not reaped"
         assert _wait_for(lambda: len(gone) == 1)

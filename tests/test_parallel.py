@@ -67,7 +67,7 @@ def test_allreduce_mean_matches_numpy(devices):
 
 
 def test_pmean_inside_shard_map(devices):
-    from jax import shard_map
+    from distriflow_tpu.utils.compat import shard_map
 
     mesh = data_parallel_mesh(devices)
 
@@ -80,7 +80,7 @@ def test_pmean_inside_shard_map(devices):
 
 
 def test_ppermute_ring_rotates(devices):
-    from jax import shard_map
+    from distriflow_tpu.utils.compat import shard_map
 
     mesh = data_parallel_mesh(devices)
 
