@@ -1019,7 +1019,10 @@ def _fit_line(result: dict, limit: int = RECORD_LIMIT) -> str:
     """Serialize ``result`` to the one stdout line, guaranteed under
     ``limit`` chars: drop optional fields progressively (logging each to
     stderr so nothing vanishes silently), then truncate error rows, then
-    assert. Unit-tested in tests/test_bench_record.py."""
+    drop whole matrix rows from the end, then — never expected — emit a
+    hard-truncated core record. A pathological result must cost fields,
+    not the whole record (crashing here would lose every number of the
+    run). Unit-tested in tests/test_bench_record.py."""
     line = json.dumps(result)
     for field in _DROP_ORDER:
         if len(line) <= limit:
@@ -1034,8 +1037,24 @@ def _fit_line(result: dict, limit: int = RECORD_LIMIT) -> str:
             if "error" in row and len(row["error"]) > 80:
                 row["error"] = row["error"][-80:]
         line = json.dumps(result)
-    assert len(line) <= limit, (
-        f"result line {len(line)} chars > record window {limit}")
+    # hard-truncation ladder: losing tail rows beats losing the record
+    matrix = result.get("matrix")
+    while len(line) > limit and matrix:
+        dropped = matrix.pop()
+        result["truncated"] = True
+        log(f"record trim: dropped whole row {dropped.get('config')!r} "
+            f"(line still over the {limit}-char window)")
+        line = json.dumps(result)
+    if len(line) > limit:
+        # headline fields alone exceed the window (absurd but possible, e.g.
+        # an enormous injected value): keep the identity + headline metric
+        core = {k: result[k] for k in
+                ("metric", "value", "unit", "device", "n_chips")
+                if k in result}
+        core["truncated"] = True
+        log(f"record trim: hard-truncated to core fields ({len(line)} chars "
+            f"> {limit})")
+        line = json.dumps(core)[:limit]
     return line
 
 

@@ -34,6 +34,7 @@ from distriflow_tpu.comm.transport import (
     FaultPlan,
 )
 from distriflow_tpu.models.base import DistributedModel, ModelSource, fetch_model
+from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
 from distriflow_tpu.utils.config import (
     COMPRESSION_DTYPES,
     DEFAULT_CLIENT_HYPERPARAMS,
@@ -86,6 +87,10 @@ class DistributedClientConfig:
     # fault injection (tests / chaos drills): consulted by the client's
     # transport at every frame boundary
     fault_plan: Optional[FaultPlan] = None
+    # telemetry spine (see distriflow_tpu.obs): None uses the process-global
+    # instance; loopback tests share one Telemetry with the server so the
+    # upload/apply spans of a trace land in the same tracer
+    telemetry: Optional[Telemetry] = None
 
 
 def resolve_client_id(config: DistributedClientConfig) -> str:
@@ -143,6 +148,14 @@ class AbstractClient:
         self._disposed = False
         self.reconnects = 0
         self.connection_failed = threading.Event()
+        self.telemetry = (
+            self.config.telemetry
+            if self.config.telemetry is not None
+            else get_telemetry()
+        )
+        self._c_reconnects = self.telemetry.counter("client_reconnects_total")
+        self._c_uploads = self.telemetry.counter("client_uploads_total")
+        self._c_retries = self.telemetry.counter("client_upload_retries_total")
         # int8 gradient compression: per-leaf quantization residual carried
         # into the next upload (error feedback); lazily keyed by tree path
         self._quant_error: Optional[Dict[str, Any]] = None
@@ -183,6 +196,7 @@ class AbstractClient:
             heartbeat_interval=self.config.heartbeat_interval_s,
             heartbeat_timeout=self.config.heartbeat_timeout_s,
             fault_plan=self.config.fault_plan,
+            telemetry=self.telemetry,
         )
         transport.on(Events.Download.value, self._on_download)
         transport.on("trainingComplete", self._on_training_complete)
@@ -231,6 +245,7 @@ class AbstractClient:
                     self._transport_ready.clear()
                     continue
                 self.reconnects += 1
+                self._c_reconnects.inc()
                 self.log(f"reconnected to {self.server_address} "
                          f"(attempt {attempt}, total reconnects {self.reconnects})")
                 self.callbacks.fire("reconnect", self.reconnects)
@@ -294,34 +309,70 @@ class AbstractClient:
             timeout = self.config.upload_timeout_s
         if msg.update_id is None:
             msg.update_id = uuid_lib.uuid4().hex
-        wire = msg.to_wire()
-        policy = self.config.upload_retry.validate()
-        last_exc: Optional[Exception] = None
-        delays = [None, *policy.delays()]  # first attempt is immediate
-        for attempt, delay in enumerate(delays):
-            if self._disposed:
-                raise last_exc or ConnectionLost("client disposed")
-            if delay is not None:
-                time.sleep(delay)
-                # if a reconnect is in flight, wait (bounded) for the fresh
-                # transport rather than burning the attempt on a dead one
-                self._transport_ready.wait(timeout)
-            transport = self.transport
-            if transport is None:
-                last_exc = ConnectionLost("not connected")
-                continue
+        self._c_uploads.inc()
+        reconnects_at_start = self.reconnects
+        transport_at_start = self.transport
+        # ONE span covers every attempt: retries resend the same wire bytes
+        # (same update_id, same trace_id), so the span's trace is the trace
+        # every duplicate delivery and the eventual server apply land in. If
+        # the caller pre-stamped a trace_id (e.g. from the dispatch that
+        # produced this update), the span joins it; otherwise it starts one.
+        with self.telemetry.span(
+            "upload", trace_id=msg.trace_id,
+            client_id=self.client_id, update_id=msg.update_id,
+        ) as span:
+            msg.trace_id = span.trace_id or msg.trace_id
+            msg.span_id = span.span_id or msg.span_id
+            wire = msg.to_wire()
+            policy = self.config.upload_retry.validate()
+            last_exc: Optional[Exception] = None
+            delays = [None, *policy.delays()]  # first attempt is immediate
+            attempts = 0
             try:
-                result = transport.request(Events.Upload.value, wire, timeout)
-                break
-            except (AckTimeout, ConnectionLost) as exc:
-                last_exc = exc
-                self.log(
-                    f"upload attempt {attempt + 1}/{len(delays)} failed "
-                    f"({type(exc).__name__}: {exc}); update_id={msg.update_id}"
-                )
-        else:
-            assert last_exc is not None
-            raise last_exc
+                for attempt, delay in enumerate(delays):
+                    if self._disposed:
+                        raise last_exc or ConnectionLost("client disposed")
+                    attempts = attempt + 1
+                    if delay is not None:
+                        self._c_retries.inc()
+                        time.sleep(delay)
+                        # if a reconnect is in flight, wait (bounded) for the
+                        # fresh transport instead of burning the attempt on a
+                        # dead one
+                        self._transport_ready.wait(timeout)
+                    transport = self.transport
+                    if transport is None:
+                        last_exc = ConnectionLost("not connected")
+                        continue
+                    try:
+                        result = transport.request(Events.Upload.value, wire,
+                                                   timeout)
+                        break
+                    except (AckTimeout, ConnectionLost) as exc:
+                        last_exc = exc
+                        self.log(
+                            f"upload attempt {attempt + 1}/{len(delays)} failed "
+                            f"({type(exc).__name__}: {exc}); "
+                            f"update_id={msg.update_id}"
+                        )
+                else:
+                    assert last_exc is not None
+                    raise last_exc
+            finally:
+                # EVERY exit — success, exhausted retries, dispose, abort —
+                # records how many reconnects the span straddled, so chaos
+                # reconciliation can find the upload that crossed the reset
+                # even when that particular call errored out and the retry
+                # landed via a redelivered batch on the same trace
+                spanned = self.reconnects - reconnects_at_start
+                current = self.transport
+                if (spanned == 0 and current is not None
+                        and current is not transport_at_start):
+                    # the ack beat the reconnect loop's counter bump: the
+                    # swap of the transport object is the ground truth that
+                    # a reconnect happened inside this span
+                    spanned = 1
+                span.set(attempts=attempts, reconnects_spanned=spanned)
         version = msg.gradients.version if msg.gradients is not None else None
         if version is not None:
             self.version_update_counts[version] = (

@@ -88,6 +88,11 @@ class AsynchronousSGDClient(AbstractClient):
                     ),
                     metrics=metrics,
                     update_id=uuid_lib.uuid4().hex,
+                    # join the dispatch's trace (rides the download header):
+                    # dispatch -> train -> upload -> apply is one trace, and
+                    # a redelivered batch re-uploads this same cached message
+                    # — same trace — so duplicates share it by construction
+                    trace_id=msg.trace_id,
                 )
                 self._recent_uploads[key] = upload
                 while len(self._recent_uploads) > _RECENT_UPLOADS:

@@ -53,6 +53,7 @@ import uuid
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 from distriflow_tpu.comm.codec import checksum, decode, encode
+from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
 
 CONNECT_TIMEOUT_S = 10.0  # reference abstract_client.ts:12
 ACK_TIMEOUT_S = 5.0  # reference abstract_client.ts:13
@@ -216,6 +217,13 @@ class FaultPlan:
         with self._lock:
             return self._counts[event]
 
+    def seen(self) -> Dict[str, int]:
+        """Copy of all per-event offered-frame counts (exempt events are
+        never counted); the doctor reconciles these totals against the
+        transport's ``transport_frames_offered_total`` counters."""
+        with self._lock:
+            return dict(self._counts)
+
     def decide(self, event: str) -> FaultDecision:
         """One decision for one outbound frame carrying ``event``."""
         if event in self.exempt:
@@ -257,36 +265,68 @@ class FaultPlan:
 
 
 class _Endpoint:
-    """Shared emit/ack machinery for one connection."""
+    """Shared emit/ack machinery for one connection.
+
+    Telemetry contract: the per-action fault counters below are bumped at
+    the exact site each :class:`FaultDecision` field is *applied* — one
+    increment per fired decision, never per copy written — so across all
+    endpoints sharing a plan, ``transport_frames_<action>_total`` sums to
+    exactly ``FaultPlan.injected[action]`` (the reconciliation the doctor
+    enforces).
+    """
 
     def __init__(
         self,
         loop: asyncio.AbstractEventLoop,
         writer: asyncio.StreamWriter,
         fault_plan: Optional[FaultPlan] = None,
+        telemetry: Optional[Telemetry] = None,
+        role: str = "server",
     ):
         self.loop = loop
         self.writer = writer
         self.fault_plan = fault_plan
         self._acks: Dict[str, asyncio.Future] = {}
         self._write_lock = asyncio.Lock()
+        t = telemetry if telemetry is not None else get_telemetry()
+        # handles cached once: the send/ack hot path does no registry lookups
+        self._c_sent = t.counter("transport_frames_sent_total", role=role)
+        self._c_offered = t.counter("transport_frames_offered_total", role=role)
+        self._c_dropped = t.counter("transport_frames_dropped_total", role=role)
+        self._c_duplicated = t.counter("transport_frames_duplicated_total", role=role)
+        self._c_corrupted = t.counter("transport_frames_corrupted_total", role=role)
+        self._c_delayed = t.counter("transport_frames_delayed_total", role=role)
+        self._c_resets = t.counter("transport_resets_total", role=role)
+        self._h_ack = t.histogram("transport_ack_latency_ms", role=role)
 
     async def _send(self, msg: Dict[str, Any]) -> None:
         copies, corrupt = 1, False
         if self.fault_plan is not None:
-            d = self.fault_plan.decide(str(msg.get("event", "")))
+            event = str(msg.get("event", ""))
+            if event not in self.fault_plan.exempt:
+                # mirrors FaultPlan._counts exactly (exempt frames skipped)
+                self._c_offered.inc()
+            d = self.fault_plan.decide(event)
             if d.reset:
+                self._c_resets.inc()
                 self.writer.close()
                 raise ConnectionLost("fault injection: connection reset")
             if d.drop:
+                self._c_dropped.inc()
                 return  # the frame vanishes; acks/retries must recover
             if d.delay_s > 0:
+                self._c_delayed.inc()
                 await asyncio.sleep(d.delay_s)
-            copies = 2 if d.duplicate else 1
-            corrupt = d.corrupt
+            if d.duplicate:
+                self._c_duplicated.inc()
+                copies = 2
+            if d.corrupt:
+                self._c_corrupted.inc()
+                corrupt = True
         async with self._write_lock:
             for _ in range(copies):
                 await _write_frame(self.writer, encode(msg), corrupt=corrupt)
+                self._c_sent.inc()
 
     def fail_pending(self, exc: BaseException) -> None:
         """Fail every in-flight request (connection torn down): retryable
@@ -303,9 +343,14 @@ class _Endpoint:
         msg_id = uuid.uuid4().hex
         fut = self.loop.create_future()
         self._acks[msg_id] = fut
+        t0 = time.perf_counter()
         try:
             await self._send({"event": event, "payload": payload, "msg_id": msg_id})
-            return await asyncio.wait_for(fut, timeout)
+            result = await asyncio.wait_for(fut, timeout)
+            # only acked round-trips land in the latency histogram —
+            # timeouts/drops are visible in the counters instead
+            self._h_ack.observe((time.perf_counter() - t0) * 1000.0)
+            return result
         finally:
             self._acks.pop(msg_id, None)
 
@@ -325,12 +370,18 @@ class ServerTransport:
         heartbeat_interval: float = HEARTBEAT_INTERVAL_S,
         heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S,
         fault_plan: Optional[FaultPlan] = None,
+        telemetry: Optional[Telemetry] = None,
     ):
         self.host = host
         self.port = port
         self.heartbeat_interval = heartbeat_interval
         self.heartbeat_timeout = heartbeat_timeout  # 0 disables reaping
         self.fault_plan = fault_plan  # chaos testing: shared by all connections
+        self.telemetry = telemetry if telemetry is not None else get_telemetry()
+        self._c_received = self.telemetry.counter(
+            "transport_frames_received_total", role="server")
+        self._c_corrupt_rx = self.telemetry.counter(
+            "transport_frames_corrupt_rx_total", role="server")
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -418,7 +469,8 @@ class ServerTransport:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         client_id = uuid.uuid4().hex
-        endpoint = _Endpoint(self._loop, writer, fault_plan=self.fault_plan)
+        endpoint = _Endpoint(self._loop, writer, fault_plan=self.fault_plan,
+                             telemetry=self.telemetry, role="server")
         self._clients[client_id] = endpoint
         self._last_seen[client_id] = time.monotonic()
         if self.on_connect:
@@ -457,6 +509,7 @@ class ServerTransport:
             while True:
                 frame = await _read_frame(reader)
                 msg = decode(frame)
+                self._c_received.inc()
                 self._last_seen[client_id] = time.monotonic()
                 if msg.get("event") == "__ack__":
                     endpoint.handle_ack(msg)
@@ -474,6 +527,7 @@ class ServerTransport:
             # a desynced stream cannot be resynchronized: reset the
             # connection (the finally below closes it; the client's
             # reconnect machinery re-establishes a clean session)
+            self._c_corrupt_rx.inc()
             print(f"[transport] resetting client {client_id[:8]}: {e}",
                   file=sys.stderr, flush=True)
         except ValueError as e:
@@ -524,6 +578,7 @@ class ClientTransport:
         heartbeat_interval: float = HEARTBEAT_INTERVAL_S,
         heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S,
         fault_plan: Optional[FaultPlan] = None,
+        telemetry: Optional[Telemetry] = None,
     ):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
@@ -531,6 +586,11 @@ class ClientTransport:
         self.heartbeat_interval = heartbeat_interval  # 0 disables heartbeats
         self.heartbeat_timeout = heartbeat_timeout  # 0 disables loss detection
         self.fault_plan = fault_plan
+        self.telemetry = telemetry if telemetry is not None else get_telemetry()
+        self._c_received = self.telemetry.counter(
+            "transport_frames_received_total", role="client")
+        self._c_corrupt_rx = self.telemetry.counter(
+            "transport_frames_corrupt_rx_total", role="client")
         self.on_server_lost: Optional[Callable[[], None]] = None
         self._last_server_frame = time.monotonic()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -577,7 +637,8 @@ class ClientTransport:
 
         async def main():
             reader, writer = await asyncio.open_connection(self.host, self.port)
-            self._endpoint = _Endpoint(self._loop, writer, fault_plan=self.fault_plan)
+            self._endpoint = _Endpoint(self._loop, writer, fault_plan=self.fault_plan,
+                                       telemetry=self.telemetry, role="client")
             self._last_server_frame = time.monotonic()
             self._connected.set()
 
@@ -618,6 +679,7 @@ class ClientTransport:
                 while True:
                     frame = await _read_frame(reader)
                     msg = decode(frame)
+                    self._c_received.inc()
                     self._last_server_frame = time.monotonic()
                     if msg.get("event") == "__ack__":
                         self._endpoint.handle_ack(msg)
@@ -633,6 +695,7 @@ class ClientTransport:
             except FrameCorruptionError as e:
                 # desynced stream: reset and let the reconnect machinery
                 # re-establish a clean session
+                self._c_corrupt_rx.inc()
                 print(f"[transport] resetting connection: {e}", file=sys.stderr, flush=True)
                 if not self._stopped and self.on_server_lost is not None:
                     await self._loop.run_in_executor(None, self.on_server_lost)

@@ -11,10 +11,16 @@ silent socket.io hang). Checks, in order:
 4. a tiny train step (MLP, one optimizer update, loss finite);
 5. loopback transport round trip (server + client + ack);
 6. chaos self-test: a loopback train run under a seeded 10% frame-drop +
-   duplicate FaultPlan, asserting every upload applies exactly once
-   (retry + dedup machinery, see ``docs/ROBUSTNESS.md``);
-7. native C++ host library presence (optional — numpy fallback is fine);
-8. checkpoint write/read round trip in a temp dir.
+   duplicate FaultPlan plus a scripted mid-upload connection reset,
+   asserting every upload applies exactly once (retry + dedup machinery,
+   see ``docs/ROBUSTNESS.md``);
+7. telemetry reconciliation: the chaos run's ``Telemetry.snapshot()``
+   counters must EXACTLY match the FaultPlans' injected-event counts and
+   ``frames_seen`` totals, at least one upload trace must span the
+   reconnect, and every apply span must link to a client upload trace
+   (see ``docs/OBSERVABILITY.md``);
+8. native C++ host library presence (optional — numpy fallback is fine);
+9. checkpoint write/read round trip in a temp dir.
 
 Exit code 0 when every mandatory check passes; each check prints
 ``ok``/``FAIL`` with a one-line detail, so CI and humans read the same
@@ -111,14 +117,19 @@ def main() -> int:
 
     ok &= _check("wire transport", transport)
 
+    # populated by the chaos run, consumed by the telemetry reconciliation
+    # check right after it (one loopback run feeds both checks)
+    chaos_state = {}
+
     def chaos():
         import numpy as np
 
         from distriflow_tpu.client.abstract_client import DistributedClientConfig
         from distriflow_tpu.client.async_client import AsynchronousSGDClient
-        from distriflow_tpu.comm.transport import FaultPlan
+        from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
         from distriflow_tpu.data.dataset import DistributedDataset
         from distriflow_tpu.models.base import DistributedModel
+        from distriflow_tpu.obs import Telemetry
         from distriflow_tpu.server.abstract_server import DistributedServerConfig
         from distriflow_tpu.server.async_server import AsynchronousSGDServer
         from distriflow_tpu.server.models import DistributedServerInMemoryModel
@@ -165,6 +176,16 @@ def main() -> int:
         y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
         dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
         applied = []
+        # one Telemetry for both endpoints: cross-endpoint traces land in a
+        # single tracer and the counters reconcile against both fault plans
+        tel = Telemetry()
+        server_plan = FaultPlan(seed=5, duplicate=0.1)
+        # the scripted reset tears the connection down mid-upload, forcing
+        # at least one upload trace to span a reconnect (checked below)
+        client_plan = FaultPlan(
+            seed=3, drop=0.1, duplicate=0.1,
+            schedule=[ScriptedFault(event="uploadVars", nth=2, action="reset")],
+        )
         with tempfile.TemporaryDirectory() as d:
             server = AsynchronousSGDServer(
                 DistributedServerInMemoryModel(TinyModel()),
@@ -173,7 +194,8 @@ def main() -> int:
                     save_dir=d,
                     heartbeat_interval_s=0.1,
                     heartbeat_timeout_s=2.0,
-                    fault_plan=FaultPlan(seed=5, duplicate=0.1),
+                    fault_plan=server_plan,
+                    telemetry=tel,
                 ),
             )
             server.setup()
@@ -188,7 +210,8 @@ def main() -> int:
                     upload_retry=RetryPolicy(
                         max_retries=6, initial_backoff_s=0.05, max_backoff_s=0.5, seed=3
                     ),
-                    fault_plan=FaultPlan(seed=3, drop=0.1, duplicate=0.1),
+                    fault_plan=client_plan,
+                    telemetry=tel,
                 ),
             )
             try:
@@ -203,13 +226,79 @@ def main() -> int:
         assert len(applied) == len(set(applied)) == 4, (
             f"updates not applied exactly once: {applied}"
         )
-        injected = dict(client.config.fault_plan.injected)
-        injected.update({f"srv_{k}": v for k, v in server.config.fault_plan.injected.items()})
-        return ("4 uploads exactly-once under 10% drop+duplicate "
+        chaos_state.update(
+            telemetry=tel, client_plan=client_plan, server_plan=server_plan,
+            applied_updates=server.applied_updates,
+        )
+        injected = dict(client_plan.injected)
+        injected.update({f"srv_{k}": v for k, v in server_plan.injected.items()})
+        return ("4 uploads exactly-once under 10% drop+duplicate+reset "
                 f"(injected: {injected or 'none'}, "
                 f"duplicates suppressed: {server.duplicate_uploads})")
 
-    ok &= _check("chaos self-test (drop+duplicate faults)", chaos)
+    ok &= _check("chaos self-test (drop+duplicate+reset faults)", chaos)
+
+    def telemetry_reconciliation():
+        """The chaos run's snapshot must agree EXACTLY with its FaultPlans:
+        every injected fault is accounted by the transport counters, every
+        offered frame matches ``FaultPlan.frames_seen``, at least one upload
+        trace spans a reconnect, and every applied update's server span
+        links to a client upload span with the same trace_id."""
+        tel = chaos_state["telemetry"]
+        # in-flight client spans close a beat after dispose() returns (the
+        # upload thread finishes its span when the dead transport's ack wait
+        # aborts): wait briefly for span quiescence before reconciling
+        want = chaos_state["applied_updates"]
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            span_ids = {s["span_id"] for s in tel.tracer.finished("upload")}
+            applies = [s for s in tel.tracer.finished("apply")
+                       if not s.get("dedup")]
+            if len(applies) >= want and all(
+                    a["parent_id"] in span_ids for a in applies):
+                break
+            time.sleep(0.02)
+        plans = (("client", chaos_state["client_plan"]),
+                 ("server", chaos_state["server_plan"]))
+        for action, counter in (
+            ("drop", "transport_frames_dropped_total"),
+            ("duplicate", "transport_frames_duplicated_total"),
+            ("corrupt", "transport_frames_corrupted_total"),
+            ("delay", "transport_frames_delayed_total"),
+            ("reset", "transport_resets_total"),
+        ):
+            for role, plan in plans:
+                got = tel.counter_value(counter, role=role)
+                want = plan.injected.get(action, 0)
+                assert got == want, (
+                    f"{counter}{{role={role}}} = {got:g} but the plan "
+                    f"injected {action} x{want}"
+                )
+        for role, plan in plans:
+            offered = tel.counter_value("transport_frames_offered_total", role=role)
+            seen = sum(plan.seen().values())
+            assert offered == seen, (
+                f"transport_frames_offered_total{{role={role}}} = {offered:g} "
+                f"but the plan saw {seen} frames"
+            )
+        uploads = tel.tracer.finished("upload")
+        spanning = [s for s in uploads if s.get("reconnects_spanned", 0) > 0]
+        assert spanning, "no upload trace spanned a reconnect (scripted reset?)"
+        upload_tids = {s["trace_id"] for s in uploads}
+        applies = [s for s in tel.tracer.finished("apply") if not s.get("dedup")]
+        unlinked = [a for a in applies if a["trace_id"] not in upload_tids]
+        assert applies and not unlinked, (
+            f"{len(unlinked)}/{len(applies)} apply spans not linked to an "
+            "upload trace"
+        )
+        dedup_spans = [s for s in tel.tracer.finished("apply") if s.get("dedup")]
+        return (f"counters == injected faults; offered == frames_seen; "
+                f"{len(spanning)} upload trace(s) span a reconnect; "
+                f"{len(applies)} applies + {len(dedup_spans)} dedup'd "
+                "duplicates all linked to client traces")
+
+    ok &= _check("telemetry reconciliation (snapshot vs FaultPlan)",
+                 telemetry_reconciliation)
 
     def native():
         from distriflow_tpu import native

@@ -1,0 +1,56 @@
+"""Unified telemetry: metrics registry, wire tracing, snapshot surface.
+
+Quick tour::
+
+    from distriflow_tpu import obs
+
+    t = obs.Telemetry(save_dir="runs/exp0")      # or obs.get_telemetry()
+    t.counter("transport_frames_sent_total", role="client").inc()
+    with t.span("upload", trace_id=tid) as s:
+        s.set(attempts=2)
+    t.snapshot()        # plain dict: counters / gauges / histograms
+    t.prometheus()      # text exposition for scraping
+    t.export_snapshot() # one JSONL row in <save_dir>/metrics.jsonl
+
+Offline, ``python -m distriflow_tpu.obs.dump <dir>`` summarizes a run's
+``metrics.jsonl`` + ``spans.jsonl``. See ``docs/OBSERVABILITY.md`` for
+the metric-name and span-schema reference.
+"""
+
+from distriflow_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NOOP_HANDLE,
+    render_prometheus,
+)
+from distriflow_tpu.obs.telemetry import (
+    Telemetry,
+    get_telemetry,
+    set_telemetry,
+)
+from distriflow_tpu.obs.tracing import (
+    NOOP_SPAN,
+    Span,
+    Tracer,
+    new_span_id,
+    new_trace_id,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NOOP_HANDLE",
+    "NOOP_SPAN",
+    "Span",
+    "Telemetry",
+    "Tracer",
+    "get_telemetry",
+    "new_span_id",
+    "new_trace_id",
+    "render_prometheus",
+    "set_telemetry",
+]

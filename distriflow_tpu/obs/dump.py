@@ -1,0 +1,103 @@
+"""Offline run summary: ``python -m distriflow_tpu.obs.dump <dir>``.
+
+Reads a run directory's ``metrics.jsonl`` and ``spans.jsonl`` (both
+optional — missing files are reported, not fatal) and prints:
+
+- the latest telemetry snapshot row's counters/gauges,
+- per-span-name duration stats (count, p50/p95 ms, error count),
+- trace linkage: how many traces have both a client-side ``upload`` span
+  and a server-side ``apply`` span (the cross-endpoint join wire tracing
+  exists to provide), and how many upload spans recorded a reconnect.
+
+Exit code is 0 when at least one of the two files existed, 2 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Dict, List
+
+from distriflow_tpu.obs.tracing import SPANS_FILENAME
+from distriflow_tpu.utils.metrics_log import read_metrics
+
+METRICS_FILENAME = "metrics.jsonl"
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def summarize_metrics(path: str) -> List[str]:
+    rows = list(read_metrics(path))
+    lines = [f"metrics: {len(rows)} rows ({path})"]
+    snaps = [r for r in rows if r.get("kind") == "telemetry_snapshot"]
+    if snaps:
+        last = snaps[-1]
+        lines.append(f"  latest snapshot ({len(snaps)} total):")
+        for key in sorted(last):
+            if key.startswith(("counter:", "gauge:")):
+                lines.append(f"    {key.split(':', 1)[1]} = {last[key]:g}")
+    return lines
+
+
+def summarize_spans(path: str) -> List[str]:
+    rows = list(read_metrics(path))  # same torn-tail-safe JSONL reader
+    lines = [f"spans: {len(rows)} rows ({path})"]
+
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for r in rows:
+        by_name.setdefault(r.get("name", "?"), []).append(r)
+    for name in sorted(by_name):
+        spans = by_name[name]
+        durs = sorted(float(s.get("dur_ms", 0.0)) for s in spans)
+        errors = sum(1 for s in spans
+                     if str(s.get("status", "ok")) != "ok")
+        lines.append(
+            f"  {name}: n={len(spans)} p50={_pctl(durs, 0.5):.2f}ms "
+            f"p95={_pctl(durs, 0.95):.2f}ms errors={errors}")
+
+    traces: Dict[str, set] = {}
+    for r in rows:
+        tid = r.get("trace_id")
+        if tid:
+            traces.setdefault(tid, set()).add(r.get("name"))
+    linked = sum(1 for names in traces.values()
+                 if "upload" in names and "apply" in names)
+    reconnect_spanning = sum(
+        1 for r in rows
+        if r.get("name") == "upload"
+        and float(r.get("reconnects_spanned", 0) or 0) > 0)
+    lines.append(f"  traces: {len(traces)} total, "
+                 f"{linked} with linked upload+apply spans, "
+                 f"{reconnect_spanning} uploads spanning a reconnect")
+    return lines
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distriflow_tpu.obs.dump",
+        description="Summarize a run directory's metrics.jsonl/spans.jsonl.")
+    parser.add_argument("run_dir", help="directory holding the JSONL files")
+    args = parser.parse_args(argv)
+
+    metrics_path = os.path.join(args.run_dir, METRICS_FILENAME)
+    spans_path = os.path.join(args.run_dir, SPANS_FILENAME)
+    found = False
+    for path, fn in ((metrics_path, summarize_metrics),
+                     (spans_path, summarize_spans)):
+        if os.path.exists(path):
+            found = True
+            print("\n".join(fn(path)))
+        else:
+            print(f"(no {os.path.basename(path)} in {args.run_dir})")
+    return 0 if found else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,301 @@
+"""Thread-safe metrics registry: counters, gauges, bounded histograms.
+
+The one telemetry spine every layer shares (transport -> server/client ->
+trainers). Design constraints, in order:
+
+- **cheap when disabled**: a disabled :class:`Telemetry` hands out shared
+  no-op singletons — no per-call allocation, no dict growth, nothing to
+  snapshot (tier-1 tested in ``tests/test_obs.py``);
+- **cheap when enabled**: handles are created once and cached by
+  ``(name, labels)`` key; the hot path (``inc``/``set``/``observe``) is a
+  lock-free attribute bump for counters/gauges and one lock + ring-buffer
+  append for histograms. Hot callers cache the handle at construction
+  (``self._hist = telemetry.histogram(...)``) so steady state does no
+  registry lookups at all;
+- **plain-dict snapshot**: :meth:`Telemetry.snapshot` returns
+  JSON-able values only, so it drops straight into
+  ``utils.metrics_log.MetricsLogger`` rows, the Prometheus text renderer
+  (:func:`render_prometheus`), and the doctor's reconciliation checks.
+
+Histograms are bounded (a fixed-size ring of recent observations) so a
+long-running server's memory does not grow with step count; quantiles
+(p50/p95/p99) are computed lazily at snapshot time over that window,
+while ``count``/``sum``/``min``/``max`` are exact over the full life of
+the handle.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+_DEFAULT_HISTOGRAM_WINDOW = 1024
+
+LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(name: str, labels: Dict[str, Any]) -> LabelKey:
+    return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is a GIL-atomic float add — no lock."""
+
+    __slots__ = ("name", "labels", "_value")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = labels
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value (model version, connected clients, ...)."""
+
+    __slots__ = ("name", "labels", "_value")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = labels
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self._value -= n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Bounded histogram: exact count/sum/min/max, windowed quantiles.
+
+    The ring holds the most recent ``window`` observations; p50/p95/p99
+    describe that window (recent behaviour — what an operator asks a
+    running server about), while the scalar aggregates cover everything
+    ever observed.
+    """
+
+    __slots__ = ("name", "labels", "window", "_ring", "_n", "_i",
+                 "count", "sum", "min", "max", "_lock")
+
+    def __init__(self, name: str, labels: Dict[str, str],
+                 window: int = _DEFAULT_HISTOGRAM_WINDOW):
+        self.name = name
+        self.labels = labels
+        self.window = int(window)
+        self._ring = [0.0] * self.window  # fixed-size: no growth per observe
+        self._n = 0  # filled slots (<= window)
+        self._i = 0  # next write index
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._ring[self._i] = v
+            self._i = (self._i + 1) % self.window
+            if self._n < self.window:
+                self._n += 1
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+
+    def percentiles(self, qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
+        """Nearest-rank quantiles over the retained window."""
+        with self._lock:
+            data = sorted(self._ring[: self._n])
+        if not data:
+            return {f"p{int(q * 100)}": 0.0 for q in qs}
+        out = {}
+        for q in qs:
+            idx = min(len(data) - 1, max(0, int(round(q * (len(data) - 1)))))
+            out[f"p{int(q * 100)}"] = data[idx]
+        return out
+
+    def summary(self) -> Dict[str, float]:
+        s: Dict[str, float] = {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.min is not None else 0.0,
+            "max": self.max if self.max is not None else 0.0,
+        }
+        s.update(self.percentiles())
+        return s
+
+
+class _NoopHandle:
+    """Shared do-nothing handle: every metric method is a pass.
+
+    ONE module-level instance serves every disabled counter/gauge/histogram
+    — handing it out allocates nothing and registers nothing, which is the
+    "zero-allocation-cheap when disabled" contract the obs-marker test
+    pins.
+    """
+
+    __slots__ = ()
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def dec(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+    @property
+    def value(self) -> float:
+        return 0.0
+
+    def summary(self) -> Dict[str, float]:
+        return {}
+
+
+NOOP_HANDLE = _NoopHandle()
+
+
+class MetricsRegistry:
+    """The handle factory + snapshot surface. Thread-safe."""
+
+    def __init__(self, enabled: bool = True,
+                 histogram_window: int = _DEFAULT_HISTOGRAM_WINDOW):
+        self.enabled = bool(enabled)
+        self.histogram_window = histogram_window
+        self._metrics: Dict[LabelKey, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, labels: Dict[str, Any], **kw):
+        if not self.enabled:
+            return NOOP_HANDLE
+        key = _key(name, labels)
+        m = self._metrics.get(key)  # fast path: no lock on hit
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = cls(name, dict(key[1]), **kw)
+                    self._metrics[key] = m
+        return m
+
+    def counter(self, name: str, **labels: Any) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: Any) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, window: Optional[int] = None,
+                  **labels: Any) -> Histogram:
+        return self._get(Histogram, name, labels,
+                         window=window or self.histogram_window)
+
+    # -- read side ---------------------------------------------------------
+
+    def counter_value(self, name: str, **labels: Any) -> float:
+        """Exact-key counter read; 0.0 when never incremented."""
+        m = self._metrics.get(_key(name, labels))
+        return m.value if m is not None else 0.0
+
+    def total(self, name: str) -> float:
+        """Sum of a counter/gauge across every label set (e.g. both
+        transport roles) — what the doctor reconciles against a shared
+        :class:`FaultPlan`'s injected-event counts."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return sum(m.value for (n, _), m in metrics
+                   if n == name and isinstance(m, (Counter, Gauge)))
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain JSON-able dict of everything registered.
+
+        Metric identity renders as ``name`` or ``name{k=v,...}`` — the
+        same spelling the Prometheus text form uses, so the two surfaces
+        never drift.
+        """
+        out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            metrics = list(self._metrics.items())
+        for (name, labels), m in sorted(metrics, key=lambda kv: kv[0]):
+            label_s = ",".join(f"{k}={v}" for k, v in labels)
+            ident = f"{name}{{{label_s}}}" if label_s else name
+            if isinstance(m, Counter):
+                out["counters"][ident] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][ident] = m.value
+            elif isinstance(m, Histogram):
+                out["histograms"][ident] = m.summary()
+        return out
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def _prom_labels(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{_prom_name(k)}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus(registry: "MetricsRegistry") -> str:
+    """Prometheus text exposition (0.0.4) of the registry's current state.
+
+    Counters render as ``counter``, gauges as ``gauge``, histograms as
+    summaries (windowed quantiles + exact ``_count``/``_sum``) — scrape
+    this from a debug endpoint or dump it at run end.
+    """
+    with registry._lock:
+        metrics = sorted(registry._metrics.items(), key=lambda kv: kv[0])
+    lines = []
+    typed = set()
+    for (name, labels), m in metrics:
+        pname = _prom_name(name)
+        if isinstance(m, Counter):
+            if pname not in typed:
+                lines.append(f"# TYPE {pname} counter")
+                typed.add(pname)
+            lines.append(f"{pname}{_prom_labels(labels)} {m.value:g}")
+        elif isinstance(m, Gauge):
+            if pname not in typed:
+                lines.append(f"# TYPE {pname} gauge")
+                typed.add(pname)
+            lines.append(f"{pname}{_prom_labels(labels)} {m.value:g}")
+        elif isinstance(m, Histogram):
+            if pname not in typed:
+                lines.append(f"# TYPE {pname} summary")
+                typed.add(pname)
+            s = m.summary()
+            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                qlabel = 'quantile="%s"' % q
+                lines.append(
+                    f"{pname}{_prom_labels(labels, qlabel)} {s[key]:g}")
+            lines.append(f"{pname}_count{_prom_labels(labels)} {s['count']:g}")
+            lines.append(f"{pname}_sum{_prom_labels(labels)} {s['sum']:g}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,121 @@
+"""The `Telemetry` facade: one object per process (or per test) that owns
+the metrics registry, the tracer, and the export paths.
+
+Components accept ``telemetry=None`` and fall back to the process-global
+instance (:func:`get_telemetry`), which starts enabled but export-less —
+counters and spans accumulate in memory and cost one attribute bump per
+event. Pass ``save_dir`` to also stream ``metrics.jsonl`` snapshots and
+``spans.jsonl`` rows to disk; pass ``enabled=False`` to get shared no-op
+handles everywhere (see ``registry.NOOP_HANDLE`` / ``tracing.NOOP_SPAN``).
+
+Loopback tests and the doctor hand ONE ``Telemetry`` to both the server
+and client configs, so cross-endpoint traces land in a single tracer and
+the snapshot can be reconciled against a shared ``FaultPlan``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+from distriflow_tpu.obs.registry import (
+    MetricsRegistry,
+    render_prometheus,
+)
+from distriflow_tpu.obs.tracing import Tracer
+
+METRICS_FILENAME = "metrics.jsonl"
+
+
+class Telemetry:
+    """Registry + tracer + snapshot surface, one handle per process."""
+
+    def __init__(self, enabled: bool = True, save_dir: Optional[str] = None,
+                 histogram_window: int = 1024):
+        self.enabled = bool(enabled)
+        self.save_dir = save_dir
+        self.registry = MetricsRegistry(enabled=self.enabled,
+                                        histogram_window=histogram_window)
+        self.tracer = Tracer(enabled=self.enabled, save_dir=save_dir)
+        self._metrics_logger = None
+
+    # -- handle factories (delegate to the registry) -----------------------
+
+    def counter(self, name: str, **labels: Any):
+        return self.registry.counter(name, **labels)
+
+    def gauge(self, name: str, **labels: Any):
+        return self.registry.gauge(name, **labels)
+
+    def histogram(self, name: str, **labels: Any):
+        return self.registry.histogram(name, **labels)
+
+    def span(self, name: str, trace_id: Optional[str] = None,
+             parent_id: Optional[str] = None, **attrs: Any):
+        return self.tracer.span(name, trace_id=trace_id,
+                                parent_id=parent_id, **attrs)
+
+    # -- read side ---------------------------------------------------------
+
+    def counter_value(self, name: str, **labels: Any) -> float:
+        return self.registry.counter_value(name, **labels)
+
+    def total(self, name: str) -> float:
+        return self.registry.total(name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain dict of every counter/gauge/histogram currently registered."""
+        return self.registry.snapshot()
+
+    def prometheus(self) -> str:
+        """Prometheus text-exposition rendering of the current state."""
+        return render_prometheus(self.registry)
+
+    def export_snapshot(self, **extra: Any) -> Optional[Dict[str, Any]]:
+        """Append one flattened snapshot row to ``<save_dir>/metrics.jsonl``.
+
+        The existing :class:`MetricsLogger` is the exporter here — the
+        registry owns the numbers, this just serializes them — so older
+        tooling reading ``metrics.jsonl`` keeps working unchanged.
+        Returns the row (or None when disabled / no ``save_dir``).
+        """
+        if not self.enabled or self.save_dir is None:
+            return None
+        if self._metrics_logger is None:
+            from distriflow_tpu.utils.metrics_log import MetricsLogger
+            self._metrics_logger = MetricsLogger(
+                os.path.join(self.save_dir, METRICS_FILENAME))
+        row: Dict[str, Any] = {"kind": "telemetry_snapshot",
+                               "snapshot_time": time.time()}
+        snap = self.snapshot()
+        for ident, v in snap["counters"].items():
+            row[f"counter:{ident}"] = v
+        for ident, v in snap["gauges"].items():
+            row[f"gauge:{ident}"] = v
+        for ident, s in snap["histograms"].items():
+            for stat, v in s.items():
+                row[f"hist:{ident}:{stat}"] = v
+        row.update(extra)
+        self._metrics_logger.log(**row)
+        return row
+
+
+_GLOBAL = Telemetry(enabled=True)
+
+
+def get_telemetry() -> Telemetry:
+    """The process-global telemetry (enabled, in-memory-only by default)."""
+    return _GLOBAL
+
+
+def set_telemetry(t: Telemetry) -> Telemetry:
+    """Replace the process-global telemetry; returns the previous one.
+
+    Components resolve the global lazily (at construction), so tests that
+    swap it should do so before building servers/clients/trainers.
+    """
+    global _GLOBAL
+    prev = _GLOBAL
+    _GLOBAL = t
+    return prev

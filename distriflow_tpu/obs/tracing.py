@@ -1,0 +1,164 @@
+"""Dapper-style wire tracing for the distributed loop.
+
+A **trace** follows one unit of work end-to-end: the server dispatches a
+batch (``dispatch`` span), the client trains and uploads (``upload``
+span), the server applies the gradients (``apply`` span). The
+``trace_id`` rides in the :class:`~distriflow_tpu.utils.messages.UploadMsg`
+/ ``DownloadMsg`` headers, so the linkage survives retries, duplicate
+deliveries, and mid-upload reconnects — the one thing per-endpoint logs
+can never show. A child span carries ``parent_id`` = the upstream span's
+``span_id``.
+
+Span row schema (JSONL, one object per line, written next to
+``metrics.jsonl``)::
+
+    {"name": "upload", "trace_id": "…32 hex…", "span_id": "…16 hex…",
+     "parent_id": "…16 hex…" | null, "start": <unix s>, "dur_ms": <float>,
+     "status": "ok" | "error:<Type>", ...free-form attributes}
+
+Retries do NOT open new traces: the client stamps ``trace_id`` once per
+update (alongside ``update_id``), so a duplicate delivery dedup'd by the
+server and the retry that finally lands share one trace — exactly the
+property ``tests/test_obs.py`` pins under chaos.
+
+The tracer keeps a bounded in-memory deque of finished spans (for tests
+and the doctor) and optionally appends each to ``spans.jsonl`` via the
+same torn-tail-safe writer ``MetricsLogger`` uses for metrics.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+SPANS_FILENAME = "spans.jsonl"
+
+_MAX_SPANS = 4096
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex  # 32 hex chars
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """Mutable in-flight span; finished by the ``Tracer.span`` context."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "attrs", "status")
+
+    def __init__(self, name: str, trace_id: Optional[str],
+                 parent_id: Optional[str], attrs: Dict[str, Any]):
+        self.name = name
+        self.trace_id = trace_id or new_trace_id()
+        self.span_id = new_span_id()
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.attrs = attrs
+        self.status = "ok"
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def to_row(self, dur_ms: float) -> Dict[str, Any]:
+        row = {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "dur_ms": dur_ms,
+            "status": self.status,
+        }
+        row.update(self.attrs)
+        return row
+
+
+class _NoopSpan:
+    """Shared span stand-in for a disabled tracer: attribute writes are
+    dropped, ids are empty strings so header stamping stays branch-free."""
+
+    __slots__ = ()
+
+    name = ""
+    trace_id = ""
+    span_id = ""
+    parent_id = None
+    status = "ok"
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Collects finished spans; bounded memory, optional JSONL export."""
+
+    def __init__(self, enabled: bool = True, save_dir: Optional[str] = None,
+                 max_spans: int = _MAX_SPANS):
+        self.enabled = bool(enabled)
+        self._spans: collections.deque = collections.deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+        self._logger = None
+        if self.enabled and save_dir is not None:
+            # Deferred import: obs must stay importable without utils and
+            # vice versa during partial installs.
+            from distriflow_tpu.utils.metrics_log import MetricsLogger
+            # spans carry their own "start" stamp — skip the logger's
+            self._logger = MetricsLogger(
+                os.path.join(save_dir, SPANS_FILENAME), stamp_time=False)
+
+    @contextlib.contextmanager
+    def span(self, name: str, trace_id: Optional[str] = None,
+             parent_id: Optional[str] = None,
+             **attrs: Any) -> Iterator[Any]:
+        """Open a span; records duration and error status on exit.
+
+        Exceptions propagate — the span is finished with
+        ``status="error:<ExcType>"`` first, so a failed upload attempt
+        still leaves its trace on disk.
+        """
+        if not self.enabled:
+            yield NOOP_SPAN
+            return
+        s = Span(name, trace_id, parent_id, attrs)
+        t0 = time.perf_counter()
+        try:
+            yield s
+        except BaseException as e:
+            s.status = f"error:{type(e).__name__}"
+            raise
+        finally:
+            self._finish(s, (time.perf_counter() - t0) * 1000.0)
+
+    def _finish(self, s: Span, dur_ms: float) -> None:
+        row = s.to_row(dur_ms)
+        with self._lock:
+            self._spans.append(row)
+        if self._logger is not None:
+            self._logger.log(**row)
+
+    def finished(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Finished-span rows (optionally filtered by span name)."""
+        with self._lock:
+            rows = list(self._spans)
+        if name is not None:
+            rows = [r for r in rows if r["name"] == name]
+        return rows
+
+    def traces(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Finished spans grouped by ``trace_id``, in finish order."""
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for row in self.finished():
+            out.setdefault(row["trace_id"], []).append(row)
+        return out

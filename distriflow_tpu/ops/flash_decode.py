@@ -43,12 +43,34 @@ XLA path materializes the whole dequantized cache to HBM every token,
 which made int8 *slower* than bf16 (measured); in-kernel folded dequant
 is what converts the 2x byte saving into a time saving.
 
+**bf16-compute contract for f32 caches**: the MXU contracts in bf16, so
+f32 K/V tiles are cast to bf16 at tile load (``.astype(jnp.bfloat16)``
+in the kernels) — scores, probabilities, and the accumulator stay f32,
+but the K/V *mantissas* see only bf16's 8 bits. An f32 cache therefore
+buys VMEM/HBM cost (2x bytes plus the cast copies in the VMEM model)
+without buying f32 contraction accuracy; the XLA fallback path is the
+only true f32-compute decode. Callers who store f32 caches for
+numerical reasons should either accept bf16-equivalent attention
+(matches the tolerance tests here, ~1e-2 relative) or disable the
+kernel (``use_flash_decode=False``). See docs/PERFORMANCE.md.
+
+**Tile floor**: :func:`pick_block_k` refuses tiles below
+``MIN_BLOCK_K`` when the cache is larger than one tile — an awkward
+length like 2056 (= 2^3 x 257) only has 8 as a sublane-aligned divisor,
+and a [8, HD] tile puts the kernel in its worst per-step-overhead
+regime (257 grid steps of sliver DMAs, far below the measured-streaming
+tiles the numbers above come from). :func:`supports_seq` returns False
+for such shapes (counted in the ``ops_flash_decode_gated_total``
+telemetry counter, warned once per shape) and the model layer takes the
+XLA decode path instead.
+
 Inference-only: no VJP (decode never backprops).
 """
 
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional
 
 import jax
@@ -64,7 +86,12 @@ BLOCK_K = 2048  # KV positions per tile: [2048, 512] bf16 K+V tiles are
 # 2 MB each, double-buffered 8 MB — inside the 16 MB scoped-VMEM limit
 # with room for the [BK, H] f32 score/prob tensors
 VMEM_LIMIT_BYTES = 16 * 1024 * 1024  # TPU scoped-vmem compile limit
+MIN_BLOCK_K = 128  # smallest multi-tile we'll run: below this the grid
+# degenerates into sliver DMAs (e.g. 2056 -> block_k 8, 257 steps) and
+# the per-step overhead regime beats the XLA path anyway
 NEG_INF = -1e30
+
+_warned_gated: set = set()  # (s, hd, kv_item) shapes already warned about
 
 
 def pick_block_k(s: int, hd: int = 512, kv_item: int = 2,
@@ -75,17 +102,36 @@ def pick_block_k(s: int, hd: int = 512, kv_item: int = 2,
     sublane-aligned (multiple of 8, or ``s`` itself — Mosaic accepts a
     block equal to the array dim), and (c) fits the scoped-VMEM model —
     wide-head or f32 configs shrink the tile instead of dying in the
-    Mosaic compiler. None when no candidate qualifies: callers fall
-    back to the XLA decode path rather than crash at trace time."""
+    Mosaic compiler. Multi-tile candidates stop at ``MIN_BLOCK_K``:
+    a sliver tile (2056 -> 8) lands in the kernel's worst per-step
+    overhead regime, so those shapes are gated off rather than run
+    slow. None when no candidate qualifies: callers fall back to the
+    XLA decode path rather than crash at trace time."""
     def fits(bk):
         return _vmem_estimate_bytes(bk, hd, kv_item) <= VMEM_LIMIT_BYTES
 
     if s <= limit and fits(s):
-        return s
-    for bk in range(min((min(limit, s) // 8) * 8, s), 0, -8):
+        return s  # whole-sequence tile: no grid, the floor doesn't apply
+    for bk in range(min((min(limit, s) // 8) * 8, s), MIN_BLOCK_K - 1, -8):
         if s % bk == 0 and fits(bk):
             return bk
     return None
+
+
+def _note_gated(s: int, hd: int, kv_item: int) -> None:
+    from distriflow_tpu.obs import get_telemetry
+
+    get_telemetry().counter("ops_flash_decode_gated_total").inc()
+    key = (s, hd, kv_item)
+    if key not in _warned_gated:
+        _warned_gated.add(key)
+        warnings.warn(
+            f"flash_decode gated off for cache length {s} (packed width "
+            f"{hd}, itemsize {kv_item}): no sublane-aligned divisor tile "
+            f">= {MIN_BLOCK_K} fits scoped VMEM — decoding on the XLA "
+            "fallback path. Pad max_seq to a multiple of a power of two "
+            "(e.g. 2048 instead of 2056) to re-enable the kernel.",
+            stacklevel=3)
 
 
 def supports_seq(s: int, hd: int = 512, kv_item: int = 2) -> bool:
@@ -93,8 +139,12 @@ def supports_seq(s: int, hd: int = 512, kv_item: int = 2) -> bool:
     at packed width ``hd`` and itemsize ``kv_item`` — the gate
     ``models/transformer.py`` uses before auto-enabling the kernel (an
     unsupported shape falls back to XLA decode instead of raising
-    mid-trace)."""
-    return pick_block_k(s, hd, kv_item) is not None
+    mid-trace). A gated shape bumps ``ops_flash_decode_gated_total`` and
+    warns once per (s, hd, kv_item)."""
+    if pick_block_k(s, hd, kv_item) is not None:
+        return True
+    _note_gated(s, hd, kv_item)
+    return False
 
 
 def _vmem_estimate_bytes(block_k: int, hd: int, kv_item: int) -> int:

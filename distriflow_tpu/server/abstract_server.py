@@ -38,6 +38,7 @@ from distriflow_tpu.utils.config import (
     client_hyperparams,
     server_hyperparams,
 )
+from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 from distriflow_tpu.utils.messages import DownloadMsg, Events, ModelMsg, UploadMsg
 from distriflow_tpu.utils.serialization import SerializedArray, serialize_tree
@@ -69,6 +70,10 @@ class DistributedServerConfig:
     # fault injection (tests / chaos drills): consulted by the server's
     # per-client endpoints at every frame boundary
     fault_plan: Optional[FaultPlan] = None
+    # telemetry spine (see distriflow_tpu.obs): None uses the process-global
+    # instance; tests/doctor pass one shared Telemetry to both endpoints so
+    # cross-endpoint traces land in a single tracer
+    telemetry: Optional[Telemetry] = None
 
 
 class AbstractServer:
@@ -99,13 +104,24 @@ class AbstractServer:
         self.hyperparams: ServerHyperparams = self._hyperparams_factory(
             self.config.server_hyperparams
         )
+        self.telemetry = (
+            self.config.telemetry
+            if self.config.telemetry is not None
+            else get_telemetry()
+        )
         self.transport = transport or ServerTransport(
             self.config.host,
             self.config.port,
             heartbeat_interval=self.config.heartbeat_interval_s,
             heartbeat_timeout=self.config.heartbeat_timeout_s,
             fault_plan=self.config.fault_plan,
+            telemetry=self.telemetry,
         )
+        # cached handles: per-event cost is one attribute bump
+        self._g_clients = self.telemetry.gauge("server_connected_clients")
+        self._g_version = self.telemetry.gauge("server_model_version")
+        self._c_uploads = self.telemetry.counter("server_uploads_total")
+        self._c_dedup = self.telemetry.counter("server_dedup_hits_total")
         self.logger = VerboseLogger(type(self).__name__, self.config.verbose)
         self.callbacks = CallbackRegistry("new_version", "upload", "connect", "disconnect")
 
@@ -186,12 +202,14 @@ class AbstractServer:
 
     def _on_connect(self, client_id: str) -> None:
         self.num_clients += 1
+        self._g_clients.set(self.num_clients)
         self.log(f"connection: {self.num_clients} clients")
         self.callbacks.fire("connect", client_id)
         self.handle_connection(client_id)
 
     def _on_disconnect(self, client_id: str) -> None:
         self.num_clients -= 1
+        self._g_clients.set(self.num_clients)
         self.log(f"disconnection: {self.num_clients} clients")
         self.callbacks.fire("disconnect", client_id)
         self.handle_disconnection(client_id)
@@ -207,19 +225,34 @@ class AbstractServer:
         the owner finishes, so concurrent deliveries also apply exactly once.
         """
         msg = UploadMsg.from_wire(payload)
+        self._c_uploads.inc()
         if msg.metrics is not None:
             self.log(f"client {msg.client_id} metrics: {msg.metrics}")
         uid = msg.update_id
         if uid is None:  # legacy client: no dedup possible
-            self.callbacks.fire("upload", msg)
-            return self.handle_upload(client_id, msg)
+            with self.telemetry.span(
+                "apply", trace_id=msg.trace_id, parent_id=msg.span_id,
+                client_id=msg.client_id,
+            ):
+                self.callbacks.fire("upload", msg)
+                return self.handle_upload(client_id, msg)
         while True:
             with self._dedup_lock:
                 if uid in self._applied_ids:
                     self._applied_ids.move_to_end(uid)
                     self.duplicate_uploads += 1
+                    self._c_dedup.inc()
                     self.log(f"duplicate upload {uid[:8]} acked without re-apply")
-                    return self._applied_ids[uid]
+                    result = self._applied_ids[uid]
+                    # the duplicate still leaves a span in the update's trace
+                    # (trace_id rides on the retried message), so one trace
+                    # shows every delivery of the update — applied or not
+                    with self.telemetry.span(
+                        "apply", trace_id=msg.trace_id, parent_id=msg.span_id,
+                        client_id=msg.client_id, update_id=uid, dedup=True,
+                    ):
+                        pass
+                    return result
                 gate = self._dedup_inflight.get(uid)
                 if gate is None:
                     gate = threading.Event()
@@ -229,8 +262,13 @@ class AbstractServer:
             # the cache (if the owner failed, the loop makes us the new owner)
             gate.wait(timeout=60.0)
         try:
-            self.callbacks.fire("upload", msg)
-            result = self.handle_upload(client_id, msg)
+            with self.telemetry.span(
+                "apply", trace_id=msg.trace_id, parent_id=msg.span_id,
+                client_id=msg.client_id, update_id=uid, dedup=False,
+            ) as span:
+                self.callbacks.fire("upload", msg)
+                result = self.handle_upload(client_id, msg)
+                span.set(accepted=bool(result))
             with self._dedup_lock:
                 self._applied_ids[uid] = result
                 while len(self._applied_ids) > self.config.dedup_cache_size:

@@ -55,6 +55,9 @@ class AsynchronousSGDServer(AbstractServer):
         super().__init__(model, config, transport)
         self.dataset = dataset
         self.version_counter = 0  # integer staleness clock
+        self._h_staleness = self.telemetry.histogram("server_gradient_staleness")
+        self._c_applied = self.telemetry.counter("server_updates_applied_total")
+        self._c_rejected = self.telemetry.counter("server_updates_rejected_total")
         self._client_versions: Dict[str, int] = {}
         self._client_batches: Dict[str, int] = {}  # outstanding batch per client
         self._waiting: set = set()  # starved clients awaiting redispatch
@@ -106,12 +109,22 @@ class AsynchronousSGDServer(AbstractServer):
             self._client_batches[client_id] = batch.batch
             self._client_versions[client_id] = self.version_counter
             self._waiting.discard(client_id)
-        msg = DownloadMsg(
-            model=self.download_msg.model,
-            hyperparams=self.download_msg.hyperparams,
-            data=batch_to_data_msg(batch),
-        )
-        self.transport.emit_to(client_id, Events.Download.value, msg.to_wire())
+        # the dispatch opens the update's trace: its trace_id rides the
+        # download header, the client copies it into the resulting upload,
+        # and the server's apply span closes the loop — one trace covers
+        # dispatch -> train -> upload -> apply, across retries/reconnects
+        with self.telemetry.span(
+            "dispatch", client_id=client_id, batch=batch.batch,
+            version=self.version_counter,
+        ) as span:
+            msg = DownloadMsg(
+                model=self.download_msg.model,
+                hyperparams=self.download_msg.hyperparams,
+                data=batch_to_data_msg(batch),
+                trace_id=span.trace_id or None,
+                span_id=span.span_id or None,
+            )
+            self.transport.emit_to(client_id, Events.Download.value, msg.to_wire())
         return True
 
     def _dispatch_waiting(self) -> None:
@@ -175,8 +188,10 @@ class AsynchronousSGDServer(AbstractServer):
             if sent_version is None:
                 sent_version = self._client_versions.get(client_id, self.version_counter)
             staleness = self.version_counter - sent_version
+            self._h_staleness.observe(staleness)
             if staleness > self.hyperparams.maximum_staleness:
                 self.rejected_updates += 1
+                self._c_rejected.inc()
                 self.log(
                     f"rejected update from {msg.client_id}: staleness {staleness} > "
                     f"{self.hyperparams.maximum_staleness}"
@@ -200,6 +215,8 @@ class AsynchronousSGDServer(AbstractServer):
                 self.model.save()  # reference saves every step (:105)
                 self.version_counter += 1
                 self.applied_updates += 1
+                self._c_applied.inc()
+                self._g_version.set(self.version_counter)
                 self.download_msg = self.compute_download_msg()
                 self._note_version_token()
         self.callbacks.fire("new_version", self.model.version)

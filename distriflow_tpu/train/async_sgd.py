@@ -36,6 +36,7 @@ import optax
 
 from distriflow_tpu.data.dataset import DistributedDataset
 from distriflow_tpu.models.base import ModelSpec, _optimizer, init_params
+from distriflow_tpu.obs.telemetry import get_telemetry
 from distriflow_tpu.utils.config import ServerHyperparams, async_server_hyperparams
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 
@@ -88,6 +89,10 @@ class AsyncSGDTrainer:
         self.applied_updates = 0
         self.rejected_updates = 0
         self._lock = threading.Lock()
+        _t = get_telemetry()
+        self._h_staleness = _t.histogram("train_gradient_staleness", mode="async")
+        self._c_applied = _t.counter("train_updates_applied_total", mode="async")
+        self._c_rejected = _t.counter("train_updates_rejected_total", mode="async")
 
         # SSP-style admission control (round-4, verdict #3): bounded
         # staleness by CONSTRUCTION instead of by discard. Two pieces:
@@ -376,8 +381,10 @@ class AsyncSGDTrainer:
             staleness = self.version - grad_version
             if staleness < 0:
                 raise ValueError(f"gradient from the future: v{grad_version} > v{self.version}")
+            self._h_staleness.observe(staleness)
             if staleness > self.hyperparams.maximum_staleness:
                 self.rejected_updates += 1
+                self._c_rejected.inc()
                 self.logger.log(
                     f"rejected update from {client_id}: staleness {staleness} > "
                     f"{self.hyperparams.maximum_staleness}"
@@ -392,6 +399,7 @@ class AsyncSGDTrainer:
             )
             self.version += 1
             self.applied_updates += 1
+            self._c_applied.inc()
             snap = None
             if (self.store is not None and self.save_every
                     and self.version % self.save_every == 0):

@@ -35,7 +35,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distriflow_tpu.models.base import ModelSpec, _optimizer, init_params
 from distriflow_tpu.parallel.collectives import pvary
 from distriflow_tpu.parallel.mesh import data_parallel_mesh
+from distriflow_tpu.obs.telemetry import get_telemetry
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
+from distriflow_tpu.utils.profiling import device_timer
 
 Params = Any
 
@@ -74,6 +76,7 @@ class FederatedAveragingTrainer:
         self.round_index = 0
         self.num_workers = self.mesh.shape["data"]
         self._round_fn = self._build_round()
+        self._h_round = get_telemetry().histogram("train_step_ms", mode="federated")
 
     def init(self, rng: Optional[jax.Array] = None) -> Params:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -134,14 +137,17 @@ class FederatedAveragingTrainer:
             )
         x = jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P("data")))
         y = jax.device_put(jnp.asarray(y), NamedSharding(self.mesh, P("data")))
-        self.params, loss = self._round_fn(self.params, x, y)
+        with device_timer() as timing:
+            self.params, loss = self._round_fn(self.params, x, y)
+            loss = float(loss)  # blocks: the round (and its allreduce) finished
+        self._h_round.observe(timing["ms"])
         self.round_index += 1
         if (self.store is not None and self.save_every
                 and self.round_index % self.save_every == 0):
             self.save()
         self.callbacks.fire("round", self.round_index)
         self.callbacks.fire("new_version", str(self.round_index))
-        return float(loss)
+        return loss
 
     def pack_round_data(self, x, y, rng=None):
         """Convenience: sample a round's [W, K, B, ...] layout from arrays."""

@@ -43,7 +43,9 @@ from distriflow_tpu.parallel.sharding import (
     opt_state_shardings,
     tree_shardings,
 )
+from distriflow_tpu.obs.telemetry import get_telemetry
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
+from distriflow_tpu.utils.profiling import device_timer
 
 Params = Any
 Batch = Tuple[jnp.ndarray, jnp.ndarray]
@@ -151,6 +153,7 @@ class SyncTrainer:
         # observability (reference time()/log wrappers, abstract_server.ts:92-103)
         self.last_step_ms: Optional[float] = None
         self._step_times: List[float] = []  # rolling window
+        self._h_step = get_telemetry().histogram("train_step_ms", mode="sync")
         self._cost_cache: Dict[Any, Dict[str, float]] = {}  # per batch signature
         # checkpointing (reference saves on every update, server/models.ts:132-138;
         # here save_every is explicit and the write happens off-thread)
@@ -309,10 +312,11 @@ class SyncTrainer:
         if self.state is None:
             self.init()
         batch = self._ensure_placed(batch)
-        start = time.perf_counter()
-        self.state, loss = self._step_fn(self.state, batch)
-        loss = float(loss)  # blocks: the step really finished
-        self.last_step_ms = (time.perf_counter() - start) * 1e3
+        with device_timer() as timing:
+            self.state, loss = self._step_fn(self.state, batch)
+            loss = float(loss)  # blocks: the step really finished
+        self.last_step_ms = timing["ms"]
+        self._h_step.observe(self.last_step_ms)
         self._step_times.append(self.last_step_ms)
         if len(self._step_times) > 100:
             del self._step_times[:-100]

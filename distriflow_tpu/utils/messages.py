@@ -87,6 +87,14 @@ class UploadMsg:
     have been applied, so the client resends the same message — same
     ``update_id`` — and the gradient lands exactly once either way.
     ``AbstractClient.upload`` stamps one automatically when unset.
+
+    ``trace_id``/``span_id`` are the wire-tracing header (see
+    ``distriflow_tpu.obs.tracing``): ``trace_id`` identifies the update's
+    end-to-end trace and — like ``update_id`` — is stamped once and reused
+    by every retry/duplicate of the same update, so the server-side apply
+    span joins the client-side upload span even across reconnects.
+    ``span_id`` is the sending span's id; the receiver records it as its
+    span's ``parent_id``.
     """
 
     client_id: str
@@ -94,6 +102,8 @@ class UploadMsg:
     batch: Optional[int] = None
     metrics: Optional[List[float]] = None
     update_id: Optional[str] = None
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
 
     def to_wire(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"client_id": self.client_id}
@@ -105,6 +115,10 @@ class UploadMsg:
             d["metrics"] = list(self.metrics)
         if self.update_id is not None:
             d["update_id"] = self.update_id
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.span_id is not None:
+            d["span_id"] = self.span_id
         return d
 
     @staticmethod
@@ -115,6 +129,8 @@ class UploadMsg:
             batch=d.get("batch"),
             metrics=d.get("metrics"),
             update_id=d.get("update_id"),
+            trace_id=d.get("trace_id"),
+            span_id=d.get("span_id"),
         )
 
 
@@ -125,16 +141,27 @@ class DownloadMsg:
     ``hyperparams`` carries server-pushed client hyperparameters (the server
     can centrally set them for every client, reference
     ``src/server/abstract_server.ts:87``).
+
+    ``trace_id``/``span_id``: wire-tracing header, mirroring ``UploadMsg``.
+    A dispatch carrying a batch starts the trace; the client copies the
+    ``trace_id`` into the resulting upload so dispatch → train → upload →
+    apply is one trace.
     """
 
     model: ModelMsg
     hyperparams: Dict[str, Any] = field(default_factory=dict)
     data: Optional[DataMsg] = None
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
 
     def to_wire(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"model": self.model.to_wire(), "hyperparams": dict(self.hyperparams)}
         if self.data is not None:
             d["data"] = self.data.to_wire()
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.span_id is not None:
+            d["span_id"] = self.span_id
         return d
 
     @staticmethod
@@ -143,4 +170,6 @@ class DownloadMsg:
             model=ModelMsg.from_wire(d["model"]),
             hyperparams=d.get("hyperparams", {}),
             data=DataMsg.from_wire(d["data"]) if d.get("data") is not None else None,
+            trace_id=d.get("trace_id"),
+            span_id=d.get("span_id"),
         )

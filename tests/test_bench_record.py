@@ -68,6 +68,31 @@ def test_fit_line_truncates_error_rows():
     assert parsed["matrix"][-1]["error"].endswith("x")
 
 
+def test_fit_line_never_raises_on_pathological_rows():
+    """A result no amount of field-dropping can fit must still yield a
+    parseable, under-limit record — whole rows are dropped from the end
+    (flagged ``truncated``), never the entire record (the pre-fix assert
+    crashed the bench and lost every number of the run)."""
+    rows = [_row(f"c{i}", note="y" * 300) for i in range(40)]  # undroppable fat
+    result = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": None,
+              "device": "d", "n_chips": 1, "matrix": rows}
+    line = _fit_line(result)
+    assert len(line) <= RECORD_LIMIT
+    parsed = json.loads(line)
+    assert parsed["truncated"] is True
+    assert parsed["value"] == 1.0  # headline survives
+    assert 0 < len(parsed["matrix"]) < 40  # tail rows paid the price
+    assert parsed["matrix"][0]["config"] == "c0"  # head rows intact
+
+
+def test_fit_line_core_record_when_even_rows_cannot_save_it():
+    # headline fields themselves are oversized: fall to the core record
+    result = {"metric": "m" * 3000, "value": 1.0, "unit": "u",
+              "vs_baseline": None, "device": "d", "n_chips": 1, "matrix": []}
+    line = _fit_line(result, limit=200)
+    assert len(line) <= 200  # hard guarantee, even if the tail is sliced
+
+
 @pytest.mark.slow
 def test_fast_bench_line_parses_and_fits():
     """Run the REAL bench (BENCH_FAST=1, CPU) end to end: stdout must be

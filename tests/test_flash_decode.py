@@ -8,6 +8,7 @@ wide-head config passed the old gate and then raised mid-trace).
 """
 
 import dataclasses
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -16,8 +17,10 @@ import pytest
 
 from distriflow_tpu.ops.flash_decode import (
     BLOCK_K,
+    MIN_BLOCK_K,
     VMEM_LIMIT_BYTES,
     _vmem_estimate_bytes,
+    _warned_gated,
     flash_decode,
     pick_block_k,
     supports_seq,
@@ -110,6 +113,33 @@ def test_pick_block_k_divisor_and_vmem_rules():
     assert _vmem_estimate_bytes(bk, 2048, 4) > VMEM_LIMIT_BYTES
     assert supports_seq(2048, hd=2048)
     assert not supports_seq(4100)
+
+
+def test_min_tile_floor_gates_sliver_shapes():
+    """2056 = 2^3 x 257: the only sublane-aligned divisor above one tile
+    is 8 — 257 grid steps of sliver DMAs, the kernel's worst per-step
+    overhead regime. The floor gates it to the XLA fallback, counted in
+    telemetry and warned once per shape."""
+    from distriflow_tpu.obs import Telemetry, set_telemetry
+
+    assert MIN_BLOCK_K >= 8 and MIN_BLOCK_K % 8 == 0
+    assert pick_block_k(2056) is None
+    # one-tile caches are exempt: the floor only guards the grid regime
+    assert pick_block_k(136) == 136
+    tel = Telemetry()
+    prev = set_telemetry(tel)
+    _warned_gated.discard((2056, 512, 2))  # test-order independence
+    try:
+        with pytest.warns(UserWarning, match="gated off"):
+            assert not supports_seq(2056)
+        assert tel.counter_value("ops_flash_decode_gated_total") == 1
+        # second gate counts again but does NOT re-warn
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert not supports_seq(2056)
+        assert tel.counter_value("ops_flash_decode_gated_total") == 2
+    finally:
+        set_telemetry(prev)
 
 
 def test_explicit_oversized_block_k_raises_python_error():

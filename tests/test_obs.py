@@ -1,0 +1,287 @@
+"""Unified telemetry: registry semantics, span export, and wire-level
+trace propagation under chaos.
+
+The design contract pinned here (see docs/OBSERVABILITY.md):
+
+- disabled telemetry is ZERO-COST — every factory returns the one shared
+  no-op handle, nothing is allocated per call site, the snapshot stays
+  empty;
+- enabled handles are cached by (name, labels) so hot paths pay one dict
+  hit at construction and one attribute bump per event;
+- trace ids ride the wire (UploadMsg/DownloadMsg headers) and survive
+  retries, reconnects, and dedup — every applied update's server span
+  links back to the client upload span that produced it.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.client.abstract_client import DistributedClientConfig
+from distriflow_tpu.client.async_client import AsynchronousSGDClient
+from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.obs import (
+    NOOP_HANDLE,
+    NOOP_SPAN,
+    Telemetry,
+    render_prometheus,
+)
+from distriflow_tpu.obs.tracing import SPANS_FILENAME
+from distriflow_tpu.server.abstract_server import DistributedServerConfig
+from distriflow_tpu.server.async_server import AsynchronousSGDServer
+from distriflow_tpu.server.models import DistributedServerInMemoryModel
+from distriflow_tpu.utils.config import RetryPolicy
+from tests.mock_model import MockModel
+
+pytestmark = pytest.mark.obs
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    t = Telemetry()
+    c = t.counter("reqs_total", role="client")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    assert t.counter_value("reqs_total", role="client") == 3
+    assert t.counter_value("reqs_total", role="server") == 0  # unregistered
+    t.counter("reqs_total", role="server").inc(5)
+    assert t.total("reqs_total") == 8  # sums across label sets
+
+    g = t.gauge("clients")
+    g.set(4)
+    g.dec()
+    assert g.value == 3
+
+    h = t.histogram("lat_ms")
+    for v in range(1, 101):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 100 and s["min"] == 1 and s["max"] == 100
+    # nearest-rank over the 0-based sorted window: data[round(q*(n-1))]
+    assert s["p50"] == 51 and s["p95"] == 95 and s["p99"] == 99
+
+
+def test_histogram_window_bounds_memory():
+    t = Telemetry(histogram_window=8)
+    h = t.histogram("w")
+    for v in range(100):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 100  # exact count/sum survive the window
+    assert s["p50"] >= 92  # percentiles come from the last 8 samples
+
+
+def test_snapshot_and_prometheus_render():
+    t = Telemetry()
+    t.counter("frames_total", role="client").inc(7)
+    t.gauge("version").set(3)
+    t.histogram("ms").observe(1.5)
+    snap = t.snapshot()
+    assert snap["counters"]['frames_total{role=client}'] == 7
+    assert snap["gauges"]["version"] == 3
+    assert snap["histograms"]["ms"]["count"] == 1
+    text = t.prometheus()
+    assert 'frames_total{role="client"} 7' in text
+    assert "# TYPE frames_total counter" in text
+    assert 'ms{quantile="0.5"}' in text
+    assert render_prometheus(t.registry) == text
+
+
+def test_disabled_telemetry_is_shared_noop():
+    """The tier-1 cheapness contract: disabled telemetry allocates NOTHING
+    per call site — every factory returns the module singletons, the
+    registry stays empty, spans are the shared no-op."""
+    t = Telemetry(enabled=False)
+    assert t.counter("a") is NOOP_HANDLE
+    assert t.counter("b", role="x") is NOOP_HANDLE
+    assert t.gauge("c") is NOOP_HANDLE
+    assert t.histogram("d") is NOOP_HANDLE
+    NOOP_HANDLE.inc()
+    NOOP_HANDLE.set(3)
+    NOOP_HANDLE.observe(1.0)  # all no-ops, no state
+    assert t.registry._metrics == {}  # nothing registered
+    assert t.snapshot() == {"counters": {}, "gauges": {}, "histograms": {}}
+    with t.span("upload", client_id="c1") as span:
+        span.set(attempts=1)
+    assert span is NOOP_SPAN and span.trace_id == ""
+    assert t.tracer.finished() == []
+    assert t.export_snapshot() is None
+
+
+def test_enabled_handles_are_cached_identities():
+    t = Telemetry()
+    assert t.counter("x") is t.counter("x")
+    assert t.counter("x", role="a") is t.counter("x", role="a")
+    assert t.counter("x", role="a") is not t.counter("x", role="b")
+    assert t.histogram("h") is t.histogram("h")
+
+
+# -- tracing ----------------------------------------------------------------
+
+
+def test_span_linkage_and_error_status():
+    t = Telemetry()
+    with t.span("upload", client_id="c1") as up:
+        pass
+    with t.span("apply", trace_id=up.trace_id, parent_id=up.span_id) as ap:
+        ap.set(accepted=True)
+    rows = t.tracer.finished()
+    assert [r["name"] for r in rows] == ["upload", "apply"]
+    assert rows[1]["trace_id"] == rows[0]["trace_id"]
+    assert rows[1]["parent_id"] == rows[0]["span_id"]
+    assert t.tracer.traces()[up.trace_id] == rows
+    with pytest.raises(RuntimeError):
+        with t.span("boom"):
+            raise RuntimeError("x")
+    assert t.tracer.finished("boom")[0]["status"] == "error:RuntimeError"
+
+
+def test_spans_export_jsonl(tmp_path):
+    t = Telemetry(save_dir=str(tmp_path))
+    with t.span("upload"):
+        pass
+    path = tmp_path / SPANS_FILENAME
+    rows = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert rows and rows[0]["name"] == "upload"
+    assert rows[0]["trace_id"] and rows[0]["span_id"]
+    t.counter("n").inc()
+    row = t.export_snapshot(step=3)
+    assert row["counter:n"] == 1 and row["step"] == 3
+    metrics = (tmp_path / "metrics.jsonl").read_text()
+    assert "telemetry_snapshot" in metrics
+
+
+def test_dump_cli_renders_and_exits_zero(tmp_path, capsys):
+    from distriflow_tpu.obs import dump
+
+    t = Telemetry(save_dir=str(tmp_path))
+    t.counter("transport_frames_sent_total", role="client").inc(4)
+    with t.span("upload", client_id="c1") as up:
+        up.set(reconnects_spanned=1)
+    with t.span("apply", trace_id=up.trace_id, parent_id=up.span_id):
+        pass
+    t.export_snapshot()
+    assert dump.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "transport_frames_sent_total" in out
+    assert "upload" in out
+    assert dump.main([str(tmp_path / "empty")]) == 2
+
+
+# -- trace propagation under chaos (the satellite acceptance test) ----------
+
+
+@pytest.mark.chaos
+def test_trace_propagation_under_chaos(tmp_path):
+    """Loopback async-SGD under drops + a scripted mid-upload reset + a
+    dropped ack (forcing a deduped retry), with ONE Telemetry shared by
+    both endpoints. Every applied update's server apply span must link to
+    a client upload span with the same trace_id; the dedup'd duplicate
+    must share its original's trace; at least one upload trace spans the
+    reconnect."""
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+    dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    tel = Telemetry()
+    server_plan = FaultPlan(
+        seed=5, duplicate=0.1,
+        # drop the first ack: the client MUST retry that update and the
+        # server MUST dedup it — the shared-trace-through-dedup case
+        schedule=[ScriptedFault(event="__ack__", nth=1, action="drop")],
+    )
+    client_plan = FaultPlan(
+        seed=3, drop=0.1, duplicate=0.1,
+        schedule=[ScriptedFault(event="uploadVars", nth=2, action="reset")],
+    )
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        dataset,
+        DistributedServerConfig(
+            save_dir=str(tmp_path / "m"),
+            heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=2.0,
+            fault_plan=server_plan,
+            telemetry=tel,
+        ),
+    )
+    server.setup()
+    applied = []
+    server.on_upload(lambda m: applied.append(m.update_id))
+    client = AsynchronousSGDClient(
+        server.address,
+        MockModel(),
+        DistributedClientConfig(
+            heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=2.0,
+            upload_timeout_s=0.5,
+            upload_retry=RetryPolicy(max_retries=8, initial_backoff_s=0.05,
+                                     max_backoff_s=0.5, seed=3),
+            fault_plan=client_plan,
+            telemetry=tel,
+        ),
+    )
+    try:
+        client.setup(timeout=10.0)
+        done = client.train_until_complete(timeout=120.0)
+        # the ack-dropped upload retries in background; wait for its dedup
+        # AND for every apply's parent upload span to finish (client spans
+        # close on the retry's ack, a beat after the server-side counters)
+        def _quiesced():
+            if server.duplicate_uploads < 1:
+                return False
+            span_ids = {s["span_id"] for s in tel.tracer.finished("upload")}
+            done = [s for s in tel.tracer.finished("apply")
+                    if not s.get("dedup")]
+            return len(done) >= 4 and all(
+                a["parent_id"] in span_ids for a in done)
+
+        deadline = time.monotonic() + 30.0
+        while not _quiesced() and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        client.dispose()
+        server.stop()
+    assert done == 4 and server.applied_updates == 4
+    assert len(applied) == len(set(applied)) == 4
+    assert server.duplicate_uploads >= 1, "dropped ack's retry never deduped"
+    assert client.reconnects >= 1, "scripted reset never forced a reconnect"
+
+    uploads = tel.tracer.finished("upload")
+    by_span_id = {s["span_id"]: s for s in uploads}
+    upload_tids = {s["trace_id"] for s in uploads}
+    applies = [s for s in tel.tracer.finished("apply") if not s.get("dedup")]
+    assert len(applies) == 4, "one apply span per applied update"
+    for a in applies:
+        parent = by_span_id.get(a["parent_id"])
+        assert parent is not None, f"apply {a} has no upload parent span"
+        assert a["trace_id"] == parent["trace_id"]
+    # the deduped duplicate shares the ORIGINAL upload's trace (retries
+    # resend the same wire bytes, trace header included)
+    dedups = [s for s in tel.tracer.finished("apply") if s.get("dedup")]
+    assert dedups, "the deduped retry must still emit a (dedup) apply span"
+    apply_tids = {a["trace_id"] for a in applies}
+    for d in dedups:
+        assert d["trace_id"] in apply_tids, "dedup span lost its trace"
+    # the scripted reset tore the connection mid-upload: that upload's
+    # span must record that it survived a reconnect
+    spanning = [s for s in uploads if s.get("reconnects_spanned", 0) > 0]
+    assert spanning, "no upload span recorded reconnects_spanned > 0"
+    assert upload_tids >= apply_tids
+    # and the transport counters reconcile with the fault plans exactly
+    for role, plan in (("client", client_plan), ("server", server_plan)):
+        assert tel.counter_value(
+            "transport_frames_dropped_total", role=role
+        ) == plan.injected.get("drop", 0)
+        assert tel.counter_value(
+            "transport_resets_total", role=role
+        ) == plan.injected.get("reset", 0)
+        assert tel.counter_value(
+            "transport_frames_offered_total", role=role
+        ) == sum(plan.seen().values())
