@@ -634,6 +634,19 @@ def bench_mobilenet(n_chips):
 # -- serving: InferenceServer micro-batching speedup -----------------------
 
 
+def _serving_client(address, timeout=600.0):
+    """Co-located bench client: both heartbeat watchdogs are useless here
+    (server tracing/compiling holds the GIL, starving echoes in BOTH
+    directions past the 10 s timeouts) and the first mixed-length round
+    can pay several cold compiles back to back, so the watchdogs and the
+    120 s decode timeout only add flakiness to the measurement."""
+    from distriflow_tpu.client import InferenceClient
+
+    c = InferenceClient(address, timeout=timeout)
+    c.transport.heartbeat_timeout = 0
+    return c.setup()
+
+
 def bench_serving():
     """8 concurrent greedy clients vs the same 8 requests serialized —
     the micro-batcher folds the concurrent ones into ~1 device program.
@@ -658,11 +671,15 @@ def bench_serving():
         vocab_size=32000, d_model=512, n_heads=8, n_layers=8, d_ff=2048,
         max_seq=1024, dtype=jnp.bfloat16)
     params = transformer_lm(cfg, example_seq=128).init(jax.random.PRNGKey(0))
-    server = InferenceServer(cfg, params, port=0).setup()
+    server = InferenceServer(cfg, params, port=0)
+    # co-located client: its heartbeats starve under the GIL while the
+    # server traces/compiles, so the 10 s reaper would evict it mid-compile
+    server.transport.heartbeat_timeout = 0
+    server.setup()
     try:
         prompts = [rng.randint(0, 32000, (1, 64)).astype(np.int32)
                    for _ in range(8)]
-        with InferenceClient(server.address).setup() as c:
+        with _serving_client(server.address) as c:
             c.generate(prompts[0], n_tokens=32)  # compile/warm bucket-1 shape
         # warm the full bucket-8 shape (the throwaway concurrent round
         # below compiles any other bucket pattern that forms); a cold
@@ -672,14 +689,14 @@ def bench_serving():
         _fetch(_gen(cfg, params, jnp.asarray(stackp), 32))
 
         start = time.perf_counter()
-        with InferenceClient(server.address).setup() as c:
+        with _serving_client(server.address) as c:
             for p in prompts:
                 c.generate(p, n_tokens=32)
         t_seq = time.perf_counter() - start
 
         # connections are NOT part of the serving measurement: set up all 8
         # clients first, then time only the barrier-released generate calls
-        clients = [InferenceClient(server.address).setup() for _ in range(8)]
+        clients = [_serving_client(server.address) for _ in range(8)]
         try:
             def one_round():
                 results = [None] * 8
@@ -717,6 +734,93 @@ def bench_serving():
         "value": round(speedup, 2),
         "seq_ms": round(t_seq * 1e3, 0),
         "conc_ms": round(t_conc * 1e3, 0),
+    }
+
+
+def bench_serving_continuous():
+    """8 concurrent clients with MIXED prompt lengths vs the same requests
+    serialized. The round-3 signature batcher could not co-batch different
+    lengths at all (~1x); the continuous-batching engine admits them into
+    independent slots of one shared decode loop, so the concurrent side
+    should approach the same-length leg's scaling."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distriflow_tpu.client import InferenceClient
+    from distriflow_tpu.models.transformer import (
+        TransformerConfig,
+        transformer_lm,
+    )
+    from distriflow_tpu.server import InferenceServer
+
+    rng = np.random.RandomState(0)
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=512, n_heads=8, n_layers=8, d_ff=2048,
+        max_seq=1024, dtype=jnp.bfloat16)
+    params = transformer_lm(cfg, example_seq=128).init(jax.random.PRNGKey(0))
+    server = InferenceServer(cfg, params, port=0)
+    server.transport.heartbeat_timeout = 0  # see bench_serving
+    server.setup()
+    try:
+        lengths = [16, 32, 48, 64, 80, 96, 112, 128]
+        prompts = [rng.randint(0, 32000, (1, p)).astype(np.int32)
+                   for p in lengths]
+
+        start = time.perf_counter()
+        with _serving_client(server.address) as c:
+            for p in prompts:
+                c.generate(p, n_tokens=32)
+        t_seq_cold = time.perf_counter() - start  # pays per-length compiles
+
+        clients = [_serving_client(server.address) for _ in range(8)]
+        try:
+            def one_round():
+                results = [None] * 8
+                barrier = threading.Barrier(8)
+
+                def call(i):
+                    barrier.wait()
+                    results[i] = clients[i].generate(prompts[i], n_tokens=32)
+
+                threads = [threading.Thread(target=call, args=(i,))
+                           for i in range(8)]
+                start = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                assert all(r is not None for r in results)
+                return time.perf_counter() - start
+
+            one_round()  # warm: grouped-admission prefill buckets compile
+            t_conc = min(one_round() for _ in range(2))
+        finally:
+            for c in clients:
+                c.close()
+
+        # warm serial pass AFTER the compiles above, for a fair ratio
+        start = time.perf_counter()
+        with _serving_client(server.address) as c:
+            for p in prompts:
+                c.generate(p, n_tokens=32)
+        t_seq = time.perf_counter() - start
+        speedup = t_seq / t_conc
+        log(f"serving_continuous: 8 mixed-length serial {t_seq*1e3:.0f} ms "
+            f"(cold {t_seq_cold*1e3:.0f} ms) vs concurrent "
+            f"{t_conc*1e3:.0f} ms -> {speedup:.2f}x "
+            f"(batches={server.decode_batches}, reqs={server.batched_requests})")
+    finally:
+        server.stop()
+    return {
+        "config": "serving_continuous",
+        "metric": "speedup (8 mixed-length clients, concurrent vs serial)",
+        "value": round(speedup, 2),
+        "seq_ms": round(t_seq * 1e3, 0),
+        "conc_ms": round(t_conc * 1e3, 0),
+        "prompt_lens": "16..128",
     }
 
 
@@ -788,7 +892,14 @@ def bench_decode(n_chips):
             per_tok_ms = max((t3 - t1) / 2, 1e-9) * 1e3 / (GEN - 1)
             kv_gb = kv_gb_per_token(s_ctx, itemsize)
             name = kv_dtype or "bf16"
-            out[(name, s_ctx)] = per_tok_ms
+            if kv_dtype == "int8" and cfg.resolved_kv_cache_dtype is None:
+                # below INT8_KV_DECODE_CROSSOVER_SEQ the config auto-gates
+                # to the bf16 cache (the round-5 i8-slower-than-bf16
+                # regression fix) — the row measures the gated reality
+                name = "int8(auto->bf16)"
+                out[("int8", s_ctx)] = per_tok_ms
+            else:
+                out[(name, s_ctx)] = per_tok_ms
             log(f"decode ctx={s_ctx} kv={name}: {per_tok_ms:.3f} ms/token, "
                 f"{B / per_tok_ms * 1e3:.0f} tok/s (B={B}, "
                 f"{kv_gb / (per_tok_ms / 1e3):.0f} GB/s implied, "
@@ -803,6 +914,7 @@ def bench_decode(n_chips):
         "ms_tok_4k": round(out[("bf16", 4096)], 3),
         "i8_ms_tok_1k": round(out[("int8", 1024)], 3),
         "i8_ms_tok_4k": round(out[("int8", 4096)], 3),
+        "i8_gated": "auto-bf16 below crossover 8192",
         "hbm_frac_4k": round(
             kv4 / (out[("bf16", 4096)] / 1e3) / HBM_PEAK_GBPS, 2),
     }
@@ -1121,6 +1233,7 @@ def main() -> None:
         run(bench_transformer_large, n_chips)
         run(bench_moe, n_chips, matrix)  # reads the flagship row above
         run(bench_serving)
+        run(bench_serving_continuous)
         run(bench_decode, n_chips)
     run(bench_mnist_sync, n_chips)
     run(bench_cifar_async, matrix)  # reads the cifar sync row for pct

@@ -31,12 +31,21 @@ class InferenceClient:
         self.timeout = timeout
         self.transport = ClientTransport(address)
         self._connected = False
+        # scheduling metadata from the last generate ack ({"path":
+        # "slots"|"direct", "queue_ms": ...}); None against servers that
+        # predate continuous batching — the key is optional on the wire
+        self.last_serving_meta: Optional[Dict[str, Any]] = None
 
     # -- lifecycle ---------------------------------------------------------
 
     def setup(self) -> "InferenceClient":
-        self.transport.connect()
-        self._connected = True
+        # idempotent: ``with InferenceClient(...).setup() as c`` otherwise
+        # dials twice (__enter__ calls setup again), and the stale first
+        # connection's heartbeat can bind the fresh endpoint's write lock
+        # to the abandoned event loop
+        if not self._connected:
+            self.transport.connect()
+            self._connected = True
         return self
 
     def close(self) -> None:
@@ -72,7 +81,9 @@ class InferenceClient:
             n_tokens=int(n_tokens), temperature=float(temperature),
             top_k=top_k, top_p=top_p, eos_id=eos_id, seed=int(seed),
         )
-        result = unpack_bytes(self._request("generate", payload)["result"])
+        ack = self._request("generate", payload)
+        self.last_serving_meta = ack.get("serving")
+        result = unpack_bytes(ack["result"])
         return deserialize_array(result["tokens"])
 
     def beam_search(

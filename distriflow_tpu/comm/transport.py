@@ -637,8 +637,14 @@ class ClientTransport:
 
         async def main():
             reader, writer = await asyncio.open_connection(self.host, self.port)
-            self._endpoint = _Endpoint(self._loop, writer, fault_plan=self.fault_plan,
-                                       telemetry=self.telemetry, role="client")
+            loop = self._loop
+            # pin THIS connection's endpoint: a second connect() replaces
+            # self._endpoint/self._loop, and a heartbeat reading the
+            # attribute would bind the new endpoint's write lock to this
+            # (abandoned) loop
+            endpoint = _Endpoint(loop, writer, fault_plan=self.fault_plan,
+                                 telemetry=self.telemetry, role="client")
+            self._endpoint = endpoint
             self._last_server_frame = time.monotonic()
             self._connected.set()
 
@@ -646,7 +652,7 @@ class ClientTransport:
                 while True:
                     await asyncio.sleep(self.heartbeat_interval)
                     try:
-                        await self._endpoint.emit_async(_HB_EVENT, None)
+                        await endpoint.emit_async(_HB_EVENT, None)
                     except (ConnectionError, RuntimeError):
                         return
                     if (
@@ -657,7 +663,7 @@ class ClientTransport:
                         print("[transport] server lost (no frames for "
                               f"{self.heartbeat_timeout:.0f}s)", file=sys.stderr, flush=True)
                         if self.on_server_lost is not None:
-                            await self._loop.run_in_executor(None, self.on_server_lost)
+                            await loop.run_in_executor(None, self.on_server_lost)
                         writer.close()
                         return
 
@@ -668,7 +674,7 @@ class ClientTransport:
                 handler = self._handlers.get(msg.get("event"))
                 if handler is not None:
                     try:
-                        await self._loop.run_in_executor(
+                        await loop.run_in_executor(
                             None, handler, msg.get("payload")
                         )
                     except Exception as e:
@@ -682,16 +688,16 @@ class ClientTransport:
                     self._c_received.inc()
                     self._last_server_frame = time.monotonic()
                     if msg.get("event") == "__ack__":
-                        self._endpoint.handle_ack(msg)
+                        endpoint.handle_ack(msg)
                         continue
                     if msg.get("event") == _HB_EVENT:
                         continue  # server's heartbeat echo; timestamp is enough
-                    self._loop.create_task(dispatch(msg))
+                    loop.create_task(dispatch(msg))
             except (asyncio.IncompleteReadError, ConnectionResetError):
                 # server went away (EOF/reset) without us calling close()
                 if not self._stopped and self.on_server_lost is not None:
                     print("[transport] server connection lost", file=sys.stderr, flush=True)
-                    await self._loop.run_in_executor(None, self.on_server_lost)
+                    await loop.run_in_executor(None, self.on_server_lost)
             except FrameCorruptionError as e:
                 # desynced stream: reset and let the reconnect machinery
                 # re-establish a clean session

@@ -358,6 +358,199 @@ def sequence_logprob(
     return fn(params, tokens, jnp.full((b,), from_pos, jnp.int32))
 
 
+# ---------------------------------------------------------------------------
+# Continuous batching: the slot-partitioned decode engine (device half).
+#
+# The inference server's scheduler keeps a fixed-capacity KV cache of
+# ``max_slots`` independent rows and advances ALL live rows one decode
+# iteration at a time — requests of different prompt lengths, sampling
+# settings, and budgets share the same jit program. These are the device
+# functions it drives:
+#
+# - :func:`slot_cache` allocates the ``[max_slots, max_seq, ...]`` cache
+#   pytree with each layer's scalar ``cache_index`` generalized to a
+#   ``[max_slots]`` vector — the static shape signal that flips
+#   ``models/transformer.py::_decode_attend`` into its per-row slot mode
+#   (per-row RoPE offsets, scatter writes, per-row visibility windows,
+#   per-row flash-decode lengths);
+# - ``prefill``/``extend`` run the SAME module + math as the solo
+#   :func:`generate` path, so an admitted row's cache contents are
+#   bit-identical to a solo request's — greedy parity is inherited from the
+#   solo path rather than re-proven;
+# - ``insert`` scatters R freshly prefilled rows (plus their lengths) into
+#   free slots in one dispatch;
+# - ``decode`` is a ``lax.scan`` of ``chunk`` single-token iterations over
+#   the whole slot batch. Multi-token chunks amortize the per-dispatch host
+#   round-trip floor that would otherwise dominate per-token serving
+#   latency; finished rows freeze to eos inside the scan exactly like the
+#   solo loop, so the host can retire them at any chunk boundary and pad
+#   deterministically.
+#
+# Sampled rows stay deterministic per (request, seed) INDEPENDENT of batch
+# composition: row keys are ``fold_in(PRNGKey(seed), absolute_position)``,
+# and a row's absolute position depends only on its own progress — not on
+# which other requests happen to share the batch, nor on the chunk size.
+
+
+def _as_dict(tree):
+    """Plain-dict view of a (possibly frozen) variable collection, so slot
+    caches built here and row caches returned by flax apply always carry
+    the same pytree structure."""
+    if hasattr(tree, "items"):
+        return {k: _as_dict(v) for k, v in tree.items()}
+    return tree
+
+
+def _cache_positions(cache):
+    """The [max_slots] per-row write positions — every layer agrees, so
+    the first ``cache_index`` leaf found is THE position vector."""
+    if hasattr(cache, "items"):
+        for name, sub in cache.items():
+            if name == "cache_index":
+                return sub
+            found = _cache_positions(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def slot_cache(config: TransformerConfig, params, max_slots: int):
+    """Allocate the engine's zeroed slot cache: the decode module's cache
+    pytree at batch ``max_slots``, with every ``cache_index`` leaf widened
+    to a ``[max_slots]`` int32 vector. Built from ``jax.eval_shape`` (no
+    forward pass runs); K/V rows start zeroed and positions at 0 — a free
+    slot's garbage stays confined to its own row because every row only
+    ever attends within its own visibility window."""
+    module = _decode_module(config)
+    dummy = jnp.zeros((max_slots, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda p: module.apply(p, dummy, mutable=["cache"])[1]["cache"],
+        params)
+
+    def build(node):
+        if hasattr(node, "items"):
+            return {
+                name: (jnp.zeros((max_slots,), jnp.int32)
+                       if name == "cache_index" else build(sub))
+                for name, sub in node.items()
+            }
+        return jnp.zeros(node.shape, node.dtype)
+
+    return build(_as_dict(shapes))
+
+
+@functools.lru_cache(maxsize=16)
+def _build_prefill(config: TransformerConfig):
+    """Admission prefill, cached per config ALONE (unlike
+    :func:`_build_fns`, whose key drags in the whole decode signature):
+    ``prefill`` fills a fresh cache over the whole prompt, ``extend``
+    continues an existing one — the chunked-prefill path, which bounds
+    how long admission can stall the running batch at the price of the
+    continuation branch's dense attention."""
+    module = _decode_module(config)
+
+    @jax.jit
+    def prefill(params, prompt):
+        logits, vars_ = module.apply(params, prompt, mutable=["cache"])
+        return logits[:, -1], vars_["cache"]
+
+    @jax.jit
+    def extend(params, cache, tokens):
+        logits, vars_ = module.apply(
+            {**params, "cache": cache}, tokens, mutable=["cache"])
+        return logits[:, -1], vars_["cache"]
+
+    return prefill, extend
+
+
+def _truncate_logit_rows(logits, top_ks, top_ps):
+    """Per-row :func:`_truncate_logits`: ``top_ks``/``top_ps`` arrive as
+    [S] vectors (0 / 1.0 = off for that row) so ONE program serves every
+    sampling mix in the batch. Same HF-style composition as the solo
+    path — k first, then p over the k-renormalized survivors — with the
+    static ``min(k, V)`` clamp replaced by a per-row clip + gather."""
+    neg = jnp.finfo(logits.dtype).min
+    v = logits.shape[-1]
+    srt = jnp.sort(logits, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(top_ks, 1, v)[:, None] - 1, axis=-1)
+    logits = jnp.where((top_ks[:, None] > 0) & (logits < kth), neg, logits)
+    srt2 = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(srt2, axis=-1)  # masked entries -> ~0 mass
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_ps[:, None]
+    n_keep = jnp.sum(keep, axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(srt2, n_keep - 1, axis=-1)
+    return jnp.where(
+        (top_ps[:, None] < 1.0) & (logits < cutoff), neg, logits)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_slot_fns(config: TransformerConfig, chunk: int,
+                    with_sampling: bool):
+    """Jit programs for one (config, chunk size, sampling?) engine
+    signature: ``insert(cache, row_cache, slots, length)``,
+    ``pick_rows(logits, temps, top_ks, top_ps, seeds, positions)`` and
+    ``decode(params, cache, tok, done, temps, top_ks, top_ps, seeds,
+    eos)``. ``with_sampling=False`` is the greedy-only fast path — no
+    vocab sort per step; the scheduler switches programs whenever a
+    sampled request joins or leaves the batch (both operate on the same
+    cache, so switching mid-flight is free)."""
+    module = _decode_module(config)
+
+    @jax.jit
+    def insert(cache, row_cache, slots, length):
+        row_cache = _as_dict(row_cache)
+
+        def put(dst, src):
+            if src.ndim == 0:  # scalar cache_index -> one entry per slot
+                return dst.at[slots].set(
+                    jnp.broadcast_to(length, slots.shape).astype(dst.dtype))
+            return dst.at[slots].set(src.astype(dst.dtype))
+
+        return jax.tree.map(put, cache, row_cache)
+
+    def _pick(logits, temps, top_ks, top_ps, seeds, positions):
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if not with_sampling:
+            return greedy
+        t = jnp.where(temps > 0, temps, 1.0)[:, None]
+        lg = _truncate_logit_rows(logits / t, top_ks, top_ps)
+
+        def one(seed, pos, row_logits):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+            return jax.random.categorical(key, row_logits)
+
+        sampled = jax.vmap(one)(seeds, positions, lg).astype(jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    @jax.jit
+    def pick_rows(logits, temps, top_ks, top_ps, seeds, positions):
+        return _pick(logits, temps, top_ks, top_ps, seeds, positions)
+
+    @jax.jit
+    def decode(params, cache, tok, done, temps, top_ks, top_ps, seeds, eos):
+        def step(carry, _):
+            cache, tok, done = carry
+            logits, vars_ = module.apply(
+                {**params, "cache": cache}, tok[:, None], mutable=["cache"])
+            cache = _as_dict(vars_["cache"])
+            pos = _cache_positions(cache)  # post-apply: the position of nxt
+            nxt = _pick(logits[:, -1], temps, top_ks, top_ps, seeds, pos)
+            # finished rows keep emitting eos, exactly like the solo scan
+            # (eos = -1 means "no eos for this row": tokens are >= 0, so
+            # done can never trip and the max() filler is never surfaced)
+            nxt = jnp.where(done, jnp.maximum(eos, 0), nxt)
+            done = done | (nxt == eos)
+            return (cache, nxt, done), nxt
+
+        (cache, tok, done), toks = jax.lax.scan(
+            step, (cache, tok, done), None, length=chunk)
+        return cache, tok, done, toks.T  # toks [max_slots, chunk]
+
+    return insert, pick_rows, decode
+
+
 def generate(
     config: TransformerConfig,
     params,

@@ -38,6 +38,16 @@ from distriflow_tpu.parallel.ring_attention import (
 )
 
 
+# int8-KV-cache latency crossover (satellite of the continuous-batching
+# round; BENCH_r05 decode row): int8 decode measured SLOWER than bf16 at
+# 1k context (0.474 vs 0.296 ms/tok) and 4k (1.014 vs 0.927) — the scale
+# reads plus per-token quantization overhead beat the halved KV bytes at
+# short context — and faster only by ~16k (3.03 vs 3.09, builder-measured,
+# docs/PERFORMANCE.md §7e). Caches shorter than this keep bf16 under
+# kv_cache_dtype="int8"; "int8_force" overrides (capacity > latency).
+INT8_KV_DECODE_CROSSOVER_SEQ = 8192
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -95,6 +105,13 @@ class TransformerConfig:
     # per-(position, head) absmax quantization; scales stored alongside in
     # float32. Pays off through the flash-decode kernel (in-VMEM dequant);
     # the XLA fallback materializes the dequantized cache and loses.
+    # "int8" auto-gates to the bf16 cache below
+    # INT8_KV_DECODE_CROSSOVER_SEQ positions: at short context the scale
+    # reads + per-token quantization overhead outweigh the halved KV
+    # traffic (measured slower at 1k AND 4k, BENCH_r05), so short caches
+    # silently keep cfg.dtype and the int8 request only takes effect where
+    # it wins. "int8_force" always quantizes (kernel unit tests, capacity-
+    # bound deployments that want 2x context per HBM byte regardless).
     kv_cache_dtype: Optional[str] = None
     # single-token decode attention via the Pallas flash-decode kernel
     # (ops/flash_decode.py): one fused pass over the KV cache instead of
@@ -115,11 +132,25 @@ class TransformerConfig:
                 "use_ring_attention and use_ulysses_attention are mutually "
                 "exclusive sequence-parallel strategies; pick one"
             )
-        if self.kv_cache_dtype not in (None, "int8"):
+        if self.kv_cache_dtype not in (None, "int8", "int8_force"):
             raise ValueError(
-                f"kv_cache_dtype must be None or 'int8', got "
-                f"{self.kv_cache_dtype!r}"
+                f"kv_cache_dtype must be None, 'int8', or 'int8_force', "
+                f"got {self.kv_cache_dtype!r}"
             )
+
+    @property
+    def resolved_kv_cache_dtype(self) -> Optional[str]:
+        """The cache precision decode actually stores: "int8" only when
+        quantization pays — i.e. forced, or ``max_seq`` at/above the
+        measured crossover (docs/PERFORMANCE.md §7e). Below it, int8's
+        per-token quantize + scale reads cost more than the halved KV
+        traffic saves, so the cache silently stays ``cfg.dtype``."""
+        if self.kv_cache_dtype == "int8_force":
+            return "int8"
+        if (self.kv_cache_dtype == "int8"
+                and self.max_seq >= INT8_KV_DECODE_CROSSOVER_SEQ):
+            return "int8"
+        return None
 
     def resolved_loss_for(self, mesh: Optional[Mesh]) -> str:
         """The loss name the model spec actually trains with. An explicit
@@ -156,22 +187,35 @@ def apply_rope(
     q: jnp.ndarray,
     k: jnp.ndarray,
     base: float = 10000.0,
-    offset: int = 0,
+    offset: Any = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Rotary position embeddings over ``[B, H, S, D]`` q/k (D even).
 
     Rotation runs in float32 (angle precision matters at long context) and
     casts back to the input dtype; the attention score then depends only on
     the relative position ``i - j``. ``offset`` shifts the absolute
-    positions (e.g. for decode-time caches)."""
+    positions (e.g. for decode-time caches): a scalar shifts every row the
+    same way; a ``[B]`` vector gives each batch row its own absolute
+    position (slot-partitioned continuous-batching decode, where rows sit
+    at unrelated depths in their sequences)."""
     d = q.shape[-1]
     if d % 2:
         raise ValueError(f"RoPE needs an even head dim, got {d}")
     half = d // 2
-    pos = offset + jnp.arange(q.shape[2], dtype=jnp.float32)  # [S]
+    off = jnp.asarray(offset, dtype=jnp.float32)
+    steps = jnp.arange(q.shape[2], dtype=jnp.float32)  # [S]
+    if off.ndim == 0:
+        pos = off + steps  # [S]
+    elif off.ndim == 1:
+        pos = off[:, None] + steps[None, :]  # [B, S]
+    else:
+        raise ValueError(f"RoPE offset must be scalar or [B], got ndim={off.ndim}")
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)  # [half]
-    angles = pos[:, None] * freqs[None, :]  # [S, half]
+    angles = pos[..., None] * freqs  # [S, half] or [B, S, half]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
+    if off.ndim == 1:
+        # insert the heads axis so the rotation broadcasts over [B, H, S, half]
+        cos, sin = cos[:, None], sin[:, None]
 
     def rot(x):
         xf = x.astype(jnp.float32)
@@ -305,7 +349,7 @@ class Attention(nn.Module):
         into its score/prob tensors in VMEM.
         """
         cfg = self.config
-        quant = cfg.kv_cache_dtype == "int8"
+        quant = cfg.resolved_kv_cache_dtype == "int8"
         hd = cfg.n_heads * head_dim
         cache_shape = (b, cfg.max_seq, hd)
         store_dtype = jnp.int8 if quant else cfg.dtype
@@ -331,11 +375,27 @@ class Attention(nn.Module):
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((), jnp.int32))
         idx = ci.value
+        # Slot mode (continuous batching): the engine swaps the scalar
+        # cache_index for a [B] vector — each batch row is an independent
+        # request at its own depth. Detected statically from the cache
+        # pytree's shape, so both modes share one module and each jit
+        # program sees exactly one branch. Per-row RoPE offsets, scatter
+        # writes (OOB rows — retired slots parked at max_seq — drop), and
+        # per-row visibility replace their scalar counterparts below.
+        slot_mode = idx.ndim == 1
         if cfg.use_rope:
             q, k = apply_rope(q, k, base=cfg.rope_base, offset=idx)
         # q/k/v arrive [B, H, s, D]; the cache wants token-major [B, s, H*D]
         k_tok = k.transpose(0, 2, 1, 3).reshape(b, s, hd)
         v_tok = v.transpose(0, 2, 1, 3).reshape(b, s, hd)
+
+        def _store(buf, upd):
+            """Append ``upd`` [B, s, ...] at each row's own position."""
+            if slot_mode:
+                rows = jnp.arange(b)[:, None]
+                cols = idx[:, None] + jnp.arange(s)[None, :]
+                return buf.at[rows, cols].set(upd)
+            return jax.lax.dynamic_update_slice(buf, upd, (0, idx, 0))
 
         def _quantize(t):  # t: [B, s, H*D] -> int8 + [B, s, H] scales
             tf = t.astype(jnp.float32).reshape(b, s, cfg.n_heads, head_dim)
@@ -347,10 +407,10 @@ class Attention(nn.Module):
         if quant:
             k8, ks = _quantize(k_tok)
             v8, vs = _quantize(v_tok)
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k8, (0, idx, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v8, (0, idx, 0))
-            sk.value = jax.lax.dynamic_update_slice(sk.value, ks, (0, idx, 0))
-            sv.value = jax.lax.dynamic_update_slice(sv.value, vs, (0, idx, 0))
+            ck.value = _store(ck.value, k8)
+            cv.value = _store(cv.value, v8)
+            sk.value = _store(sk.value, ks)
+            sv.value = _store(sv.value, vs)
             # dequantize in f32 and cast the PRODUCT, matching the flash
             # kernel's in-VMEM dequant — casting the scales to bf16 first
             # would diverge the two decode paths' numerics
@@ -361,10 +421,8 @@ class Attention(nn.Module):
                 b, cfg.max_seq, cfg.n_heads, head_dim)
                 * sv.value[..., None]).astype(cfg.dtype)
         else:
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k_tok.astype(cfg.dtype), (0, idx, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v_tok.astype(cfg.dtype), (0, idx, 0))
+            ck.value = _store(ck.value, k_tok.astype(cfg.dtype))
+            cv.value = _store(cv.value, v_tok.astype(cfg.dtype))
             keys = ck.value.reshape(b, cfg.max_seq, cfg.n_heads, head_dim)
             vals = cv.value.reshape(b, cfg.max_seq, cfg.n_heads, head_dim)
         ci.value = idx + s
@@ -444,12 +502,27 @@ class Attention(nn.Module):
             "bhqd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
         ) / math.sqrt(head_dim)  # [B, H, s, max_seq]
         k_pos = jnp.arange(cfg.max_seq)[None, :]
-        q_pos = idx + jnp.arange(s)[:, None]
-        if cfg.causal:
-            visible = k_pos <= q_pos
+        if slot_mode:
+            # per-row windows: row i sees [0, idx[i] + q) — other slots'
+            # depths never leak into the mask, and masked scores at -1e30
+            # underflow to exactly 0.0 in softmax, so a row's output is
+            # bit-identical whatever garbage its batchmates left behind
+            q_pos = idx[:, None] + jnp.arange(s)[None, :]  # [B, s]
+            if cfg.causal:
+                visible = k_pos[None] <= q_pos[..., None]  # [B, s, K]
+            else:
+                visible = jnp.broadcast_to(
+                    k_pos[None] < (idx + s)[:, None, None],
+                    (b, s, cfg.max_seq))
+            visible = visible[:, None]  # [B, 1, s, K] over heads
         else:
-            # non-causal configs still must not attend to empty cache slots
-            visible = jnp.broadcast_to(k_pos < idx + s, (s, cfg.max_seq))
+            q_pos = idx + jnp.arange(s)[:, None]
+            if cfg.causal:
+                visible = k_pos <= q_pos
+            else:
+                # non-causal configs still must not attend to empty cache
+                # slots
+                visible = jnp.broadcast_to(k_pos < idx + s, (s, cfg.max_seq))
         scores = jnp.where(visible, scores, -1e30)
         p = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum(

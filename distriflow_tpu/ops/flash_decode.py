@@ -170,11 +170,14 @@ def _bd_mask(h: int, hd: int) -> jnp.ndarray:
             == lax.broadcasted_iota(jnp.int32, (h, hd), 0)).astype(jnp.float32)
 
 
-def _attend_tile(len_ref, v_tile, o_ref, m_ref, l_ref, acc_ref,
+def _attend_tile(row_len, v_tile, o_ref, m_ref, l_ref, acc_ref,
                  j, n_kv, block_k, h, s2, p_scale=None):
     """Shared online-softmax tile update.
 
-    ``s2``: [BK, H] raw scores for this tile (already 1/sqrt(D)-scaled,
+    ``row_len``: scalar valid length for THIS batch row (continuous
+    batching gives every row its own depth — the callers read it from
+    the [B] scalar-prefetch operand at ``pl.program_id(0)``); ``s2``:
+    [BK, H] raw scores for this tile (already 1/sqrt(D)-scaled,
     scale-folded for int8); ``v_tile``: [BK, HD] bf16 packed values;
     ``p_scale``: optional [BK, H] per-position weight folded into the PV
     contraction only (the int8 V scales — the softmax normalizer ``l``
@@ -182,7 +185,7 @@ def _attend_tile(len_ref, v_tile, o_ref, m_ref, l_ref, acc_ref,
     hd = v_tile.shape[-1]
     mask = _bd_mask(h, hd)
     row = j * block_k + lax.broadcasted_iota(jnp.int32, s2.shape, 0)
-    s2 = jnp.where(row < len_ref[0], s2, NEG_INF)
+    s2 = jnp.where(row < row_len, s2, NEG_INF)
 
     m_prev = m_ref[:]  # [1, H]
     m_new = jnp.maximum(m_prev, jnp.max(s2, axis=0, keepdims=True))
@@ -232,8 +235,8 @@ def _decode_kernel(len_ref, qbd_ref, k_ref, v_ref, o_ref,
     _init_scratch(j, m_ref, l_ref, acc_ref)
     d = k_ref.shape[-1] // h
     s2 = _qk_scores(qbd_ref, k_ref[0].astype(jnp.bfloat16), d)
-    _attend_tile(len_ref, v_ref[0].astype(jnp.bfloat16), o_ref,
-                 m_ref, l_ref, acc_ref, j, n_kv, block_k, h, s2)
+    _attend_tile(len_ref[pl.program_id(0)], v_ref[0].astype(jnp.bfloat16),
+                 o_ref, m_ref, l_ref, acc_ref, j, n_kv, block_k, h, s2)
 
 
 def _decode_kernel_quant(len_ref, qbd_ref, qs_ref, k_ref, ks_ref, v_ref,
@@ -260,8 +263,8 @@ def _decode_kernel_quant(len_ref, qbd_ref, qs_ref, k_ref, ks_ref, v_ref,
         preferred_element_type=jnp.int32)  # [BK, H] on the s8 MXU
     scale = 1.0 / (d ** 0.5)
     s2 = s_i32.astype(jnp.float32) * ks_ref[0] * (qs_ref[0] * scale)
-    _attend_tile(len_ref, v_ref[0].astype(jnp.bfloat16), o_ref,
-                 m_ref, l_ref, acc_ref, j, n_kv, block_k, h, s2,
+    _attend_tile(len_ref[pl.program_id(0)], v_ref[0].astype(jnp.bfloat16),
+                 o_ref, m_ref, l_ref, acc_ref, j, n_kv, block_k, h, s2,
                  p_scale=vs_ref[0])
 
 
@@ -287,8 +290,10 @@ def flash_decode(
 
     ``q``: [B, H, D]; ``k``/``v``: token-major packed caches
     ``[B, S, H*D]`` (bf16/f32, or int8 with ``k_scale``/``v_scale``
-    ``[B, S, H]`` f32); ``valid_len``: int32 scalar — attend to positions
-    [0, valid_len). Returns [B, H, D] in ``q``'s dtype.
+    ``[B, S, H]`` f32); ``valid_len``: int32 scalar (every row attends
+    to [0, valid_len)) or a ``[B]`` vector giving each batch row its own
+    window — the continuous-batching slot cache, where rows sit at
+    unrelated depths. Returns [B, H, D] in ``q``'s dtype.
 
     ``block_k=None`` auto-picks via :func:`pick_block_k` and validates
     the tile against the scoped-VMEM model (a too-large explicit
@@ -329,7 +334,10 @@ def flash_decode(
             "pass a smaller block_k (a divisor of the cache length, "
             "multiple of 8), or let block_k=None pick one")
     n_kv = s // block_k
-    len1 = jnp.reshape(valid_len.astype(jnp.int32), (1,))
+    # scalar-prefetch lengths, one per batch row (a scalar broadcasts:
+    # the homogeneous static-batch callers keep their old semantics)
+    lens = jnp.broadcast_to(
+        jnp.reshape(valid_len.astype(jnp.int32), (-1,)), (b,))
 
     # block-diagonal query [B, HD, H]: head h's query in rows h*D:(h+1)*D
     # of column h — the operand that turns all-head scores into ONE
@@ -398,7 +406,7 @@ def flash_decode(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(len1, *arrays)
+    )(lens, *arrays)
     return out.reshape(b, h, d)
 
 
@@ -461,13 +469,15 @@ def _sharded_fd(quant: bool, interpret: bool):
         b, hx = _q_spec(mesh, arg_infos)
         q_sh = NamedSharding(mesh, P(b, hx, None))
         kv_sh = NamedSharding(mesh, P(b, None, hx))
-        arg_sh = [q_sh, kv_sh, kv_sh, NamedSharding(mesh, P(None))]
+        # the [B] per-row lengths co-shard with batch (each data shard
+        # masks its own rows)
+        arg_sh = [q_sh, kv_sh, kv_sh, NamedSharding(mesh, P(b))]
         if quant:
             arg_sh += [kv_sh, kv_sh]  # [B, S, H] scales co-shard on H
         return mesh, fn, NamedSharding(mesh, P(b, hx, None)), tuple(arg_sh)
 
-    rule = ("b h d, b s k, b s k, l -> b h d" if not quant else
-            "b h d, b s k, b s k, l, b s j, b s j -> b h d")
+    rule = ("b h d, b s k, b s k, b -> b h d" if not quant else
+            "b h d, b s k, b s k, b, b s j, b s j -> b h d")
     compat.def_partition(
         wrapped, partition=partition, infer_sharding_from_operands=infer,
         sharding_rule=rule)
@@ -487,10 +497,14 @@ def flash_decode_sharded(
     safe (and a no-op) on unsharded operands; under tensor parallelism
     each model shard runs the kernel on its own heads with no gather.
     Head counts not divisible by the sharding degree replicate heads
-    (correct, just not sharded)."""
+    (correct, just not sharded). ``valid_len`` may be a scalar or a
+    ``[B]`` per-row vector (continuous-batching slot cache)."""
     interpret = _resolve_interpret(interpret)
-    len1 = jnp.reshape(valid_len.astype(jnp.int32), (1,))
+    # materialize the [B] per-row form OUTSIDE the partitioned call so
+    # the lengths operand carries a batch dim the rule can co-shard
+    lens = jnp.broadcast_to(
+        jnp.reshape(valid_len.astype(jnp.int32), (-1,)), (q.shape[0],))
     fn = _sharded_fd(k_scale is not None, bool(interpret))
     if k_scale is not None:
-        return fn(q, k, v, len1, k_scale, v_scale)
-    return fn(q, k, v, len1)
+        return fn(q, k, v, lens, k_scale, v_scale)
+    return fn(q, k, v, lens)

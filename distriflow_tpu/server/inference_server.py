@@ -14,26 +14,39 @@ same encoding every other message type uses):
 - ``model_info``  {} -> {vocab_size, max_seq, d_model, n_layers, n_heads,
   name}
 - ``generate``    {prompt: <packed {tokens}>, n_tokens, temperature?,
-  top_k?, top_p?, eos_id?, seed?} -> {result: <packed {tokens}>}
+  top_k?, top_p?, eos_id?, seed?} -> {result: <packed {tokens}>,
+  serving: {path, queue_ms?}}
 - ``beam``        {prompt: <packed {tokens}>, n_tokens, beam_size?,
   length_penalty?, eos_id?} -> {result: <packed {tokens, scores}>}
 - ``score``       {prompt: <packed {tokens}>, from_pos} ->
   {result: <packed {scores}>} — teacher-forced log P(tokens[from_pos:])
 
-Decoding runs through the same jit-cached :func:`generate` /
-:func:`beam_search` programs the local API uses; a lock serializes device
-work across concurrent client requests (one TPU program at a time — the
-transport's handler pool would otherwise interleave compilations).
+**Continuous batching** (this round, replacing the round-3 same-signature
+window batcher): ``generate`` requests are served by a persistent decode
+loop over a fixed-capacity, slot-partitioned KV cache
+(``[max_slots, max_seq, ...]``; device half in ``models/generate.py``).
+Each slot carries its own length, eos flag, remaining-token budget and
+per-request RNG seed, so requests of *different* prompt lengths, budgets
+and sampling settings share every decode iteration:
 
-**Request batching** (round 3): concurrent *greedy* ``generate`` requests
-with the same decode signature (prompt length, n_tokens, eos) are
-micro-batched — a dispatcher thread drains the queue, stacks the prompts
-along the batch axis, runs ONE decode program, and splits the results.
+- **admission**: between decode iterations, queued requests are prefilled
+  (grouped by prompt length, optionally in ``prefill_chunk`` pieces) and
+  scattered into free slots in one dispatch;
+- **iteration**: one jit program advances ALL live rows ``decode_chunk``
+  tokens; finished rows freeze to eos inside the scan exactly like the
+  solo path;
+- **retirement**: rows that hit eos or their budget retire at the next
+  chunk boundary and their caller is answered immediately — nobody waits
+  for the slowest member of a "group", because there are no groups.
+
 Greedy decoding is row-independent, so each caller gets bit-identical
-output to a solo request; N waiting clients cost one decode instead of N.
-Sampled requests (temperature > 0) keep the serialized path: batching
-would merge their sampling streams and break the per-request ``seed``
-determinism contract.
+output to a solo request. Sampled requests batch too (new): a row's keys
+are ``fold_in(PRNGKey(seed), position)`` where the position depends only
+on the request's own progress, so the per-request ``seed`` determinism
+contract holds regardless of batch composition. Requests that cannot use
+the engine (``B`` rows > free capacity ever possible, i.e. ``B >
+max_slots``, or multi-row sampled prompts whose historical contract ties
+all rows to ONE key stream) fall back to the serialized solo path.
 
 **Mesh-aware serving** (round 3): ``params`` may be Megatron/TP-sharded
 device arrays — the decode programs GSPMD-partition from the param
@@ -47,14 +60,26 @@ from __future__ import annotations
 
 import queue as queue_mod
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+import time as time_mod
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from distriflow_tpu.comm.transport import ServerTransport
-from distriflow_tpu.models.generate import beam_search, generate, sequence_logprob
+from distriflow_tpu.models.generate import (
+    _build_prefill,
+    _build_slot_fns,
+    _check_fits,
+    beam_search,
+    generate,
+    sequence_logprob,
+    slot_cache,
+)
 from distriflow_tpu.models.transformer import TransformerConfig
+from distriflow_tpu.obs import get_telemetry
+from distriflow_tpu.utils.config import ServingConfig
 from distriflow_tpu.utils.logging import VerboseLogger
 from distriflow_tpu.utils.serialization import (
     deserialize_array,
@@ -63,30 +88,51 @@ from distriflow_tpu.utils.serialization import (
     unpack_bytes,
 )
 
+# Compatibility defaults: ``ServingConfig`` fields left ``None`` read these
+# at USE time, so tests (and soaks) that monkeypatch the module constants
+# keep working unchanged.
 MAX_PROMPT_BATCH = 64  # refuse absurd wire batches before touching the device
-BATCH_WINDOW_S = 0.004  # micro-batch collection window after the first request
+BATCH_WINDOW_S = 0.004  # collection window after the first idle-state request
 
 
-class _Pending:
-    """One queued greedy-generate request awaiting its batch."""
+class _Request:
+    """One queued ``generate`` request awaiting the engine."""
 
-    __slots__ = ("prompt", "sig", "done", "result", "error")
+    __slots__ = (
+        "prompt", "n_tokens", "temperature", "top_k", "top_p", "eos",
+        "seed", "client_id", "enq_t", "admit_t", "rows_out", "rows_left",
+        "cancelled", "done", "result", "error",
+    )
 
-    def __init__(self, prompt: np.ndarray, sig: Tuple):
+    def __init__(self, prompt: np.ndarray, n_tokens: int, temperature: float,
+                 top_k: int, top_p: float, eos: int, seed: int,
+                 client_id: str):
         self.prompt = prompt
-        self.sig = sig
+        self.n_tokens = n_tokens
+        self.temperature = temperature
+        self.top_k = top_k          # 0 = off
+        self.top_p = top_p          # 1.0 = off
+        self.eos = eos              # -1 = no eos
+        self.seed = seed
+        self.client_id = client_id
+        self.enq_t = time_mod.monotonic()
+        self.admit_t: Optional[float] = None
+        self.rows_out: List[Optional[np.ndarray]] = [None] * prompt.shape[0]
+        self.rows_left = prompt.shape[0]
+        self.cancelled = False
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[Exception] = None
 
 
-def _prompt_from(payload: Dict[str, Any]) -> np.ndarray:
+def _prompt_from(payload: Dict[str, Any], limit: Optional[int] = None) -> np.ndarray:
+    cap = MAX_PROMPT_BATCH if limit is None else limit
     arr = deserialize_array(unpack_bytes(payload["prompt"])["tokens"])
     if arr.ndim != 2:
         raise ValueError(f"prompt must be [B, P], got shape {arr.shape}")
-    if not 1 <= arr.shape[0] <= MAX_PROMPT_BATCH:
+    if not 1 <= arr.shape[0] <= cap:
         raise ValueError(
-            f"prompt batch {arr.shape[0]} outside [1, {MAX_PROMPT_BATCH}]"
+            f"prompt batch {arr.shape[0]} outside [1, {cap}]"
         )
     if not np.issubdtype(arr.dtype, np.integer):
         raise ValueError(f"prompt must be integer tokens, got {arr.dtype}")
@@ -103,9 +149,12 @@ class InferenceServer:
         host: str = "127.0.0.1",
         port: int = 0,
         verbose: Optional[bool] = None,
+        serving: Optional[ServingConfig] = None,
+        telemetry: Any = None,
     ):
         self.config = config
         self.params = params
+        self.serving = (serving or ServingConfig()).validate()
         self.logger = VerboseLogger("InferenceServer", verbose)
         self._device_lock = threading.Lock()  # one device program at a time
         self.transport = ServerTransport(host, port)
@@ -113,13 +162,43 @@ class InferenceServer:
         self.transport.on("generate", self._on_generate)
         self.transport.on("beam", self._on_beam)
         self.transport.on("score", self._on_score)
-        # greedy-generate micro-batching (module docstring): queue + one
-        # dispatcher thread; observability counters for tests/soaks
-        self._queue: "queue_mod.Queue[Optional[_Pending]]" = queue_mod.Queue()
+        self.transport.on_disconnect = self._on_client_disconnect
+        # continuous-batching engine (module docstring): queue + one
+        # scheduler thread; plain-int counters kept for tests/soaks that
+        # read them directly, mirrored into the obs registry below
+        self._queue: "queue_mod.Queue[Optional[_Request]]" = queue_mod.Queue()
+        self._backlog: Deque[_Request] = deque()  # pulled, awaiting a slot
         self._dispatcher: Optional[threading.Thread] = None
         self._stopped = False
-        self.decode_batches = 0  # device programs run for greedy generates
-        self.batched_requests = 0  # greedy requests served by those programs
+        self.decode_batches = 0  # engine decode iterations dispatched
+        self.batched_requests = 0  # requests admitted into the engine
+        # requests owned by each live connection, so a disconnect can
+        # cancel its queued work and free its slots (chaos-reset tests)
+        self._inflight_lock = threading.Lock()
+        self._inflight: Dict[str, List[_Request]] = {}
+        # slot state (host side; device cache allocated lazily on first
+        # admission). Free slots sit with done=True so the decode scan
+        # leaves them frozen; their writes stay confined to their own row.
+        s = self.serving.max_slots
+        self._slot_cache: Any = None
+        self._tok = np.zeros((s,), np.int32)
+        self._done = np.ones((s,), bool)
+        self._temps = np.zeros((s,), np.float32)
+        self._top_ks = np.zeros((s,), np.int32)
+        self._top_ps = np.ones((s,), np.float32)
+        self._seeds = np.zeros((s,), np.int32)
+        self._eos = np.full((s,), -1, np.int32)
+        self._slot_req: List[Optional[_Request]] = [None] * s
+        self._slot_row = np.zeros((s,), np.int32)
+        self._slot_emitted = np.zeros((s,), np.int64)
+        # serving metrics (contract table in docs/OBSERVABILITY.md §1)
+        tel = telemetry if telemetry is not None else get_telemetry()
+        self._m_batches = tel.counter("serving_decode_batches_total")
+        self._m_admitted = tel.counter("serving_batched_requests_total")
+        self._m_tokens = tel.counter("serving_tokens_generated_total")
+        self._m_slots = tel.gauge("serving_slots_active")
+        self._m_qwait = tel.histogram("serving_queue_wait_ms")
+        self._m_tpot = tel.histogram("serving_time_per_output_token_ms")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -127,11 +206,11 @@ class InferenceServer:
         self._stopped = False
         # restart hygiene: a request that raced a previous stop() was
         # error-completed but may still sit in the queue — the new
-        # dispatcher must not serve orphans whose callers already errored
+        # scheduler must not serve orphans whose callers already errored
         self._drain_and_error()
         self.transport.start()
         self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, daemon=True,
+            target=self._engine_loop, daemon=True,
             name="inference-batcher")
         self._dispatcher.start()
         self.logger.log(f"serving on {self.address}")
@@ -144,7 +223,7 @@ class InferenceServer:
             self._queue.put(None)  # wake + exit sentinel
             self._dispatcher.join(timeout=5.0)
             self._dispatcher = None
-        # a handler may have enqueued between the dispatcher's final drain
+        # a handler may have enqueued between the scheduler's final drain
         # and _stopped landing in its view; sweep once more so no waiter is
         # left to the 600 s backstop
         self._drain_and_error()
@@ -154,10 +233,23 @@ class InferenceServer:
         return self.transport.address
 
     def set_params(self, params: Any) -> None:
-        """Swap serving weights (e.g. after a training round); in-flight
-        requests finish on the old params."""
+        """Swap serving weights (e.g. after a training round). Requests
+        mid-decode continue on the NEW params from their next chunk — the
+        engine re-reads ``self.params`` every dispatch; the KV cache is
+        config-shaped only, so it survives the swap."""
         with self._device_lock:
             self.params = params
+
+    # -- config accessors (None -> module constant, read at use time so
+    #    tests that monkeypatch the constants keep working) ----------------
+
+    def _window_s(self) -> float:
+        w = self.serving.batch_window_s
+        return BATCH_WINDOW_S if w is None else w
+
+    def _prompt_cap(self) -> int:
+        cap = self.serving.max_prompt_batch
+        return MAX_PROMPT_BATCH if cap is None else cap
 
     # -- handlers (run in the transport's executor; return value = ack) ----
 
@@ -172,22 +264,54 @@ class InferenceServer:
             "n_heads": cfg.n_heads,
         }
 
+    def _on_client_disconnect(self, client_id: str) -> None:
+        """Transport callback: cancel the departed client's work. Queued
+        requests are skipped at admission; live slots retire at the next
+        chunk boundary — a dead socket must not hold capacity."""
+        with self._inflight_lock:
+            for req in self._inflight.get(client_id, ()):
+                req.cancelled = True
+
     def _on_generate(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
-        prompt = _prompt_from(payload)
+        prompt = _prompt_from(payload, self._prompt_cap())
         n_tokens = int(payload["n_tokens"])
         temperature = float(payload.get("temperature", 0.0))
         top_k = payload.get("top_k")
         top_p = payload.get("top_p")
         eos_id = payload.get("eos_id")
         seed = int(payload.get("seed", 0))
-        if temperature == 0.0 and self._dispatcher is not None:
-            # greedy: row-independent -> micro-batch with concurrent peers
-            # (bit-identical to a solo request; see module docstring)
-            sig = (prompt.shape[1], n_tokens,
-                   int(eos_id) if eos_id is not None else None)
-            item = _Pending(prompt, sig)
+        rows = prompt.shape[0]
+        # the engine serves single requests and row-independent (greedy)
+        # multi-row prompts; multi-row SAMPLED prompts keep the solo path —
+        # their historical contract derives every row from one key stream
+        use_engine = (
+            self._dispatcher is not None
+            and n_tokens >= 1
+            and rows <= self.serving.max_slots
+            and (temperature == 0.0 or rows == 1)
+        )
+        if use_engine:
+            # mirror generate()'s argument validation BEFORE enqueueing so
+            # bad requests fail in this handler, not inside the engine
+            _check_fits(prompt.shape[1], n_tokens, self.config)
+            if top_k is not None and int(top_k) < 1:
+                raise ValueError(f"top_k must be >= 1, got {top_k}")
+            if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+                raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+            if eos_id is not None and not 0 <= int(eos_id) < self.config.vocab_size:
+                raise ValueError(
+                    f"eos_id {eos_id} outside vocab [0, {self.config.vocab_size})")
+            item = _Request(
+                prompt, n_tokens, temperature,
+                int(top_k) if top_k is not None else 0,
+                float(top_p) if top_p is not None else 1.0,
+                int(eos_id) if eos_id is not None else -1,
+                seed, client_id,
+            )
+            with self._inflight_lock:
+                self._inflight.setdefault(client_id, []).append(item)
             self._queue.put(item)
-            # re-check AFTER enqueueing (TOCTOU vs stop(): the dispatcher
+            # re-check AFTER enqueueing (TOCTOU vs stop(): the scheduler
             # may have drained and exited between the liveness check above
             # and the put) — error the item now rather than letting the
             # waiter ride the 600 s backstop
@@ -197,15 +321,21 @@ class InferenceServer:
             # generous last-resort bound (cold compiles can take minutes);
             # normal completion/shutdown sets the event long before this
             if not item.done.wait(timeout=600.0):
+                self._unregister(item)
                 raise RuntimeError(
-                    "batched generate timed out awaiting the dispatcher")
+                    "batched generate timed out awaiting the scheduler")
+            self._unregister(item)
             # prefer result over error: the stop()-race path above can set
-            # error while a still-draining dispatcher concurrently serves
+            # error while a still-draining scheduler concurrently serves
             # the item — a request that actually computed must not be
             # reported as "server stopped"
             if item.result is None and item.error is not None:
                 raise item.error
             out = item.result
+            meta = {"path": "slots"}
+            if item.admit_t is not None:
+                meta["queue_ms"] = round(
+                    (item.admit_t - item.enq_t) * 1000.0, 3)
         else:
             with self._device_lock, self.logger.time(
                 f"generate[{prompt.shape[0]}x{prompt.shape[1]}+{n_tokens}]"
@@ -218,51 +348,294 @@ class InferenceServer:
                     eos_id=int(eos_id) if eos_id is not None else None,
                     rng=jax.random.PRNGKey(seed),
                 )
-        return {"result": pack_bytes({"tokens": serialize_array(out)})}
+            meta = {"path": "direct"}
+        return {"result": pack_bytes({"tokens": serialize_array(out)}),
+                "serving": meta}
 
-    # -- greedy micro-batching ---------------------------------------------
+    # -- continuous-batching engine ----------------------------------------
 
-    def _dispatch_loop(self) -> None:
-        """Drain the greedy queue: collect requests until BATCH_WINDOW_S
-        after the first arrival (an ABSOLUTE deadline — a steady trickle
-        cannot extend collection indefinitely), group by decode signature,
-        run ONE program per group (prompts stacked over the batch axis),
-        split results. On shutdown, every still-queued request is errored —
-        a waiter must never hang forever."""
-        import time as time_mod
-
-        carry: Optional[_Pending] = None  # overflow request -> next cycle
+    def _engine_loop(self) -> None:
+        """The scheduler: pull requests into the backlog (blocking when
+        idle, with a short collection window so concurrent arrivals share
+        the first admission; non-blocking between iterations), admit into
+        free slots, advance every live row one ``decode_chunk``, retire.
+        On shutdown every waiter — queued, backlogged, or mid-decode — is
+        errored; nobody is left to the 600 s backstop."""
         while True:
-            item = carry or self._queue.get()
-            carry = None
+            try:
+                if self._gather():
+                    self._shutdown_engine()
+                    return
+                self._admit()
+                if any(r is not None for r in self._slot_req):
+                    self._decode_iteration()
+            except Exception as e:  # device failure: fail loud, stay up
+                self.logger.log(f"engine error: {e!r}")
+                self._abort_all(e)
+
+    def _gather(self) -> bool:
+        """Queue -> backlog. Returns True on the shutdown sentinel."""
+        idle = not self._backlog and all(r is None for r in self._slot_req)
+        if idle:
+            item = self._queue.get()
             if item is None:
-                self._drain_and_error()
-                return
-            batch = [item]
-            rows = item.prompt.shape[0]
-            end = time_mod.monotonic() + BATCH_WINDOW_S
+                return True
+            self._backlog.append(item)
+            deadline = time_mod.monotonic() + self._window_s()
             while True:
-                remaining = end - time_mod.monotonic()
+                remaining = deadline - time_mod.monotonic()
                 if remaining <= 0:
-                    break
+                    return False
                 try:
                     nxt = self._queue.get(timeout=remaining)
                 except queue_mod.Empty:
-                    break
+                    return False
                 if nxt is None:
-                    self._run_groups(batch)
-                    self._drain_and_error()
-                    return
-                if rows + nxt.prompt.shape[0] > MAX_PROMPT_BATCH:
-                    carry = nxt  # keep the cap; serve it next cycle
-                    break
-                batch.append(nxt)
-                rows += nxt.prompt.shape[0]
-            self._run_groups(batch)
+                    return True
+                self._backlog.append(nxt)
+        while True:
+            try:
+                nxt = self._queue.get_nowait()
+            except queue_mod.Empty:
+                return False
+            if nxt is None:
+                return True
+            self._backlog.append(nxt)
+
+    def _admit(self) -> None:
+        """Move backlog requests into free slots (strict FIFO — a wide
+        request blocks later ones rather than being starved), prefill
+        grouped by prompt length, scatter into the cache, emit first
+        tokens, retire rows already finished (n_tokens=1 or instant eos)."""
+        admit: List[_Request] = []
+        free = sum(1 for r in self._slot_req if r is None)
+        while self._backlog:
+            head = self._backlog[0]
+            if head.cancelled:
+                self._backlog.popleft()
+                self._finish_error(head, RuntimeError("client disconnected"))
+                continue
+            if head.prompt.shape[0] > free:
+                break
+            free -= head.prompt.shape[0]
+            admit.append(self._backlog.popleft())
+        if not admit:
+            return
+        if self._slot_cache is None:
+            with self._device_lock:
+                self._slot_cache = slot_cache(
+                    self.config, self.params, self.serving.max_slots)
+        now = time_mod.monotonic()
+        groups: Dict[int, List[Tuple[_Request, int]]] = {}
+        for req in admit:
+            req.admit_t = now
+            self._m_qwait.observe((now - req.enq_t) * 1000.0)
+            for row in range(req.prompt.shape[0]):
+                groups.setdefault(req.prompt.shape[1], []).append((req, row))
+        for plen, members in sorted(groups.items()):
+            try:
+                self._admit_group(plen, members)
+            except Exception as e:
+                # contain a failed prefill to its own group: any slots the
+                # group already claimed stay unrecorded (free), so the next
+                # insert simply overwrites those cache rows
+                for req in {id(r): r for r, _ in members}.values():
+                    self._finish_error(req, e)
+        self.batched_requests += len(admit)
+        self._m_admitted.inc(len(admit))
+        self._m_slots.set(sum(1 for r in self._slot_req if r is not None))
+
+    def _admit_group(self, plen: int, members: List[Tuple[_Request, int]]) -> None:
+        """Prefill + insert + first-token for all rows of one prompt
+        length. The batch axis is padded to a power-of-two bucket (repeat
+        row 0) so arbitrary admission sizes don't each compile a fresh XLA
+        program — same rationale as the round-3 batcher; padded scatter
+        indices point one past the last slot, which JAX's FILL_OR_DROP
+        scatter mode silently drops."""
+        srv = self.serving
+        n = len(members)
+        bucket = 1 << (n - 1).bit_length()
+        stacked = np.stack([req.prompt[row] for req, row in members])
+        free_ids = [i for i, r in enumerate(self._slot_req) if r is None]
+        slots = np.array(free_ids[:n], np.int32)
+        if bucket > n:
+            pad = np.broadcast_to(stacked[:1], (bucket - n, plen))
+            stacked = np.concatenate([stacked, pad], axis=0)
+            slots = np.concatenate(
+                [slots, np.full((bucket - n,), srv.max_slots, np.int32)])
+        temps = np.zeros((bucket,), np.float32)
+        top_ks = np.zeros((bucket,), np.int32)
+        top_ps = np.ones((bucket,), np.float32)
+        seeds = np.zeros((bucket,), np.int32)
+        eos = np.full((bucket,), -1, np.int32)
+        for j, (req, _row) in enumerate(members):
+            temps[j] = req.temperature
+            top_ks[j] = req.top_k
+            top_ps[j] = req.top_p
+            seeds[j] = req.seed & 0x7FFFFFFF
+            eos[j] = req.eos
+        sampling = bool((temps > 0).any())
+        prefill, extend = _build_prefill(self.config)
+        insert, pick_rows, _ = _build_slot_fns(
+            self.config, srv.decode_chunk, sampling)
+        with self._device_lock, self.logger.time(
+            f"admit[{n}->{bucket}x{plen}]"
+        ):
+            pc = srv.prefill_chunk
+            if pc is None or pc >= plen:
+                logits, row_cache = prefill(self.params, stacked)
+            else:
+                logits, row_cache = prefill(self.params, stacked[:, :pc])
+                for i in range(pc, plen, pc):
+                    logits, row_cache = extend(
+                        self.params, row_cache, stacked[:, i:i + pc])
+            self._slot_cache = insert(
+                self._slot_cache, row_cache, slots, np.int32(plen))
+            first = np.asarray(pick_rows(
+                logits, temps, top_ks, top_ps, seeds,
+                np.full((bucket,), plen, np.int32)))[:n]
+        for j, (req, row) in enumerate(members):
+            s = int(slots[j])
+            self._slot_req[s] = req
+            self._slot_row[s] = row
+            self._slot_emitted[s] = 1
+            self._tok[s] = first[j]
+            self._temps[s] = temps[j]
+            self._top_ks[s] = top_ks[j]
+            self._top_ps[s] = top_ps[j]
+            self._seeds[s] = seeds[j]
+            self._eos[s] = eos[j]
+            hit_eos = req.eos >= 0 and int(first[j]) == req.eos
+            self._done[s] = hit_eos
+            self._m_tokens.inc()
+            out = np.asarray([first[j]], np.int32)
+            if hit_eos and req.n_tokens > 1:
+                # instant eos: the rest of the budget is frozen repeats,
+                # exactly what the solo path returns
+                out = np.concatenate(
+                    [out, np.full((req.n_tokens - 1,), req.eos, np.int32)])
+            req.rows_out[row] = out
+            if req.n_tokens == 1 or hit_eos:
+                self._complete_row(s)
+
+    def _decode_iteration(self) -> None:
+        """Advance every live slot ``decode_chunk`` tokens in ONE device
+        dispatch, then retire finished/cancelled rows."""
+        srv = self.serving
+        active = [i for i, r in enumerate(self._slot_req) if r is not None]
+        # cancelled rows retire before the dispatch, not after it
+        for s in active:
+            req = self._slot_req[s]
+            if req.cancelled:
+                self._retire_slot(s)
+                self._finish_error(req, RuntimeError("client disconnected"))
+        active = [i for i, r in enumerate(self._slot_req) if r is not None]
+        if not active:
+            self._m_slots.set(0)
+            return
+        sampling = bool((self._temps[active] > 0).any())
+        _insert, _pick, decode = _build_slot_fns(
+            self.config, srv.decode_chunk, sampling)
+        t0 = time_mod.monotonic()
+        with self._device_lock:
+            cache, tok, done, toks = decode(
+                self.params, self._slot_cache, self._tok, self._done,
+                self._temps, self._top_ks, self._top_ps, self._seeds,
+                self._eos)
+            self._slot_cache = cache
+            # np.array, not np.asarray: device outputs arrive as read-only
+            # views, and the slot state is mutated in place below
+            tok = np.array(tok)
+            done = np.array(done)
+            toks = np.array(toks)
+        elapsed_ms = (time_mod.monotonic() - t0) * 1000.0
+        self._m_tpot.observe(elapsed_ms / srv.decode_chunk)
+        self.decode_batches += 1
+        self._m_batches.inc()
+        self._tok = tok
+        self._done = done
+        emitted_now = 0
+        for s in active:
+            req = self._slot_req[s]
+            row = int(self._slot_row[s])
+            have = int(self._slot_emitted[s])
+            take = min(srv.decode_chunk, req.n_tokens - have)
+            chunk_toks = toks[s, :take].astype(np.int32)
+            emitted_now += take
+            self._slot_emitted[s] = have + take
+            req.rows_out[row] = np.concatenate([req.rows_out[row], chunk_toks])
+            if done[s]:
+                # row froze to eos inside the scan; pad the remaining
+                # budget with eos — bit-identical to the solo path's
+                # frozen-row output — and answer the caller NOW
+                pad = req.n_tokens - have - take
+                if pad:
+                    req.rows_out[row] = np.concatenate([
+                        req.rows_out[row],
+                        np.full((pad,), req.eos, np.int32)])
+                self._complete_row(s)
+            elif have + take >= req.n_tokens:
+                self._complete_row(s)
+        self._m_tokens.inc(emitted_now)
+        self._m_slots.set(sum(1 for r in self._slot_req if r is not None))
+
+    def _complete_row(self, s: int) -> None:
+        """Finish one slot's row (its tokens already sit in ``rows_out``):
+        retire the slot and resolve the request once every row is in."""
+        req = self._slot_req[s]
+        self._retire_slot(s)
+        req.rows_left -= 1
+        if req.rows_left == 0 and not req.done.is_set():
+            req.result = np.concatenate(
+                [req.prompt, np.stack(req.rows_out)], axis=1)
+            self._unregister(req)
+            req.done.set()
+
+    def _retire_slot(self, s: int) -> None:
+        """Park a slot: frozen (done=True, eos filler 0) so the decode
+        scan leaves it inert; its cache row is fully overwritten by the
+        next insert, and any writes past max_seq are dropped by the
+        scatter's FILL_OR_DROP mode."""
+        self._slot_req[s] = None
+        self._done[s] = True
+        self._temps[s] = 0.0
+        self._eos[s] = -1
+
+    def _finish_error(self, req: _Request, err: Exception) -> None:
+        if not req.done.is_set():
+            req.error = err
+            self._unregister(req)
+            req.done.set()
+
+    def _unregister(self, req: _Request) -> None:
+        with self._inflight_lock:
+            lst = self._inflight.get(req.client_id)
+            if lst is not None:
+                try:
+                    lst.remove(req)
+                except ValueError:
+                    pass
+                if not lst:
+                    self._inflight.pop(req.client_id, None)
+
+    def _abort_all(self, err: Exception) -> None:
+        """Device failure mid-engine: error every waiter (active slots and
+        backlog) and reset slot state so the engine can keep serving."""
+        for s, req in enumerate(self._slot_req):
+            if req is not None:
+                self._retire_slot(s)
+                self._finish_error(req, err)
+        while self._backlog:
+            self._finish_error(self._backlog.popleft(), err)
+        self._m_slots.set(0)
+
+    def _shutdown_engine(self) -> None:
+        self._abort_all(RuntimeError("inference server stopped"))
+        self._drain_and_error()
 
     def _drain_and_error(self) -> None:
         """Error out every request still queued at shutdown (stop() may
-        race a handler that passed the dispatcher-alive check but had not
+        race a handler that passed the scheduler-alive check but had not
         yet enqueued)."""
         while True:
             try:
@@ -270,51 +643,13 @@ class InferenceServer:
             except queue_mod.Empty:
                 return
             if item is not None:
-                item.error = RuntimeError("inference server stopped")
-                item.done.set()
+                self._finish_error(
+                    item, RuntimeError("inference server stopped"))
 
-    def _run_groups(self, batch: List[_Pending]) -> None:
-        groups: Dict[Tuple, List[_Pending]] = {}
-        for p in batch:
-            groups.setdefault(p.sig, []).append(p)
-        for sig, members in groups.items():
-            prompt_len, n_tokens, eos_id = sig
-            try:
-                stacked = np.concatenate([m.prompt for m in members], axis=0)
-                # pad the batch axis to a power-of-two bucket (repeat row 0):
-                # arbitrary stack sizes would each be a fresh XLA compile —
-                # measured ~4 s/shape over a remote backend, which turned the
-                # batching win into a loss; buckets bound the shapes to
-                # log2(MAX_PROMPT_BATCH) programs per decode signature
-                rows = stacked.shape[0]
-                bucket = 1 << (rows - 1).bit_length()
-                if bucket > rows:
-                    pad = np.broadcast_to(
-                        stacked[:1], (bucket - rows,) + stacked.shape[1:])
-                    stacked = np.concatenate([stacked, pad], axis=0)
-                with self._device_lock, self.logger.time(
-                    f"generate[batched {len(members)} reqs, "
-                    f"{rows}->{bucket}x{prompt_len}+{n_tokens}]"
-                ):
-                    out = np.asarray(generate(
-                        self.config, self.params, stacked, n_tokens,
-                        temperature=0.0, eos_id=eos_id,
-                    ))[:rows]
-                self.decode_batches += 1
-                self.batched_requests += len(members)
-                row = 0
-                for m in members:
-                    b = m.prompt.shape[0]
-                    m.result = out[row:row + b]
-                    row += b
-                    m.done.set()
-            except Exception as e:  # surface to every waiter in the group
-                for m in members:
-                    m.error = e
-                    m.done.set()
+    # -- direct-path handlers ----------------------------------------------
 
     def _on_beam(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
-        prompt = _prompt_from(payload)
+        prompt = _prompt_from(payload, self._prompt_cap())
         n_tokens = int(payload["n_tokens"])
         # .get with a default, NOT `or`: an explicit beam_size=0 must reach
         # beam_search's validation, not silently become the default
@@ -336,7 +671,7 @@ class InferenceServer:
         }
 
     def _on_score(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
-        tokens = _prompt_from(payload)
+        tokens = _prompt_from(payload, self._prompt_cap())
         from_pos = int(payload.get("from_pos", 1))
         with self._device_lock, self.logger.time(
             f"score[{tokens.shape[0]}x{tokens.shape[1]} from={from_pos}]"

@@ -252,6 +252,51 @@ class MeshConfig:
         return self.data * self.model * self.seq * self.pipe * self.expert
 
 
+@dataclass
+class ServingConfig:
+    """Inference-server scheduling knobs (``server/inference_server.py``).
+
+    ``max_slots`` caps the continuous-batching engine's concurrent rows
+    (the KV cache is allocated ``[max_slots, max_seq, ...]`` up front);
+    ``decode_chunk`` is how many tokens each device dispatch advances the
+    whole batch (amortizes the host round-trip floor; retirement and
+    admission happen at chunk boundaries, so it also bounds scheduling
+    latency in tokens). ``prefill_chunk`` optionally splits admission
+    prefill into fixed-size pieces so a long prompt cannot stall the
+    running batch for its full length. ``batch_window_s`` /
+    ``max_prompt_batch`` default to ``None`` = "use the module-level
+    constants at call time" (which existing tests monkeypatch).
+    """
+
+    max_slots: int = 8
+    decode_chunk: int = 8
+    prefill_chunk: Optional[int] = None
+    batch_window_s: Optional[float] = None
+    max_prompt_batch: Optional[int] = None
+
+    def validate(self) -> "ServingConfig":
+        if self.max_slots <= 0:
+            raise ValueError(f"max_slots must be positive, got {self.max_slots}")
+        if self.decode_chunk <= 0:
+            raise ValueError(
+                f"decode_chunk must be positive, got {self.decode_chunk}")
+        if self.prefill_chunk is not None and self.prefill_chunk <= 0:
+            raise ValueError(
+                f"prefill_chunk must be positive when set, got {self.prefill_chunk}")
+        if self.batch_window_s is not None and self.batch_window_s < 0:
+            raise ValueError(
+                f"batch_window_s must be >= 0 when set, got {self.batch_window_s}")
+        if self.max_prompt_batch is not None and self.max_prompt_batch <= 0:
+            raise ValueError(
+                f"max_prompt_batch must be positive when set, got {self.max_prompt_batch}")
+        return self
+
+
+def serving_config(overrides: Optional[Mapping[str, Any]] = None) -> ServingConfig:
+    """Validated inference-serving config (strict keys, like the rest)."""
+    return make_config(ServingConfig, overrides).validate()
+
+
 DEFAULT_CLIENT_HYPERPARAMS = ClientHyperparams()
 DEFAULT_SERVER_HYPERPARAMS = ServerHyperparams()
 DEFAULT_DATASET_CONFIG = DatasetConfig()

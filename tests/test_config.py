@@ -93,3 +93,31 @@ def test_dataset_config():
 def test_mesh_config_size():
     assert MeshConfig().size == 1
     assert MeshConfig(data=2, model=2, seq=2).size == 8
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        {"max_slots": 0},
+        {"decode_chunk": 0},
+        {"prefill_chunk": 0},
+        {"batch_window_s": -0.1},
+        {"max_prompt_batch": 0},
+    ],
+)
+def test_serving_validation(bad):
+    from distriflow_tpu.utils.config import serving_config
+
+    with pytest.raises(ValueError):
+        serving_config(bad)
+
+
+def test_serving_config_defaults_and_strict_keys():
+    from distriflow_tpu.utils.config import serving_config
+
+    cfg = serving_config({"max_slots": 16, "batch_window_s": 0.01})
+    assert cfg.max_slots == 16 and cfg.batch_window_s == 0.01
+    # None fields mean "use the server module's constants at call time"
+    assert cfg.prefill_chunk is None and cfg.max_prompt_batch is None
+    with pytest.raises(KeyError):
+        serving_config({"max_slotz": 4})

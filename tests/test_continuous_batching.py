@@ -1,0 +1,246 @@
+"""Continuous batching: iteration-level scheduling over the slotted KV cache.
+
+Pins the three contracts the engine makes (docs/PERFORMANCE.md §7e):
+
+- batched GREEDY output is bit-identical to a solo request, for ANY mix of
+  prompt lengths and budgets sharing the batch (row independence);
+- batched SAMPLED output is deterministic per (request, seed) regardless of
+  batch composition (per-row keys fold the seed with the row's own
+  absolute position — nothing about the neighbours enters the stream);
+- a client that disconnects mid-decode has its slot retired at the next
+  chunk boundary instead of holding capacity until the budget runs out.
+
+Everything here runs on a tiny CPU transformer and is deliberately NOT in
+conftest's slow set: tier-1 exercises the scheduler on every run.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distriflow_tpu.client import InferenceClient
+from distriflow_tpu.models import generate
+from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+from distriflow_tpu.server import InferenceServer
+from distriflow_tpu.utils.config import ServingConfig, serving_config
+
+pytestmark = pytest.mark.serve
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=48,
+    dtype=jnp.float32, use_flash_attention=False,
+)
+
+
+@pytest.fixture(scope="module")
+def served():
+    spec = transformer_lm(CFG, example_seq=16)
+    params = spec.init(jax.random.PRNGKey(0))
+    server = InferenceServer(
+        CFG, params, port=0,
+        # wide window so concurrent test requests share one admission;
+        # chunk=4 so short budgets still cross several chunk boundaries
+        serving=ServingConfig(batch_window_s=0.25, decode_chunk=4),
+    ).setup()
+    yield server, params
+    server.stop()
+
+
+def _concurrent(server, calls):
+    """Fire len(calls) clients through a barrier; return results in order."""
+    results = [None] * len(calls)
+    errors = []
+    barrier = threading.Barrier(len(calls))
+
+    def run(i, kwargs):
+        try:
+            with InferenceClient(server.address).setup() as c:
+                barrier.wait()
+                results[i] = c.generate(**kwargs)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=run, args=(i, kw)) for i, kw in enumerate(calls)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    return results
+
+
+def test_smoke_scheduler_serves_sequentially(served):
+    """Fast smoke: the engine path answers plain requests correctly and
+    leaves no slot occupied afterwards."""
+    server, params = served
+    with InferenceClient(server.address).setup() as c:
+        for p_len, n in ((1, 3), (5, 7), (3, 1)):
+            prompt = np.arange(p_len, dtype=np.int32)[None, :] % 64
+            out = c.generate(prompt, n_tokens=n)
+            want = np.asarray(generate(CFG, params, jnp.asarray(prompt), n))
+            np.testing.assert_array_equal(out, want)
+            assert c.last_serving_meta["path"] == "slots"
+    assert all(r is None for r in server._slot_req)  # everything retired
+
+
+def test_mixed_length_greedy_bit_parity(served):
+    """The headline tentpole property: requests with DIFFERENT prompt
+    lengths and budgets share decode iterations, and each still gets the
+    bit-exact solo answer."""
+    server, params = served
+    rs = np.random.RandomState(7)
+    shapes = [(1, 3), (4, 8), (2, 5), (7, 6), (3, 10), (6, 4)]
+    calls, expected = [], []
+    for p_len, n in shapes:
+        prompt = rs.randint(0, 64, size=(1, p_len)).astype(np.int32)
+        calls.append(dict(prompt=prompt, n_tokens=n))
+        expected.append(np.asarray(generate(CFG, params, jnp.asarray(prompt), n)))
+    r0 = server.batched_requests
+    results = _concurrent(server, calls)
+    for got, want in zip(results, expected):
+        np.testing.assert_array_equal(got, want)
+    assert server.batched_requests - r0 == len(calls)  # all rode the engine
+
+
+def test_sampled_determinism_independent_of_batch_composition(served):
+    """Same (request, seed) -> same tokens whether the request decodes
+    alone or wedged between unrelated greedy traffic."""
+    server, _ = served
+    rs = np.random.RandomState(3)
+    prompt = rs.randint(0, 64, size=(1, 4)).astype(np.int32)
+    kwargs = dict(prompt=prompt, n_tokens=9, temperature=0.9, top_k=12,
+                  top_p=0.95, seed=42)
+    with InferenceClient(server.address).setup() as c:
+        alone = c.generate(**kwargs)
+    noise = [
+        dict(prompt=rs.randint(0, 64, size=(1, p)).astype(np.int32), n_tokens=n)
+        for p, n in ((2, 12), (6, 5), (3, 8))
+    ]
+    crowded = _concurrent(server, [kwargs] + noise)[0]
+    np.testing.assert_array_equal(alone, crowded)
+    # and a different seed diverges (sanity that sampling is live)
+    with InferenceClient(server.address).setup() as c:
+        other = c.generate(**{**kwargs, "seed": 43})
+    assert other.shape == alone.shape
+
+
+def test_disconnect_mid_decode_retires_slot():
+    """A client that drops mid-decode must not hold its slot until the
+    budget runs out: the transport's disconnect callback cancels the
+    request and the scheduler retires the row at the next chunk boundary —
+    the same connection-loss path the chaos plan's ``reset`` action tears."""
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=256, dtype=jnp.float32, use_flash_attention=False,
+    )
+    spec = transformer_lm(cfg, example_seq=16)
+    params = spec.init(jax.random.PRNGKey(1))
+    server = InferenceServer(
+        cfg, params, port=0,
+        serving=serving_config({"decode_chunk": 1}),  # boundary every token
+    ).setup()
+    try:
+        client = InferenceClient(server.address).setup()
+        prompt = np.asarray([[1, 2, 3]], np.int32)
+        done = threading.Event()
+
+        def fire():
+            try:
+                client.generate(prompt, n_tokens=250)  # ~250 iterations
+            except Exception:
+                pass  # the disconnect below kills the ack path
+            finally:
+                done.set()
+
+        t = threading.Thread(target=fire)
+        t.start()
+        deadline = time.monotonic() + 30
+        while server.batched_requests == 0 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert server.batched_requests, "request was never admitted"
+        # freeze the engine at a chunk boundary, then yank the connection
+        with server._device_lock:
+            client.close()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with server._inflight_lock:
+                    reqs = [r for lst in server._inflight.values() for r in lst]
+                if not reqs or all(r.cancelled for r in reqs):
+                    break
+                time.sleep(0.002)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if all(r is None for r in server._slot_req):
+                break
+            time.sleep(0.005)
+        assert all(r is None for r in server._slot_req), "slot never retired"
+        t.join(timeout=30)
+        # capacity is genuinely free again: a fresh client gets served
+        with InferenceClient(server.address).setup() as c2:
+            out = c2.generate(prompt, n_tokens=4)
+            want = np.asarray(generate(cfg, params, jnp.asarray(prompt), 4))
+            np.testing.assert_array_equal(out, want)
+    finally:
+        server.stop()
+
+
+def test_oversized_batch_falls_back_to_direct_path(served):
+    """A prompt with more rows than max_slots cannot fit the engine; it is
+    served by the solo path and says so in the ack metadata."""
+    server, params = served
+    rows = server.serving.max_slots + 1
+    prompt = np.tile(np.asarray([[2, 4, 6]], np.int32), (rows, 1))
+    with InferenceClient(server.address).setup() as c:
+        out = c.generate(prompt, n_tokens=3)
+        assert c.last_serving_meta["path"] == "direct"
+    want = np.asarray(generate(CFG, params, jnp.asarray(prompt), 3))
+    np.testing.assert_array_equal(out, want)
+
+
+def test_serving_metrics_surface(served):
+    """The obs registry sees the engine: counters move, the occupancy
+    gauge returns to zero, and the histograms record observations."""
+    from distriflow_tpu.obs import get_telemetry
+
+    server, _ = served
+    tel = get_telemetry()
+    c0 = tel.counter_value("serving_decode_batches_total")
+    with InferenceClient(server.address).setup() as c:
+        c.generate(np.asarray([[9, 8]], np.int32), n_tokens=6)
+    snap = tel.snapshot()
+    assert tel.counter_value("serving_decode_batches_total") > c0
+    assert tel.counter_value("serving_batched_requests_total") >= 1
+    assert tel.counter_value("serving_tokens_generated_total") >= 6
+    assert snap["gauges"]["serving_slots_active"] == 0
+    assert "serving_queue_wait_ms" in snap["histograms"]
+    assert "serving_time_per_output_token_ms" in snap["histograms"]
+
+
+def test_int8_kv_auto_gates_below_latency_crossover():
+    """Satellite of the serving PR: plain "int8" resolves to the bf16 cache
+    below INT8_KV_DECODE_CROSSOVER_SEQ (where dequant overhead loses to
+    HBM savings — measured crossover in docs/PERFORMANCE.md), stays
+    quantized at/above it, and "int8_force" always quantizes."""
+    import dataclasses
+
+    from distriflow_tpu.models.transformer import (
+        INT8_KV_DECODE_CROSSOVER_SEQ,
+        TransformerConfig,
+    )
+
+    short = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    assert short.resolved_kv_cache_dtype is None  # auto-gated to bf16
+    longctx = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=INT8_KV_DECODE_CROSSOVER_SEQ, kv_cache_dtype="int8",
+    )
+    assert longctx.resolved_kv_cache_dtype == "int8"
+    forced = dataclasses.replace(CFG, kv_cache_dtype="int8_force")
+    assert forced.resolved_kv_cache_dtype == "int8"
+    assert CFG.resolved_kv_cache_dtype is None

@@ -160,14 +160,19 @@ def test_concurrent_greedy_requests_micro_batch(served):
         srv_mod.BATCH_WINDOW_S = old_window
 
 
-def test_sampled_requests_not_batched(served):
-    """temperature>0 keeps the serialized path (per-seed determinism)."""
+def test_sampled_requests_ride_the_engine(served):
+    """temperature>0 now batches through the slot engine (per-row keys are
+    folded from the request seed, so the per-seed determinism contract
+    survives batching) — and the engine, not the solo path, serves it."""
     server, client, _ = served
-    b0 = server.decode_batches
+    r0 = server.batched_requests
     prompt = np.asarray([[4, 5]], np.int32)
     out = client.generate(prompt, n_tokens=4, temperature=0.7, seed=11)
+    again = client.generate(prompt, n_tokens=4, temperature=0.7, seed=11)
     assert out.shape == (1, 6)
-    assert server.decode_batches == b0  # batcher untouched
+    np.testing.assert_array_equal(out, again)  # same seed -> same tokens
+    assert server.batched_requests - r0 == 2  # engine path, not direct
+    assert client.last_serving_meta["path"] == "slots"
 
 
 def test_enqueue_after_stop_errors_immediately():

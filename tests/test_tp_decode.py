@@ -85,7 +85,7 @@ def test_tp_flash_decode_token_for_token(tp_setup):
 
     params, params_tp, _ = tp_setup
     prompt = _prompt(seed=7)
-    for kv in (None, "int8"):
+    for kv in (None, "int8_force"):
         cfg = dataclasses.replace(CFG, use_flash_decode=True,
                                   kv_cache_dtype=kv)
         ref = np.asarray(generate(cfg, params, prompt, 8))
