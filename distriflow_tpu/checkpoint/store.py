@@ -41,6 +41,7 @@ from distriflow_tpu.utils.serialization import (
 CURRENT = "current"
 DATA_BIN = "data.bin"
 META_JSON = "meta.json"
+MANIFEST_JSON = "manifest.json"
 
 
 _version_lock = threading.Lock()
@@ -93,11 +94,20 @@ class CheckpointStore:
         tree: Any,
         version: Optional[str] = None,
         extra_meta: Optional[Dict[str, Any]] = None,
+        manifest: Optional[Dict[str, Any]] = None,
     ) -> str:
         """Write ``tree`` as a new version; returns the version string.
 
         Atomic: writes to a tmp dir then renames into place, then swaps the
         ``current`` symlink (force-symlink semantics, ``models.ts:17-30``).
+
+        ``manifest`` is an optional JSON-able dict written as
+        ``manifest.json`` inside the version directory BEFORE the publish
+        rename — so the params and the manifest land (or don't) as one
+        atomic unit. Servers persist their training-plane state this way
+        (dataset cursor, version clock, dedup keys; see
+        ``docs/ROBUSTNESS.md`` §8): a crash between two saves rolls both
+        the weights and the bookkeeping back to the same consistent pair.
         """
         version = version if version is not None else _timestamp_version()
         host_tree = jax.tree.map(np.asarray, tree)  # device -> host once
@@ -110,6 +120,9 @@ class CheckpointStore:
                 f.write(blob)
             with open(os.path.join(tmp_dir, META_JSON), "w") as f:
                 json.dump(meta, f)
+            if manifest is not None:
+                with open(os.path.join(tmp_dir, MANIFEST_JSON), "w") as f:
+                    json.dump(manifest, f)
             self._publish_dir(tmp_dir, version)
         except BaseException:
             shutil.rmtree(tmp_dir, ignore_errors=True)
@@ -179,7 +192,11 @@ class CheckpointStore:
 
     def _force_symlink(self, version: str) -> None:
         link = os.path.join(self.save_dir, CURRENT)
-        tmp_link = link + ".tmp"
+        # per-caller-unique staging name: concurrent publishers (federated
+        # aggregation racing a drill/teardown save) must not collide on a
+        # shared "current.tmp" — with one shared name, both can pass an
+        # exists-check and the second symlink() raises FileExistsError
+        tmp_link = f"{link}.tmp-{os.getpid()}-{threading.get_ident()}"
         if os.path.lexists(tmp_link):
             os.remove(tmp_link)
         os.symlink(version, tmp_link)
@@ -192,8 +209,8 @@ class CheckpointStore:
         out = []
         for name in os.listdir(self.save_dir):
             path = os.path.join(self.save_dir, name)
-            if name == CURRENT or name.startswith("."):
-                continue
+            if name == CURRENT or name.startswith(".") or name.startswith(CURRENT + "."):
+                continue  # pointer, tmp/trash dirs, or a crashed staging link
             if os.path.isdir(path) and os.path.exists(os.path.join(path, META_JSON)):
                 out.append(name)
         # numeric versions (timestamps, step counters) order numerically so
@@ -233,3 +250,12 @@ class CheckpointStore:
     def meta(self, version: str) -> Dict[str, Any]:
         with open(os.path.join(self.save_dir, version, META_JSON)) as f:
             return json.load(f).get("extra", {})
+
+    def load_manifest(self, version: str) -> Optional[Dict[str, Any]]:
+        """The training-state manifest saved with ``version``, or None when
+        the checkpoint predates manifests (or none was supplied)."""
+        path = os.path.join(self.save_dir, version, MANIFEST_JSON)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)

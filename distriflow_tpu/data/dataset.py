@@ -159,12 +159,21 @@ class DistributedDataset:
             self._outstanding.clear()
             self._unserved = self._epoch_order()
 
-    def complete_batch(self, index: int) -> None:
-        """Ack a batch (reference ``completeBatch``, ``dataset.ts:43-45``)."""
+    def complete_batch(self, index: int) -> bool:
+        """Ack a batch (reference ``completeBatch``, ``dataset.ts:43-45``).
+
+        Returns True iff this was the FIRST completion of the batch this
+        epoch. Servers gate the gradient apply on it: with speculative
+        re-dispatch (lease expiry) or duplicate completion by a second
+        client, only the first ack's gradient lands — first-wins
+        arbitration, at-most-once apply per batch.
+        """
         with self._cond:
+            first = index in self._incomplete
             self._incomplete.discard(index)
             self._outstanding.discard(index)
             self._cond.notify_all()
+        return first
 
     def requeue(self, index: int) -> None:
         """Return an un-acked batch to the queue (worker failure/timeout path).
@@ -179,6 +188,52 @@ class DistributedDataset:
                 self._outstanding.discard(index)
                 self._unserved.append(index)
                 self._cond.notify_all()
+
+    # -- crash-consistent recovery ----------------------------------------
+
+    def state(self) -> Dict[str, Any]:
+        """Snapshot of the dispatch cursor for a training-state manifest.
+
+        Captures everything a restarted server needs to resume mid-epoch:
+        the epoch, which batches are still un-acked, and which of those
+        were outstanding (dispatched, awaiting ack) at snapshot time.
+        JSON-able by construction (see ``CheckpointStore.save(manifest=)``).
+        """
+        with self._lock:
+            return {
+                "epoch": int(self.epoch),
+                "num_batches": int(self.num_batches),
+                "incomplete": sorted(int(b) for b in self._incomplete),
+                "outstanding": sorted(int(b) for b in self._outstanding),
+                "exhausted": bool(self.exhausted),
+            }
+
+    def restore_state(self, state: Dict[str, Any]) -> int:
+        """Resume from a :meth:`state` snapshot; returns how many batches
+        were requeued.
+
+        Formerly-outstanding batches go back into the serve queue — their
+        holders' dispatch records died with the old server process, so they
+        are re-served like any other un-acked work (at-least-once; the
+        manifest's dedup keys and first-wins completion keep the APPLY
+        exactly-once, see ``docs/ROBUSTNESS.md`` §8).
+        """
+        if int(state["num_batches"]) != self.num_batches:
+            raise ValueError(
+                f"manifest was cut for {state['num_batches']} batches but this "
+                f"dataset has {self.num_batches} — not the same data/config"
+            )
+        with self._cond:
+            self.epoch = int(state["epoch"])
+            self._incomplete = {int(b) for b in state["incomplete"]}
+            requeued = [int(b) for b in state.get("outstanding", ())]
+            self._outstanding = set()
+            # re-serve every un-acked batch in epoch order; the requeued
+            # (formerly outstanding) ones ride the same queue
+            self._unserved = [i for i in self._epoch_order() if i in self._incomplete]
+            self.exhausted = bool(state.get("exhausted", False))
+            self._cond.notify_all()
+        return len(requeued)
 
     @property
     def incomplete_batches(self) -> Set[int]:

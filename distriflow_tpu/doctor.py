@@ -19,8 +19,16 @@ silent socket.io hang). Checks, in order:
    ``frames_seen`` totals, at least one upload trace must span the
    reconnect, and every apply span must link to a client upload trace
    (see ``docs/OBSERVABILITY.md``);
-8. native C++ host library presence (optional — numpy fallback is fine);
-9. checkpoint write/read round trip in a temp dir.
+8. kill-and-resume recovery drill: an async training run hard-stopped at
+   a (seeded-)random mid-run point, restarted as a fresh server on the
+   same ``save_dir``; the manifest restores the dataset cursor/version
+   clock/dedup keys and the drill asserts exactly-once batch accounting
+   end-to-end (see ``docs/ROBUSTNESS.md`` §8);
+9. straggler drill: one artificially slow client, a short batch lease —
+   the run must complete via speculative re-dispatch and the straggler's
+   late gradient must be suppressed by first-wins arbitration;
+10. native C++ host library presence (optional — numpy fallback is fine);
+11. checkpoint write/read round trip in a temp dir.
 
 Exit code 0 when every mandatory check passes; each check prints
 ``ok``/``FAIL`` with a one-line detail, so CI and humans read the same
@@ -32,6 +40,52 @@ from __future__ import annotations
 import sys
 import tempfile
 import time
+
+
+def _tiny_model_cls():
+    """Protocol-level fake model (fixed 'gradients', no ML) shared by the
+    chaos self-test and the recovery/straggler drills. Built lazily so
+    importing the doctor never imports numpy-heavy deps."""
+    import numpy as np
+
+    from distriflow_tpu.models.base import DistributedModel
+
+    class TinyModel(DistributedModel):
+        def __init__(self):
+            self._params = {"w": np.ones((4,), np.float32)}
+
+        def setup(self):
+            pass
+
+        def fit(self, x, y):
+            return {"w": np.full((4,), 0.1, np.float32)}
+
+        def update(self, grads):
+            self._params = {
+                "w": np.asarray(self._params["w"] - grads["w"], np.float32)
+            }
+
+        def predict(self, x):
+            return np.zeros((len(x), 2), np.float32)
+
+        def evaluate(self, x, y):
+            return [0.0]
+
+        def get_params(self):
+            return self._params
+
+        def set_params(self, params):
+            self._params = {k: np.asarray(v, np.float32) for k, v in params.items()}
+
+        @property
+        def input_shape(self):
+            return (1,)
+
+        @property
+        def output_shape(self):
+            return (2,)
+
+    return TinyModel
 
 
 def _check(name: str, fn, mandatory: bool = True) -> bool:
@@ -128,50 +182,13 @@ def main() -> int:
         from distriflow_tpu.client.async_client import AsynchronousSGDClient
         from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
         from distriflow_tpu.data.dataset import DistributedDataset
-        from distriflow_tpu.models.base import DistributedModel
         from distriflow_tpu.obs import Telemetry
         from distriflow_tpu.server.abstract_server import DistributedServerConfig
         from distriflow_tpu.server.async_server import AsynchronousSGDServer
         from distriflow_tpu.server.models import DistributedServerInMemoryModel
         from distriflow_tpu.utils.config import RetryPolicy
 
-        class TinyModel(DistributedModel):
-            """Protocol-level fake: fixed 'gradients', no ML."""
-
-            def __init__(self):
-                self._params = {"w": np.ones((4,), np.float32)}
-
-            def setup(self):
-                pass
-
-            def fit(self, x, y):
-                return {"w": np.full((4,), 0.1, np.float32)}
-
-            def update(self, grads):
-                self._params = {
-                    "w": np.asarray(self._params["w"] - grads["w"], np.float32)
-                }
-
-            def predict(self, x):
-                return np.zeros((len(x), 2), np.float32)
-
-            def evaluate(self, x, y):
-                return [0.0]
-
-            def get_params(self):
-                return self._params
-
-            def set_params(self, params):
-                self._params = {k: np.asarray(v, np.float32) for k, v in params.items()}
-
-            @property
-            def input_shape(self):
-                return (1,)
-
-            @property
-            def output_shape(self):
-                return (2,)
-
+        TinyModel = _tiny_model_cls()
         x = np.arange(8, dtype=np.float32).reshape(8, 1)
         y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
         dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
@@ -299,6 +316,196 @@ def main() -> int:
 
     ok &= _check("telemetry reconciliation (snapshot vs FaultPlan)",
                  telemetry_reconciliation)
+
+    def kill_and_resume():
+        """Hard-stop an async training run at a seeded-random mid-run point,
+        restart a FRESH server (new object, fresh dataset instance — the
+        in-process stand-in for a new process) on the same save_dir, and
+        assert exactly-once batch accounting end-to-end: the manifest
+        restores the dataset cursor, version clock, and dedup keys, the
+        outstanding batch is requeued, and the cumulative applied count
+        equals the batch count exactly — none lost, none double-applied."""
+        import random
+
+        import numpy as np
+
+        from distriflow_tpu.client.abstract_client import DistributedClientConfig
+        from distriflow_tpu.client.async_client import AsynchronousSGDClient
+        from distriflow_tpu.data.dataset import DistributedDataset
+        from distriflow_tpu.obs import Telemetry
+        from distriflow_tpu.server.abstract_server import DistributedServerConfig
+        from distriflow_tpu.server.async_server import AsynchronousSGDServer
+        from distriflow_tpu.utils.config import RetryPolicy
+
+        TinyModel = _tiny_model_cls()
+        n_batches = 8
+        x = np.arange(2 * n_batches, dtype=np.float32).reshape(-1, 1)
+        y = np.eye(2, dtype=np.float32)[np.arange(len(x)) % 2]
+        tel = Telemetry()
+
+        def make_server(dataset, port):
+            # a BARE model: auto-wrapped into a checkpointed server model on
+            # save_dir, which is what persists+restores the manifest
+            return AsynchronousSGDServer(
+                TinyModel(),
+                dataset,
+                DistributedServerConfig(
+                    save_dir=d, port=port, max_checkpoints=3,
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+                    telemetry=tel,
+                ),
+            )
+
+        with tempfile.TemporaryDirectory() as d:
+            ds1 = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+            server1 = make_server(ds1, 0)
+            server1.setup()
+            port = server1.transport.port
+            client = AsynchronousSGDClient(
+                server1.address,
+                TinyModel(),
+                DistributedClientConfig(
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=1.0,
+                    upload_timeout_s=2.0,
+                    upload_retry=RetryPolicy(
+                        max_retries=8, initial_backoff_s=0.05,
+                        max_backoff_s=0.5, seed=7,
+                    ),
+                    reconnect_retry=RetryPolicy(
+                        max_retries=10, initial_backoff_s=0.1,
+                        max_backoff_s=1.0, seed=7,
+                    ),
+                    telemetry=tel,
+                ),
+            )
+            server2 = None
+            kill_at = random.Random(0xD0C).randint(2, n_batches - 3)
+            try:
+                client.setup(timeout=10.0)
+                deadline = time.monotonic() + 30.0
+                while (server1.applied_updates < kill_at
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+                assert server1.applied_updates >= kill_at, (
+                    f"never reached the kill point ({server1.applied_updates}"
+                    f"/{kill_at} applied)"
+                )
+                server1.stop()  # hard kill: NOTHING copied to the new server
+                # fresh dataset + fresh server = what a new process sees;
+                # every bit of resume state must come from the manifest
+                ds2 = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+                server2 = make_server(ds2, port)
+                server2.setup()
+                assert server2.recovered, "manifest not restored"
+                client.train_until_complete(timeout=60.0)
+            finally:
+                client.dispose()
+                if server2 is not None:
+                    server2.stop()
+            assert ds2.exhausted, "restored dataset never exhausted"
+            # applied_updates is cumulative across incarnations (restored
+            # from the manifest): exactly one apply per batch, ever
+            assert server2.applied_updates == n_batches, (
+                f"exactly-once violated: {server2.applied_updates} applies "
+                f"for {n_batches} batches (rejected {server2.rejected_updates}, "
+                f"suppressed {server2.suppressed_uploads})"
+            )
+            assert server2.rejected_updates == 0, (
+                f"{server2.rejected_updates} updates rejected across restart"
+            )
+            assert tel.counter_value("server_recoveries_total") == 1
+            return (f"killed after {server1.applied_updates} applies, resumed "
+                    f"from manifest, {server2.applied_updates}/{n_batches} "
+                    f"batches applied exactly once "
+                    f"(dedup hits {server2.duplicate_uploads + server1.duplicate_uploads})")
+
+    ok &= _check("kill-and-resume recovery drill", kill_and_resume)
+
+    def straggler():
+        """One artificially slow client: its batch lease expires, the batch
+        is speculatively re-dispatched to the fast client, the run completes
+        without the straggler, and the straggler's late upload is suppressed
+        by first-wins arbitration."""
+        import numpy as np
+
+        from distriflow_tpu.client.abstract_client import DistributedClientConfig
+        from distriflow_tpu.client.async_client import AsynchronousSGDClient
+        from distriflow_tpu.data.dataset import DistributedDataset
+        from distriflow_tpu.obs import Telemetry
+        from distriflow_tpu.server.abstract_server import DistributedServerConfig
+        from distriflow_tpu.server.async_server import AsynchronousSGDServer
+        from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+        TinyModel = _tiny_model_cls()
+
+        class SlowFirstFit(TinyModel):
+            """Straggles on its first batch only — long enough to lose the
+            race, short enough that its late upload lands in-drill."""
+
+            def fit(self, x, y):
+                if not getattr(self, "_straggled", False):
+                    self._straggled = True
+                    time.sleep(1.5)
+                return super().fit(x, y)
+
+        n_batches = 8
+        x = np.arange(2 * n_batches, dtype=np.float32).reshape(-1, 1)
+        y = np.eye(2, dtype=np.float32)[np.arange(len(x)) % 2]
+        dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+        tel = Telemetry()
+        server = AsynchronousSGDServer(
+            DistributedServerInMemoryModel(TinyModel()),
+            dataset,
+            DistributedServerConfig(
+                batch_lease_s=0.3,
+                heartbeat_interval_s=0.1, heartbeat_timeout_s=10.0,
+                telemetry=tel,
+            ),
+        )
+        server.setup()
+        fast = slow = None
+        try:
+            def mk(model):
+                return AsynchronousSGDClient(
+                    server.address, model,
+                    DistributedClientConfig(
+                        heartbeat_interval_s=0.1, heartbeat_timeout_s=10.0,
+                        upload_timeout_s=5.0, telemetry=tel,
+                    ),
+                )
+
+            slow = mk(SlowFirstFit())
+            slow.setup(timeout=10.0)
+            fast = mk(TinyModel())
+            fast.setup(timeout=10.0)
+            fast.train_until_complete(timeout=30.0)
+            # the straggler's late upload arrives ~1.5 s in; wait for the
+            # suppression to be recorded before asserting
+            deadline = time.monotonic() + 10.0
+            while (server.suppressed_uploads < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+        finally:
+            for c in (fast, slow):
+                if c is not None:
+                    c.dispose()
+            server.stop()
+        assert dataset.exhausted, "run did not complete"
+        assert server.lease_expirations >= 1, "no lease expired"
+        assert tel.counter_value("server_lease_expirations_total") >= 1
+        assert server.suppressed_uploads >= 1, (
+            "straggler's late gradient was not suppressed"
+        )
+        assert server.applied_updates == n_batches, (
+            f"exactly-once violated: {server.applied_updates} applies "
+            f"for {n_batches} batches"
+        )
+        return (f"run completed without the straggler "
+                f"({server.lease_expirations} lease expirations, "
+                f"{server.suppressed_uploads} late upload(s) suppressed, "
+                f"{server.applied_updates}/{n_batches} applied exactly once)")
+
+    ok &= _check("straggler drill (lease re-dispatch + first-wins)", straggler)
 
     def native():
         from distriflow_tpu import native

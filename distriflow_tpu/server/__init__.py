@@ -13,6 +13,7 @@ from distriflow_tpu.server.models import (
     DistributedServerModel,
     is_server_model,
 )
+from distriflow_tpu.server.quarantine import GateVerdict, GradientGate
 
 __all__ = [
     "AbstractServer",
@@ -23,5 +24,7 @@ __all__ = [
     "DistributedServerCheckpointedModel",
     "DistributedServerInMemoryModel",
     "DistributedServerModel",
+    "GateVerdict",
+    "GradientGate",
     "is_server_model",
 ]

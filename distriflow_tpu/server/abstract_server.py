@@ -31,8 +31,10 @@ from distriflow_tpu.server.models import (
     DistributedServerModel,
     is_server_model,
 )
+from distriflow_tpu.server.quarantine import GradientGate
 from distriflow_tpu.utils.config import (
     ClientHyperparams,
+    QuarantinePolicy,
     ServerHyperparams,
     asdict,
     client_hyperparams,
@@ -67,6 +69,17 @@ class DistributedServerConfig:
     # for duplicate suppression; sized >> the number of uploads any client
     # fleet can have in flight during one ack-timeout window
     dedup_cache_size: int = 1024
+    # straggler mitigation (async mode): seconds a dispatched batch is
+    # leased to its client before the server speculatively re-dispatches it
+    # to a parked client (backup-worker execution, Chen et al. 2016).
+    # First-wins arbitration at upload keeps the apply at-most-once even
+    # when the straggler eventually answers. 0 disables leases.
+    batch_lease_s: float = 0.0
+    # gradient quarantine (finiteness + norm-outlier gate before every
+    # apply, payload dumps under save_dir/quarantine/, post-apply rollback
+    # guard); None uses the default QuarantinePolicy — pass
+    # QuarantinePolicy(enabled=False) to switch the gate off entirely
+    quarantine: Optional[QuarantinePolicy] = None
     # fault injection (tests / chaos drills): consulted by the server's
     # per-client endpoints at every frame boundary
     fault_plan: Optional[FaultPlan] = None
@@ -122,7 +135,15 @@ class AbstractServer:
         self._g_version = self.telemetry.gauge("server_model_version")
         self._c_uploads = self.telemetry.counter("server_uploads_total")
         self._c_dedup = self.telemetry.counter("server_dedup_hits_total")
+        self._c_recoveries = self.telemetry.counter("server_recoveries_total")
         self.logger = VerboseLogger(type(self).__name__, self.config.verbose)
+        self.gate = GradientGate(
+            self.config.quarantine or QuarantinePolicy(),
+            save_dir=self.config.save_dir,
+            telemetry=self.telemetry,
+            log=self.logger.log,
+        )
+        self.recovered = False  # True when setup() resumed from a manifest
         self.callbacks = CallbackRegistry("new_version", "upload", "connect", "disconnect")
 
         self.num_clients = 0
@@ -182,8 +203,18 @@ class AbstractServer:
     # -- lifecycle ----------------------------------------------------------
 
     def setup(self) -> None:
+        # install the manifest provider BEFORE model.setup(): a fresh-init
+        # save inside setup() must already carry the (initial) manifest
+        if hasattr(self.model, "manifest_provider"):
+            self.model.manifest_provider = self._manifest
         with self.time("model setup"):
             self.model.setup()
+        manifest = getattr(self.model, "restored_manifest", None)
+        if manifest is not None and self._restore_manifest(manifest):
+            self.recovered = True
+            self._c_recoveries.inc()
+            self.log(f"recovered training state from manifest "
+                     f"(checkpoint version {self.model.version})")
         self.download_msg = self.compute_download_msg()
         self.transport.on_connect = self._on_connect
         self.transport.on_disconnect = self._on_disconnect
@@ -201,16 +232,23 @@ class AbstractServer:
     # -- hooks for subclasses ------------------------------------------------
 
     def _on_connect(self, client_id: str) -> None:
-        self.num_clients += 1
-        self._g_clients.set(self.num_clients)
-        self.log(f"connection: {self.num_clients} clients")
+        # counter mutation under the lock (the disconnect path races this
+        # on concurrent churn — unlocked, the server_connected_clients
+        # gauge could go negative); handlers run outside it
+        with self._lock:
+            self.num_clients += 1
+            n = self.num_clients
+        self._g_clients.set(n)
+        self.log(f"connection: {n} clients")
         self.callbacks.fire("connect", client_id)
         self.handle_connection(client_id)
 
     def _on_disconnect(self, client_id: str) -> None:
-        self.num_clients -= 1
-        self._g_clients.set(self.num_clients)
-        self.log(f"disconnection: {self.num_clients} clients")
+        with self._lock:
+            self.num_clients -= 1
+            n = self.num_clients
+        self._g_clients.set(n)
+        self.log(f"disconnection: {n} clients")
         self.callbacks.fire("disconnect", client_id)
         self.handle_disconnection(client_id)
 
@@ -278,6 +316,66 @@ class AbstractServer:
             with self._dedup_lock:
                 self._dedup_inflight.pop(uid, None)
             gate.set()
+
+    # -- crash-consistent recovery (docs/ROBUSTNESS.md §8) ------------------
+
+    #: bumped when the manifest layout changes incompatibly
+    MANIFEST_SCHEMA = 1
+
+    def _manifest(self) -> Dict[str, Any]:
+        """Training-state manifest saved atomically with every checkpoint.
+
+        Called by the checkpointed model inside ``save()`` — which runs
+        under ``self._lock`` in the apply paths, so implementations must
+        NOT re-acquire it (it is not reentrant). The base captures the
+        applied-``update_id`` dedup keys: a client retrying an upload
+        across a server restart is deduped from the restored manifest
+        instead of double-applying. Subclasses extend.
+        """
+        with self._dedup_lock:
+            applied = [[uid, self._jsonable_ack(res)]
+                       for uid, res in self._applied_ids.items()]
+        return {"schema": self.MANIFEST_SCHEMA, "applied_update_ids": applied}
+
+    def _restore_manifest(self, manifest: Dict[str, Any]) -> bool:
+        """Adopt a restored manifest (called from ``setup()`` before the
+        transport starts — single-threaded). Returns False when the
+        manifest cannot be honored (unknown schema) — subclasses must
+        propagate the refusal and restore NOTHING in that case."""
+        schema = manifest.get("schema")
+        if schema != self.MANIFEST_SCHEMA:
+            self.log(f"ignoring manifest with unknown schema {schema!r}")
+            return False
+        with self._dedup_lock:
+            self._applied_ids = collections.OrderedDict(
+                (str(uid), res) for uid, res in manifest.get("applied_update_ids", ())
+            )
+        return True
+
+    @staticmethod
+    def _jsonable_ack(result: Any) -> Any:
+        """Ack results ride the manifest; keep them JSON-able."""
+        return result if isinstance(result, (bool, int, float, str, type(None))) else True
+
+    def _note_applied_id(self, update_id: Optional[str], result: Any = True) -> None:
+        """Record an applied ``update_id`` in the dedup cache *before* the
+        checkpoint save that persists its gradient.
+
+        This is the crash-consistency linchpin: the manifest written by
+        that save must already list the update as applied — otherwise a
+        crash between save and the post-apply cache insert would let the
+        client's retry re-apply a gradient the restored params already
+        contain. ``_on_upload_wire`` re-inserts the same (uid, result)
+        afterwards, which is harmless.
+        """
+        if update_id is None:
+            return
+        with self._dedup_lock:
+            self._applied_ids[update_id] = result
+            while len(self._applied_ids) > self.config.dedup_cache_size:
+                self._applied_ids.popitem(last=False)
+
+    # -- subclass surface ---------------------------------------------------
 
     def handle_connection(self, client_id: str) -> None:
         raise NotImplementedError

@@ -21,9 +21,12 @@ reference lacks — lost batches there are only re-served on epoch wrap).
 from __future__ import annotations
 
 import collections
-from typing import Dict, Optional
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
 
 import jax
+import numpy as np
 
 from distriflow_tpu.data.dataset import DistributedDataset, batch_to_data_msg
 from distriflow_tpu.models.base import DistributedModel
@@ -58,12 +61,25 @@ class AsynchronousSGDServer(AbstractServer):
         self._h_staleness = self.telemetry.histogram("server_gradient_staleness")
         self._c_applied = self.telemetry.counter("server_updates_applied_total")
         self._c_rejected = self.telemetry.counter("server_updates_rejected_total")
+        self._c_lease_expired = self.telemetry.counter("server_lease_expirations_total")
+        self._c_suppressed = self.telemetry.counter("server_first_wins_suppressed_total")
+        self._c_requeued = self.telemetry.counter("server_recovery_requeued_total")
         self._client_versions: Dict[str, int] = {}
         self._client_batches: Dict[str, int] = {}  # outstanding batch per client
         self._waiting: set = set()  # starved clients awaiting redispatch
         self._completion_sent = False
         self.applied_updates = 0
         self.rejected_updates = 0
+        # straggler mitigation: client_id -> (batch, monotonic deadline);
+        # the monitor thread requeues expired leases for speculative
+        # re-dispatch (config.batch_lease_s > 0 enables)
+        self._lease_deadlines: Dict[str, Tuple[int, float]] = {}
+        self._lease_stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
+        self.lease_expirations = 0
+        # gradients suppressed by first-wins arbitration (their batch was
+        # already completed by another client — straggler's late answer)
+        self.suppressed_uploads = 0
         # reconnect reconciliation: model-version string -> the counter value
         # when that version was published. A gradient from a client that
         # reconnected mid-flight has no per-connection dispatch record, but
@@ -82,7 +98,20 @@ class AsynchronousSGDServer(AbstractServer):
 
     def setup(self) -> None:
         super().setup()
-        self._note_version_token()  # the initial weights are version 0
+        # the initial (or restored) weights map to the current counter value
+        self._note_version_token()
+        if self.config.batch_lease_s > 0:
+            self._lease_thread = threading.Thread(
+                target=self._lease_monitor, name="batch-lease-monitor", daemon=True
+            )
+            self._lease_thread.start()
+
+    def stop(self) -> None:
+        self._lease_stop.set()
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout=2.0)
+            self._lease_thread = None
+        super().stop()
 
     # -- dispatch ----------------------------------------------------------
 
@@ -108,6 +137,10 @@ class AsynchronousSGDServer(AbstractServer):
         with self._lock:
             self._client_batches[client_id] = batch.batch
             self._client_versions[client_id] = self.version_counter
+            if self.config.batch_lease_s > 0:
+                self._lease_deadlines[client_id] = (
+                    batch.batch, time.monotonic() + self.config.batch_lease_s
+                )
             self._waiting.discard(client_id)
         # the dispatch opens the update's trace: its trace_id rides the
         # download header, the client copies it into the resulting upload,
@@ -124,7 +157,26 @@ class AsynchronousSGDServer(AbstractServer):
                 trace_id=span.trace_id or None,
                 span_id=span.span_id or None,
             )
-            self.transport.emit_to(client_id, Events.Download.value, msg.to_wire())
+            try:
+                self.transport.emit_to(client_id, Events.Download.value, msg.to_wire())
+            except KeyError:
+                # the client disconnected between its upload-apply and this
+                # dispatch; un-claim the batch so it isn't lost until epoch
+                # wrap (mirror of the guarded trainingComplete path above).
+                # `owned` resolves the race with handle_disconnection: only
+                # whoever pops the dispatch record requeues.
+                with self._lock:
+                    owned = self._client_batches.get(client_id) == batch.batch
+                    if owned:
+                        self._client_batches.pop(client_id, None)
+                    self._client_versions.pop(client_id, None)
+                    self._lease_deadlines.pop(client_id, None)
+                    self._waiting.discard(client_id)
+                if owned:
+                    self.dataset.requeue(batch.batch)
+                    self.log(f"client {client_id[:8]} gone before dispatch; "
+                             f"requeued batch {batch.batch}")
+                return False
         return True
 
     def _dispatch_waiting(self) -> None:
@@ -154,6 +206,7 @@ class AsynchronousSGDServer(AbstractServer):
         with self._lock:
             outstanding = self._client_batches.pop(client_id, None)
             self._client_versions.pop(client_id, None)
+            self._lease_deadlines.pop(client_id, None)
             self._waiting.discard(client_id)
         if outstanding is not None:
             self.dataset.requeue(outstanding)
@@ -163,14 +216,30 @@ class AsynchronousSGDServer(AbstractServer):
     # -- upload ------------------------------------------------------------
 
     def handle_upload(self, client_id: str, msg: UploadMsg) -> bool:
+        first = True
         if msg.batch is not None:
-            self.dataset.complete_batch(msg.batch)  # ack first (reference :72)
+            # ack first (reference :72). `first` gates the apply: a batch
+            # completed by another client already — a speculative
+            # re-dispatch winner, or a duplicate completion — must not
+            # land its gradient twice (first-wins arbitration)
+            first = self.dataset.complete_batch(msg.batch)
             with self._lock:
                 if self._client_batches.get(client_id) == msg.batch:
                     self._client_batches.pop(client_id, None)
+                lease = self._lease_deadlines.get(client_id)
+                if lease is not None and lease[0] == msg.batch:
+                    self._lease_deadlines.pop(client_id, None)
         accepted = False
         if msg.gradients is not None:
-            accepted = self._apply(client_id, msg)
+            if first:
+                accepted = self._apply(client_id, msg)
+            else:
+                self.suppressed_uploads += 1
+                self._c_suppressed.inc()
+                self.log(
+                    f"suppressed gradient for batch {msg.batch} from "
+                    f"{msg.client_id}: already completed (first-wins)"
+                )
         # hand the next batch to THIS client only (fixed dispatch), then give
         # parked clients a chance at whatever the ack freed up
         self._send_next_batch(client_id)
@@ -208,16 +277,129 @@ class AsynchronousSGDServer(AbstractServer):
                 grads,
                 template,
             )
+            # quarantine gate: a non-finite or norm-outlier gradient is
+            # rejected BEFORE it can touch the canonical model, and its
+            # payload is dumped for postmortem (docs/ROBUSTNESS.md §8)
+            verdict = self.gate.check(grads)
+            if not verdict.ok:
+                self.rejected_updates += 1
+                self._c_rejected.inc()
+                self.log(f"quarantined update from {msg.client_id}: {verdict.reason}")
+                self.gate.quarantine(
+                    msg.gradients.vars, verdict.reason,
+                    client_id=msg.client_id, update_id=msg.update_id,
+                    batch=msg.batch, version=msg.gradients.version,
+                )
+                return False
             if decay != 1.0:
                 grads = jax.tree.map(lambda g: g * decay, grads)
             with self.time("updating model"):
+                if self.gate.active:
+                    # host-side snapshot for the rollback guard: the update
+                    # rule may mutate params in place
+                    prev = jax.tree.map(lambda a: np.array(a, copy=True), template)
                 self.model.update(grads)
-                self.model.save()  # reference saves every step (:105)
+                if self.gate.active and not self.gate.params_finite(
+                        self.model.get_params()):
+                    # rollback guard: the gradient passed the gate but the
+                    # update drove the PARAMS non-finite — restore and reject
+                    self.model.set_params(prev)
+                    self.rejected_updates += 1
+                    self._c_rejected.inc()
+                    self.gate.record_rollback()
+                    self.log(f"rolled back update from {msg.client_id}: "
+                             "params went non-finite")
+                    self.gate.quarantine(
+                        msg.gradients.vars, "post-apply-non-finite",
+                        client_id=msg.client_id, update_id=msg.update_id,
+                        batch=msg.batch, version=msg.gradients.version,
+                    )
+                    return False
+                self.gate.accept(verdict.norm)
+                # state mutations BEFORE save(): the manifest written by the
+                # save must describe the post-apply world (counter advanced,
+                # this update_id in the dedup keys, its batch completed) so a
+                # restart restores a consistent (params, bookkeeping) pair
                 self.version_counter += 1
                 self.applied_updates += 1
+                self._note_applied_id(msg.update_id)
+                self.model.save()  # reference saves every step (:105)
                 self._c_applied.inc()
                 self._g_version.set(self.version_counter)
                 self.download_msg = self.compute_download_msg()
                 self._note_version_token()
         self.callbacks.fire("new_version", self.model.version)
+        return True
+
+    # -- straggler mitigation (lease monitor) -------------------------------
+
+    def _lease_monitor(self) -> None:
+        """Backup-worker speculative execution (Chen et al. 2016): requeue
+        batches whose lease expired so a parked client can race the
+        straggler; first-wins arbitration in :meth:`handle_upload` keeps
+        the apply at-most-once whichever copy answers first."""
+        interval = max(0.02, min(0.5, self.config.batch_lease_s / 4.0))
+        while not self._lease_stop.wait(interval):
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for cid, (batch, deadline) in list(self._lease_deadlines.items()):
+                    if now >= deadline:
+                        # one expiry per dispatch: the straggler keeps its
+                        # dispatch record (its eventual upload still names
+                        # the batch), only the lease is retired
+                        self._lease_deadlines.pop(cid)
+                        expired.append((cid, batch))
+            for cid, batch in expired:
+                self.lease_expirations += 1
+                self._c_lease_expired.inc()
+                self.log(f"lease expired on batch {batch} held by {cid[:8]}; "
+                         "speculative re-dispatch")
+                self.dataset.requeue(batch)
+                self._dispatch_waiting()
+
+    # -- crash-consistent recovery ------------------------------------------
+
+    def _manifest(self) -> Dict[str, Any]:
+        """Base manifest (dedup keys) + the async training plane: dataset
+        cursor, version clock, and the apply/reject accounting. Runs under
+        ``self._lock`` when called from ``_apply``'s save — reads state
+        directly, never re-acquires it."""
+        m = super()._manifest()
+        m.update(
+            mode="async",
+            dataset=self.dataset.state(),
+            version_counter=self.version_counter,
+            version_tokens=[[v, c] for v, c in self._version_tokens.items()],
+            applied_updates=self.applied_updates,
+            rejected_updates=self.rejected_updates,
+            suppressed_uploads=self.suppressed_uploads,
+            lease_expirations=self.lease_expirations,
+            quarantined_updates=self.gate.quarantined_updates,
+        )
+        return m
+
+    def _restore_manifest(self, manifest: Dict[str, Any]) -> bool:
+        """Resume mid-epoch on a fresh server process: version clock and
+        token window back, counters cumulative across incarnations, and
+        every batch that was outstanding at save time requeued (its
+        holder's connection died with the old process)."""
+        if not super()._restore_manifest(manifest):
+            return False
+        self.version_counter = int(manifest.get("version_counter", 0))
+        self._version_tokens = collections.OrderedDict(
+            (str(v), int(c)) for v, c in manifest.get("version_tokens", ())
+        )
+        self.applied_updates = int(manifest.get("applied_updates", 0))
+        self.rejected_updates = int(manifest.get("rejected_updates", 0))
+        self.suppressed_uploads = int(manifest.get("suppressed_uploads", 0))
+        self.lease_expirations = int(manifest.get("lease_expirations", 0))
+        self._g_version.set(self.version_counter)
+        ds_state = manifest.get("dataset")
+        if ds_state is not None:
+            requeued = self.dataset.restore_state(ds_state)
+            if requeued:
+                self._c_requeued.inc(requeued)
+                self.log(f"requeued {requeued} outstanding batch(es) from "
+                         "the previous server incarnation")
         return True

@@ -18,7 +18,11 @@ from typing import Any, Dict, List, Optional
 
 from distriflow_tpu.server.abstract_server import AbstractServer
 from distriflow_tpu.utils.messages import Events, UploadMsg
-from distriflow_tpu.utils.serialization import SerializedArray, mean_serialized
+from distriflow_tpu.utils.serialization import (
+    SerializedArray,
+    deserialize_array,
+    mean_serialized,
+)
 
 
 class FederatedServer(AbstractServer):
@@ -64,6 +68,24 @@ class FederatedServer(AbstractServer):
                 self.log(f"dropping malformed upload from {msg.client_id}")
                 self.dropped_uploads += 1
                 return False
+            # quarantine gate at receipt: one NaN (or exploding) contribution
+            # buffered now would poison the whole aggregated round later —
+            # reject it alone, dump the payload for postmortem
+            if self.gate.active:
+                verdict = self.gate.check(
+                    {k: deserialize_array(s) for k, s in vars_.items()}
+                )
+                if not verdict.ok:
+                    self.dropped_uploads += 1
+                    self.log(f"quarantined upload from {msg.client_id}: "
+                             f"{verdict.reason}")
+                    self.gate.quarantine(
+                        vars_, verdict.reason,
+                        client_id=msg.client_id, update_id=msg.update_id,
+                        version=msg.gradients.version,
+                    )
+                    return False
+                self.gate.accept(verdict.norm)
             # decay folds into aggregation as a per-contribution weight
             # (mean_serialized(weights=...)) — no deserialize/re-serialize
             # round trip per decayed upload
@@ -129,9 +151,27 @@ class FederatedServer(AbstractServer):
             # host-side mean over zero-copy buffer views (C++ kernel when
             # built) — replaces the reference's byte-stack + device mean(0);
             # staleness decay rides in as per-contribution weights
-            mean_grads = mean_serialized(updates, self.model.get_params(),
-                                         weights=decays)
+            template = self.model.get_params()
+            mean_grads = mean_serialized(updates, template, weights=decays)
+            if self.gate.active:
+                import jax
+                import numpy as np
+
+                prev = jax.tree.map(lambda a: np.array(a, copy=True), template)
             self.model.update(mean_grads)
+            if self.gate.active and not self.gate.params_finite(
+                    self.model.get_params()):
+                # rollback guard: every contribution passed the gate, yet
+                # the aggregated step drove the params non-finite — restore
+                # the previous version and quarantine the aggregate
+                self.model.set_params(prev)
+                self.gate.record_rollback()
+                self.log("rolled back aggregated update: params went non-finite")
+                self.gate.quarantine(
+                    mean_grads, "post-apply-non-finite",
+                    contributions=len(updates), version=self.model.version,
+                )
+                return
             self.model.save()
             self.download_msg = self.compute_download_msg()
         self.callbacks.fire("new_version", self.model.version)

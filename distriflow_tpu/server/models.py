@@ -15,7 +15,7 @@ of the core model surface.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Protocol, runtime_checkable
+from typing import Any, Callable, Dict, List, Optional, Protocol, runtime_checkable
 
 from distriflow_tpu.checkpoint import CheckpointStore
 from distriflow_tpu.checkpoint.store import timestamp_version as _timestamp_version
@@ -97,6 +97,14 @@ class DistributedServerCheckpointedModel(DistributedServerInMemoryModel):
     Reference ``DistributedServerTfModel`` semantics (``models.ts:77-150``):
     ``setup()`` loads the newest checkpoint if one exists, else initializes
     fresh; ``save()`` writes ``save_dir/<version>/`` and swaps ``current``.
+
+    Crash-consistent recovery (beyond the reference, which persists ONLY
+    params): when a server installs a ``manifest_provider``, every save
+    also writes the provider's training-state manifest atomically inside
+    the version dir, and ``setup()`` exposes the restored checkpoint's
+    manifest as ``restored_manifest`` — a restarted server resumes the
+    dataset cursor, version clock, and dedup keys in lockstep with the
+    weights they were saved with (``docs/ROBUSTNESS.md`` §8).
     """
 
     def __init__(
@@ -107,6 +115,10 @@ class DistributedServerCheckpointedModel(DistributedServerInMemoryModel):
     ):
         super().__init__(model)
         self.store = CheckpointStore(save_dir, max_to_keep)
+        #: set by the owning server before setup(): () -> JSON-able dict
+        self.manifest_provider: Optional[Callable[[], Dict[str, Any]]] = None
+        #: manifest of the checkpoint setup() restored, None on fresh init
+        self.restored_manifest: Optional[Dict[str, Any]] = None
 
     def setup(self) -> None:
         self.model.setup()
@@ -114,15 +126,18 @@ class DistributedServerCheckpointedModel(DistributedServerInMemoryModel):
         if restored is not None:
             self.version, params = restored
             self.model.set_params(params)
+            self.restored_manifest = self.store.load_manifest(self.version)
         else:
             self.version = self.save()
 
     def save(self) -> str:
         self.version = _timestamp_version()
         spec_name = getattr(getattr(self.model, "spec", None), "name", None)
+        manifest = self.manifest_provider() if self.manifest_provider else None
         self.store.save(
             self.model.get_params(),
             version=self.version,
             extra_meta={"spec_name": spec_name},
+            manifest=manifest,
         )
         return self.version

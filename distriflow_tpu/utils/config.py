@@ -192,6 +192,40 @@ class ServerHyperparams:
 
 
 @dataclass
+class QuarantinePolicy:
+    """Gradient-quarantine gate for the wire-serving training servers.
+
+    One poisoned upload (NaN/inf from a diverged or buggy worker) applied
+    to the canonical model corrupts every subsequent broadcast — the
+    classic parameter-server failure (Li et al., OSDI 2014 §5.3). The gate
+    sits in front of every apply: non-finite gradients are rejected
+    outright, and a global-norm outlier (vs. an EMA of accepted norms) is
+    rejected once the EMA has seen ``warmup_updates`` accepted gradients.
+    Rejected payloads are dumped under ``save_dir/quarantine/`` for
+    postmortem (``docs/ROBUSTNESS.md`` §8). A post-apply rollback guard
+    restores the previous params if an update drove THEM non-finite.
+    """
+
+    enabled: bool = True
+    # reject when gradient global-norm > multiplier * EMA(accepted norms)
+    max_norm_multiplier: float = 10.0
+    ema_decay: float = 0.9
+    warmup_updates: int = 5  # no norm gating until the EMA is warm
+    dump: bool = True  # write rejected payloads to save_dir/quarantine/
+
+    def validate(self) -> "QuarantinePolicy":
+        if self.max_norm_multiplier <= 1.0:
+            raise ValueError(
+                f"max_norm_multiplier must be > 1, got {self.max_norm_multiplier}"
+            )
+        if not 0.0 < self.ema_decay < 1.0:
+            raise ValueError(f"ema_decay must be in (0, 1), got {self.ema_decay}")
+        if self.warmup_updates < 1:
+            raise ValueError(f"warmup_updates must be >= 1, got {self.warmup_updates}")
+        return self
+
+
+@dataclass
 class DatasetConfig:
     """Dataset sharding config (reference ``src/common/utils.ts:193-197``).
 

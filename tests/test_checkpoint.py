@@ -1,6 +1,7 @@
 """Checkpoint store tests: versioned dirs, current pointer, resume."""
 
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -96,3 +97,71 @@ def test_extra_meta(tmp_path):
     store = CheckpointStore(str(tmp_path))
     store.save(_tree(), version="9", extra_meta={"spec_name": "mnist_mlp", "note": "x"})
     assert store.meta("9")["note"] == "x"
+
+
+# -- retention + pointer robustness (save-per-update servers hammer these) --
+
+
+def test_prune_keeps_newest(tmp_path):
+    store = CheckpointStore(str(tmp_path), max_to_keep=3)
+    for i in range(1, 8):
+        store.save(_tree(i), version=str(i))
+    assert store.list() == ["5", "6", "7"]
+    assert store.last() == "7"
+    # _trash leaves no residue behind (tmp dirs, half-deleted versions)
+    assert not [n for n in os.listdir(str(tmp_path)) if n.startswith(".t")]
+
+
+def test_rapid_saves_current_always_loadable(tmp_path):
+    """Save-per-update cadence under tight retention: after every save the
+    ``current`` pointer must resolve to a complete, loadable checkpoint."""
+    store = CheckpointStore(str(tmp_path), max_to_keep=2)
+    for i in range(20):
+        store.save(_tree(i))
+        out = store.load(store.last(), _tree())
+        np.testing.assert_array_equal(out["step"], np.int32(i))
+    assert len(store.list()) == 2
+
+
+def test_concurrent_saves_thread_safe(tmp_path):
+    """Concurrent savers (the federated server's aggregation thread racing a
+    drill/teardown save): every publish succeeds and the final ``current``
+    target is complete."""
+    store = CheckpointStore(str(tmp_path), max_to_keep=3)
+    errors = []
+
+    def saver(seed):
+        try:
+            for i in range(8):
+                store.save(_tree(seed * 100 + i))
+        except Exception as e:  # noqa: BLE001 - recorded for the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=saver, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, f"concurrent saves failed: {errors}"
+    assert store.last() is not None
+    store.load(store.last(), _tree())  # complete and parseable
+    assert len(store.list()) <= 3 + 4  # pruning keeps up (races tolerated)
+
+
+def test_stale_current_symlink_falls_back(tmp_path):
+    """A ``current`` pointer naming a deleted/never-published version (crash
+    between rename and symlink swap, or external cleanup) must not wedge
+    resume: ``last()`` falls back to the newest listed version and the next
+    save repairs the pointer."""
+    store = CheckpointStore(str(tmp_path))
+    store.save(_tree(1), version="100")
+    store.save(_tree(2), version="200")
+    link = os.path.join(str(tmp_path), "current")
+    os.remove(link)
+    os.symlink("999", link)  # dangling: version 999 was never published
+    assert store.last() == "200"
+    version, out = store.restore_latest(_tree())
+    assert version == "200"
+    np.testing.assert_array_equal(out["step"], np.int32(2))
+    store.save(_tree(3), version="300")
+    assert os.readlink(link) == "300", "the next save must repair the pointer"

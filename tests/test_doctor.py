@@ -20,5 +20,6 @@ def test_doctor_passes_on_cpu():
     assert "all checks passed" in out.stdout
     for name in ("backend/devices", "mesh construction", "allreduce",
                  "train step", "wire transport", "chaos self-test",
-                 "checkpoint store"):
+                 "telemetry reconciliation", "kill-and-resume recovery drill",
+                 "straggler drill", "checkpoint store"):
         assert f"ok   {name}" in out.stdout, (name, out.stdout)

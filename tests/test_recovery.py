@@ -1,0 +1,533 @@
+"""Crash-consistent recovery, lease re-dispatch, and gradient quarantine.
+
+The training plane's restart contract (``docs/ROBUSTNESS.md`` §8): a
+training-state manifest rides every checkpoint atomically, a fresh server
+process on the same ``save_dir`` resumes mid-epoch with no batch lost and
+no gradient double-applied; expired batch leases are speculatively
+re-dispatched with first-wins arbitration; and a poisoned gradient is
+quarantined before it can touch the canonical model.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.checkpoint import CheckpointStore
+from distriflow_tpu.client.abstract_client import DistributedClientConfig
+from distriflow_tpu.client.async_client import AsynchronousSGDClient
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.obs import Telemetry
+from distriflow_tpu.server.abstract_server import DistributedServerConfig
+from distriflow_tpu.server.async_server import AsynchronousSGDServer
+from distriflow_tpu.server.federated_server import FederatedServer
+from distriflow_tpu.server.models import (
+    DistributedServerCheckpointedModel,
+    DistributedServerInMemoryModel,
+)
+from distriflow_tpu.server.quarantine import GradientGate
+from distriflow_tpu.utils.config import QuarantinePolicy, RetryPolicy
+from distriflow_tpu.utils.messages import GradientMsg, UploadMsg
+from distriflow_tpu.utils.serialization import serialize_tree
+from tests.mock_model import MockModel
+
+pytestmark = pytest.mark.recovery
+
+
+def _wait_for(cond, timeout=10.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _xy(n=16):
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    y = np.eye(2, dtype=np.float32)[np.arange(n) % 2]
+    return x, y
+
+
+def _client_config(**kw):
+    kw.setdefault("heartbeat_interval_s", 0.1)
+    kw.setdefault("heartbeat_timeout_s", 2.0)
+    kw.setdefault("upload_timeout_s", 2.0)
+    kw.setdefault(
+        "upload_retry",
+        RetryPolicy(max_retries=8, initial_backoff_s=0.05, max_backoff_s=0.5, seed=1),
+    )
+    kw.setdefault(
+        "reconnect_retry",
+        RetryPolicy(
+            max_retries=30, initial_backoff_s=0.1, max_backoff_s=0.3, jitter=0.2, seed=2
+        ),
+    )
+    return DistributedClientConfig(**kw)
+
+
+# -- dataset state snapshot / restore ---------------------------------------
+
+
+def test_dataset_state_roundtrip():
+    x, y = _xy(16)  # 8 batches of 2
+    ds = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    served = [ds.next(timeout=0.0) for _ in range(3)]
+    ds.complete_batch(served[0].batch)  # one acked, two outstanding
+    snap = ds.state()
+    assert snap["epoch"] == 0 and snap["num_batches"] == 8
+    assert len(snap["incomplete"]) == 7
+    assert sorted(b.batch for b in served[1:]) == snap["outstanding"]
+
+    fresh = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    requeued = fresh.restore_state(snap)
+    assert requeued == 2, "formerly-outstanding batches must be requeued"
+    assert fresh.outstanding_batches == set()
+    assert fresh.incomplete_batches == set(snap["incomplete"])
+    # the restored dataset re-serves exactly the un-acked work
+    got = []
+    while True:
+        b = fresh.next(timeout=0.0)
+        if b is None:
+            break
+        fresh.complete_batch(b.batch)
+        got.append(b.batch)
+    assert sorted(got) == snap["incomplete"]
+    assert fresh.exhausted
+
+
+def test_dataset_restore_rejects_mismatched_shape():
+    x, y = _xy(16)
+    ds = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    other = DistributedDataset(x, y, {"batch_size": 4, "epochs": 1})
+    with pytest.raises(ValueError, match="not the same data/config"):
+        other.restore_state(ds.state())
+
+
+def test_complete_batch_first_wins():
+    x, y = _xy(8)
+    ds = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    b = ds.next(timeout=0.0)
+    assert ds.complete_batch(b.batch) is True, "first completion wins"
+    assert ds.complete_batch(b.batch) is False, "second completion must lose"
+    # requeue after completion is a no-op (the ack already landed)
+    ds.requeue(b.batch)
+    assert b.batch not in ds.incomplete_batches
+
+
+# -- manifest rides the checkpoint atomically --------------------------------
+
+
+def test_manifest_saved_with_checkpoint(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    tree = {"w": np.arange(4, dtype=np.float32)}
+    v1 = store.save(tree, version="100")
+    v2 = store.save(tree, version="200", manifest={"schema": 1, "applied": ["u-1"]})
+    assert store.load_manifest(v1) is None, "no manifest supplied -> None"
+    assert store.load_manifest(v2) == {"schema": 1, "applied": ["u-1"]}
+    # the manifest lives INSIDE the version dir: published or absent with it
+    assert os.path.exists(os.path.join(str(tmp_path), "200", "manifest.json"))
+
+
+def test_checkpointed_model_restores_manifest(tmp_path):
+    m1 = DistributedServerCheckpointedModel(MockModel(), str(tmp_path))
+    m1.manifest_provider = lambda: {"schema": 1, "note": "mid-epoch"}
+    m1.setup()
+    m1.save()
+    assert m1.restored_manifest is None, "fresh init must not claim a restore"
+
+    m2 = DistributedServerCheckpointedModel(MockModel(), str(tmp_path))
+    m2.setup()
+    assert m2.restored_manifest == {"schema": 1, "note": "mid-epoch"}
+    assert m2.version == m1.version
+
+
+# -- the headline: kill the server, restart from the manifest ---------------
+
+
+class _SlowFitModel(MockModel):
+    """Per-batch compute delay so the kill reliably lands mid-training."""
+
+    def fit(self, x, y):
+        time.sleep(0.1)
+        return super().fit(x, y)
+
+
+def test_server_restart_resumes_exactly_once(tmp_path):
+    """Hard-kill an async server mid-run and restart a FRESH server (new
+    object, new dataset instance) on the same save_dir: the manifest alone
+    must restore the dataset cursor, version clock, and dedup keys, and the
+    cumulative applied count must equal the batch count exactly."""
+    x, y = _xy(16)  # 8 batches of 2
+    tel = Telemetry()
+
+    def make_server(dataset, port):
+        # a BARE model: auto-wrapped into a checkpointed server model, which
+        # is what persists + restores the manifest
+        return AsynchronousSGDServer(
+            MockModel(),
+            dataset,
+            DistributedServerConfig(
+                save_dir=str(tmp_path / "models"), port=port,
+                heartbeat_interval_s=0.1, heartbeat_timeout_s=0.5,
+                telemetry=tel,
+            ),
+        )
+
+    ds1 = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    server1 = make_server(ds1, 0)
+    server1.setup()
+    assert not server1.recovered, "empty save_dir must not claim a recovery"
+    port = server1.transport.port
+    client = AsynchronousSGDClient(
+        server1.address,
+        _SlowFitModel(),
+        _client_config(heartbeat_timeout_s=0.5, upload_timeout_s=1.0),
+    )
+    server2 = None
+    try:
+        client.setup(timeout=10.0)
+        assert _wait_for(lambda: server1.applied_updates >= 3, timeout=30.0)
+        server1.stop()  # hard kill: NOTHING is copied to the new server
+        applied_before = server1.applied_updates
+        ds2 = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+        server2 = make_server(ds2, port)
+        server2.setup()
+        assert server2.recovered, "manifest not restored"
+        # counters are cumulative across incarnations
+        assert server2.applied_updates == applied_before
+        assert server2.version_counter == applied_before
+        done = client.train_until_complete(timeout=60.0)
+    finally:
+        client.dispose()
+        if server2 is not None:
+            server2.stop()
+    assert ds2.exhausted
+    assert done >= 8, f"all 8 batches must be trained, got {done}"
+    # exactly-once apply across the restart: first-wins completion plus the
+    # manifest's restored dedup keys absorb every redelivery/retry
+    assert server2.applied_updates == 8, (
+        f"exactly-once violated: {server2.applied_updates} applies for 8 "
+        f"batches (rejected {server2.rejected_updates}, "
+        f"suppressed {server2.suppressed_uploads})"
+    )
+    assert server2.rejected_updates == 0
+    assert tel.counter_value("server_recoveries_total") == 1
+
+
+# -- lease-based straggler re-dispatch --------------------------------------
+
+
+class _SlowFirstFit(MockModel):
+    """Straggles on its first batch only."""
+
+    def fit(self, x, y):
+        if not getattr(self, "_straggled", False):
+            self._straggled = True
+            time.sleep(1.2)
+        return super().fit(x, y)
+
+
+def test_lease_expiry_redispatch_and_first_wins(tmp_path):
+    x, y = _xy(16)  # 8 batches of 2
+    dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    tel = Telemetry()
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        dataset,
+        DistributedServerConfig(
+            save_dir=str(tmp_path / "models"),
+            batch_lease_s=0.3,
+            heartbeat_interval_s=0.1, heartbeat_timeout_s=10.0,
+            telemetry=tel,
+        ),
+    )
+    server.setup()
+    fast = slow = None
+    try:
+        slow = AsynchronousSGDClient(
+            server.address, _SlowFirstFit(),
+            _client_config(heartbeat_timeout_s=10.0, upload_timeout_s=5.0),
+        )
+        slow.setup(timeout=10.0)
+        fast = AsynchronousSGDClient(
+            server.address, MockModel(),
+            _client_config(heartbeat_timeout_s=10.0, upload_timeout_s=5.0),
+        )
+        fast.setup(timeout=10.0)
+        # the fast client must finish the epoch WITHOUT the straggler: the
+        # straggler's leased batch expires and is speculatively re-dispatched
+        fast.train_until_complete(timeout=30.0)
+        # ... and the straggler's late answer must lose first-wins arbitration
+        assert _wait_for(lambda: server.suppressed_uploads >= 1, timeout=10.0), (
+            "straggler's late gradient was not suppressed"
+        )
+    finally:
+        for c in (fast, slow):
+            if c is not None:
+                c.dispose()
+        server.stop()
+    assert dataset.exhausted
+    assert server.lease_expirations >= 1
+    assert tel.counter_value("server_lease_expirations_total") >= 1
+    assert tel.counter_value("server_first_wins_suppressed_total") >= 1
+    assert server.applied_updates == 8, (
+        f"exactly-once violated: {server.applied_updates} applies for 8 batches"
+    )
+
+
+# -- gradient quarantine ----------------------------------------------------
+
+
+class _NaNOnceModel(MockModel):
+    """Second fit returns a poisoned (all-NaN) gradient."""
+
+    def fit(self, x, y):
+        grads = super().fit(x, y)
+        if self.fit_calls == 2:
+            return {k: np.full_like(v, np.nan) for k, v in grads.items()}
+        return grads
+
+
+def test_nan_upload_quarantined(tmp_path):
+    """A NaN gradient upload is rejected before the apply: the version clock
+    does not advance for it, and the payload lands under
+    ``save_dir/quarantine/`` for postmortem."""
+    x, y = _xy(16)  # 8 batches of 2
+    dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    tel = Telemetry()
+    save_dir = str(tmp_path / "models")
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        dataset,
+        DistributedServerConfig(
+            save_dir=save_dir, heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=2.0, telemetry=tel,
+        ),
+    )
+    server.setup()
+    client = AsynchronousSGDClient(server.address, _NaNOnceModel(), _client_config())
+    try:
+        client.setup(timeout=10.0)
+        client.train_until_complete(timeout=60.0)
+    finally:
+        client.dispose()
+        server.stop()
+    assert dataset.exhausted
+    assert server.rejected_updates == 1, "the NaN upload must be rejected"
+    assert server.applied_updates == 7
+    assert server.version_counter == 7, "version must not advance on rejection"
+    assert server.gate.quarantined_updates == 1
+    assert tel.counter_value("server_quarantined_total") == 1
+    dumps = os.listdir(os.path.join(save_dir, "quarantine"))
+    assert len(dumps) == 1
+    meta_path = os.path.join(save_dir, "quarantine", dumps[0], "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)["quarantine"]
+    assert meta["reason"] == "non-finite"
+    assert meta["batch"] is not None and meta["client_id"]
+    assert os.path.exists(
+        os.path.join(save_dir, "quarantine", dumps[0], "data.bin")
+    ), "the poisoned payload must be dumped for postmortem"
+
+
+def test_norm_outlier_gate(tmp_path):
+    gate = GradientGate(
+        QuarantinePolicy(warmup_updates=3, max_norm_multiplier=10.0),
+        save_dir=str(tmp_path), telemetry=Telemetry(),
+    )
+    g = {"w": np.ones(4, np.float32)}
+    big = {"w": np.full(4, 1e4, np.float32)}
+    # during warmup only finiteness is enforced
+    assert gate.check(big).ok
+    for _ in range(3):
+        v = gate.check(g)
+        assert v.ok
+        gate.accept(v.norm)
+    v = gate.check(big)
+    assert not v.ok and "norm-outlier" in v.reason
+    # rejected norms must NOT feed the EMA: the threshold cannot be dragged
+    # up toward the outliers, so the same burst keeps getting rejected
+    assert not gate.check(big).ok
+    assert gate.check(g).ok, "honest gradients still pass"
+    # NaN is rejected regardless of warmup or EMA
+    assert gate.check({"w": np.array([np.nan], np.float32)}).reason == "non-finite"
+
+
+def test_gate_handles_low_precision_dtypes(tmp_path):
+    import jax.numpy as jnp
+
+    gate = GradientGate(
+        QuarantinePolicy(), save_dir=str(tmp_path), telemetry=Telemetry()
+    )
+    assert gate.check({"w": jnp.ones((4,), jnp.bfloat16)}).ok
+    assert not gate.check({"w": jnp.array([jnp.nan], jnp.bfloat16)}).ok
+
+
+def test_quarantine_dump_roundtrip(tmp_path):
+    gate = GradientGate(
+        QuarantinePolicy(), save_dir=str(tmp_path), telemetry=Telemetry()
+    )
+    d = gate.quarantine(
+        {"w": np.ones(4, np.float32)}, "non-finite", client_id="c9", update_id="u-7"
+    )
+    assert d is not None and d.startswith(os.path.join(str(tmp_path), "quarantine"))
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["quarantine"] == {
+        "reason": "non-finite", "client_id": "c9", "update_id": "u-7"
+    }
+    assert os.path.getsize(os.path.join(d, "data.bin")) == 4 * 4
+
+
+class _PoisonUpdateModel(MockModel):
+    """The gradient passes the gate, but the update rule blows the params
+    up — the post-apply rollback guard's failure mode."""
+
+    def update(self, grads):
+        super().update(grads)
+        self._params = {k: np.full_like(v, np.nan) for k, v in self._params.items()}
+
+
+def _upload_for(server, model, batch):
+    grads = {k: np.asarray(v).copy() for k, v in model.get_params().items()}
+    return UploadMsg(
+        client_id="c1",
+        batch=batch,
+        gradients=GradientMsg(version=server.model.version, vars=serialize_tree(grads)),
+        update_id="u-1",
+    )
+
+
+def test_rollback_guard_restores_params(tmp_path):
+    x, y = _xy(8)
+    dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    model = DistributedServerInMemoryModel(_PoisonUpdateModel())
+    server = AsynchronousSGDServer(
+        model,
+        dataset,
+        DistributedServerConfig(save_dir=str(tmp_path / "models")),
+    )
+    server.setup()
+    try:
+        before = {k: np.asarray(v).copy() for k, v in model.get_params().items()}
+        b = dataset.next(timeout=0.0)
+        accepted = server.handle_upload("c1", _upload_for(server, model, b.batch))
+        assert accepted is False
+        for k, v in model.get_params().items():
+            np.testing.assert_array_equal(np.asarray(v), before[k])
+        assert server.rejected_updates == 1
+        assert server.version_counter == 0, "rolled-back update must not version"
+        assert server.gate.rollbacks == 1
+        dumps = os.listdir(os.path.join(str(tmp_path / "models"), "quarantine"))
+        assert len(dumps) == 1 and "post-apply-non-finite" in dumps[0]
+    finally:
+        server.stop()
+
+
+def test_federated_nan_upload_quarantined(tmp_path):
+    save_dir = str(tmp_path / "models")
+    server = FederatedServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(save_dir=save_dir),
+    )
+    server.setup()
+    try:
+        nan_vars = serialize_tree(
+            {k: np.full_like(np.asarray(v), np.nan)
+             for k, v in server.model.get_params().items()}
+        )
+        msg = UploadMsg(
+            client_id="c1", batch=0,
+            gradients=GradientMsg(version=server.model.version, vars=nan_vars),
+            update_id="u-nan",
+        )
+        assert server.handle_upload("c1", msg) is False
+        assert server.dropped_uploads == 1
+        assert server.updates == [], "the poisoned upload must not be buffered"
+        assert server.gate.quarantined_updates == 1
+        assert os.listdir(os.path.join(save_dir, "quarantine"))
+    finally:
+        server.stop()
+
+
+def test_quarantine_disabled_passes_everything(tmp_path):
+    gate = GradientGate(
+        QuarantinePolicy(enabled=False), save_dir=str(tmp_path), telemetry=Telemetry()
+    )
+    assert not gate.active
+    assert gate.check({"w": np.array([np.nan], np.float32)}).ok
+
+
+# -- dispatch-to-ghost guard ------------------------------------------------
+
+
+def test_ghost_client_dispatch_requeues(tmp_path):
+    """A client that disconnects between its upload and the next dispatch
+    must not swallow the batch: the emit raises KeyError and the guard
+    returns the batch to the queue instead of crashing the handler."""
+    x, y = _xy(8)
+    dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        dataset,
+        DistributedServerConfig(save_dir=str(tmp_path / "models")),
+    )
+    server.setup()
+    try:
+        before = dataset.incomplete_batches
+        assert server._send_next_batch("ghost-client") is False
+        assert dataset.outstanding_batches == set(), "batch leaked to a ghost"
+        assert dataset.incomplete_batches == before, "batch lost to a ghost"
+        assert "ghost-client" not in server._client_batches
+        assert "ghost-client" not in server._lease_deadlines
+    finally:
+        server.stop()
+
+
+# -- manifest restore edge cases --------------------------------------------
+
+
+def test_unknown_manifest_schema_ignored(tmp_path):
+    x, y = _xy(8)
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedDataset(x, y, {"batch_size": 2, "epochs": 1}),
+        DistributedServerConfig(save_dir=str(tmp_path / "models")),
+    )
+    assert server._restore_manifest({"schema": 999, "version_counter": 42}) is False
+    assert server.version_counter == 0, "unknown schema must restore NOTHING"
+    assert server._applied_ids == {}
+
+
+def test_restored_dedup_keys_suppress_reapply(tmp_path):
+    """An update applied by the previous incarnation, retried against the
+    new one (ambiguous ack at kill time), must be deduped from the restored
+    manifest — not re-applied."""
+    x, y = _xy(8)
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedDataset(x, y, {"batch_size": 2, "epochs": 1}),
+        DistributedServerConfig(save_dir=str(tmp_path / "models")),
+    )
+    server._restore_manifest({
+        "schema": 1,
+        "applied_update_ids": [["u-old", True]],
+        "version_counter": 3,
+        "applied_updates": 3,
+        "version_tokens": [["1000", 2]],
+        "dataset": None,
+    })
+    assert server.version_counter == 3 and server.applied_updates == 3
+    assert server._version_tokens == {"1000": 2}
+    ack = server._on_upload_wire("c1", UploadMsg(
+        client_id="c1", batch=0,
+        gradients=GradientMsg(version="1000", vars={}),
+        update_id="u-old",
+    ).to_wire())
+    assert ack is True, "the retry must be acked from the restored cache"
+    assert server.duplicate_uploads == 1
+    assert server.applied_updates == 3, "restored dedup key must prevent re-apply"
