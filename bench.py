@@ -399,6 +399,44 @@ def bench_torch_cifar():
     return sps
 
 
+# -- wire-cost accounting (docs/PERFORMANCE.md §8) -------------------------
+
+
+def _wire_cost(params, gradient_compression="none", topk_fraction=0.01,
+               weight_compression="none"):
+    """(up_bytes_per_update, down_bytes_per_broadcast) for a param-shaped
+    tree under the given wire modes, computed with the REAL serialization
+    helpers (the in-process trainers never serialize, so the wire cost is
+    modeled from the exact same code path the multi-process plane ships
+    through — payload bytes + sparse index bytes, headers excluded)."""
+    import jax
+    import numpy as np
+
+    from distriflow_tpu.utils.serialization import (
+        cast_tree,
+        quantize_array,
+        serialize_tree,
+        topk_array,
+        tree_wire_nbytes,
+    )
+
+    host = [np.asarray(l) for l in jax.tree.leaves(params)]
+    if gradient_compression in ("topk", "topk_int8"):
+        up = {str(i): topk_array(l, topk_fraction,
+                                 quantize=gradient_compression == "topk_int8")
+              for i, l in enumerate(host)}
+    elif gradient_compression == "int8":
+        up = {str(i): quantize_array(l) for i, l in enumerate(host)}
+    else:
+        up = serialize_tree(
+            host if gradient_compression == "none"
+            else cast_tree(host, gradient_compression)
+        )
+    down_tree = host if weight_compression == "none" else cast_tree(
+        host, weight_compression)
+    return tree_wire_nbytes(up), tree_wire_nbytes(serialize_tree(down_tree))
+
+
 # -- config #3: CIFAR-10 async-SGD, bounded staleness ----------------------
 
 
@@ -483,6 +521,28 @@ def bench_cifar_async(matrix):
     unattributed_ms = wall_ms - drain_ms - dispatch_sum_ms / workers
     phases = {k: round(v / uploads, 1) for k, v in trainer.phase_ms.items()}
 
+    # wire-cost columns (docs/PERFORMANCE.md §8): what ONE update/broadcast
+    # of this model costs on the multi-process wire, dense f32 vs 1% top-k
+    up_dense, down_dense = _wire_cost(trainer.params)
+    up_topk, _ = _wire_cost(trainer.params, gradient_compression="topk",
+                            topk_fraction=0.01)
+    up_topk8, _ = _wire_cost(trainer.params, gradient_compression="topk_int8",
+                             topk_fraction=0.01)
+    matrix.append({
+        "config": "cifar10_convnet_async_topk",
+        "metric": "up_bytes_per_update",
+        "value": up_topk,
+        "dense_bytes": up_dense,
+        "reduction_x": round(up_dense / up_topk, 1),
+        "topk_int8_bytes": up_topk8,
+        "topk_int8_reduction_x": round(up_dense / up_topk8, 1),
+        "topk_fraction": 0.01,
+        "down_bytes_per_broadcast": down_dense,
+    })
+    log(f"#3w wire: dense {up_dense} B/update vs topk(1%) {up_topk} B "
+        f"({up_dense / up_topk:.0f}x) vs topk_int8 {up_topk8} B "
+        f"({up_dense / up_topk8:.0f}x); broadcast {down_dense} B")
+
     sync_row = next(
         (e for e in matrix if e.get("config") == "cifar10_convnet_sync"), {})
     pct = (round(100.0 * sps / (sync_row["value"] * len(jax.devices())), 1)
@@ -508,6 +568,8 @@ def bench_cifar_async(matrix):
         "unattributed_ms": round(unattributed_ms, 0),
         "floor_ms": round(dispatch_floor_ms, 1),
         "ceiling_sps": round(ceiling, 0),
+        "up_bytes_per_update": up_dense,
+        "down_bytes_per_broadcast": down_dense,
     }
 
 
@@ -554,12 +616,15 @@ def bench_fedavg():
         f"{w} workers x {k} local steps, final_loss {loss:.4f}; single-chip: "
         "weight-pmean is a no-op at workers=1, multi-worker semantics "
         "covered by dryrun/tests)")
+    up_dense, down_dense = _wire_cost(trainer.params)
     return {
         "config": "fedavg_cifar10",
         "metric": "samples/sec",
         "value": round(sps, 1),
         "round_ms": round(elapsed * 1e3 / rounds, 2),
         "workers": w,
+        "up_bytes_per_update": up_dense,
+        "down_bytes_per_broadcast": down_dense,
     }
 
 
@@ -1123,7 +1188,9 @@ _DROP_ORDER = [
     "drain_ms", "dispatch_ms", "ceiling_sps", "seq_ms", "conc_ms",
     "params_m", "round_ms", "workers", "step_ms", "mfu_med", "top2_mfu",
     "top2_tok_s", "i8_ms_tok_1k", "hbm_frac_4k", "wall_ms",
-    "unattributed_ms",
+    "unattributed_ms", "topk_int8_bytes", "topk_int8_reduction_x",
+    "topk_fraction", "down_bytes_per_broadcast", "dense_bytes",
+    "up_bytes_per_update", "reduction_x",
 ]
 
 

@@ -44,7 +44,7 @@ from distriflow_tpu.utils.config import (
 )
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 from distriflow_tpu.utils.messages import DownloadMsg, Events, UploadMsg
-from distriflow_tpu.utils.serialization import deserialize_tree
+from distriflow_tpu.utils.serialization import deserialize_tree, tree_wire_nbytes
 
 IDENTITY_FILE = ".distriflow-learner-uuid"  # cookie-equivalent persistence
 
@@ -156,9 +156,21 @@ class AbstractClient:
         self._c_reconnects = self.telemetry.counter("client_reconnects_total")
         self._c_uploads = self.telemetry.counter("client_uploads_total")
         self._c_retries = self.telemetry.counter("client_upload_retries_total")
-        # int8 gradient compression: per-leaf quantization residual carried
-        # into the next upload (error feedback); lazily keyed by tree path
+        # wire accounting (see docs/OBSERVABILITY.md comm_* table)
+        self._c_up_bytes = self.telemetry.counter("comm_up_bytes_total", role="client")
+        self._c_down_bytes = self.telemetry.counter("comm_down_bytes_total", role="client")
+        self._c_up_sparse = self.telemetry.counter("comm_uploads_sparse_total", role="client")
+        self._c_up_dense = self.telemetry.counter("comm_uploads_dense_total", role="client")
+        self._c_down_delta = self.telemetry.counter("comm_broadcasts_delta_total", role="client")
+        self._c_down_full = self.telemetry.counter("comm_broadcasts_full_total", role="client")
+        self._c_resyncs = self.telemetry.counter("comm_resyncs_total", role="client")
+        self._g_residual = self.telemetry.gauge("comm_residual_norm")
+        # int8/topk gradient compression: per-leaf compression residual
+        # carried into the next upload (error feedback); keyed by tree path
         self._quant_error: Optional[Dict[str, Any]] = None
+        # version of the last *installed* weights — the base a delta
+        # broadcast must name for us to be able to apply it
+        self._installed_version: Optional[str] = None
 
     # -- observability -----------------------------------------------------
 
@@ -265,9 +277,32 @@ class AbstractClient:
 
     def _on_download(self, payload: Any) -> None:
         msg = DownloadMsg.from_wire(payload)
+        self._c_down_bytes.inc(tree_wire_nbytes(msg.model.vars))
+        if msg.model.delta_base is not None:
+            self._c_down_delta.inc()
+        else:
+            self._c_down_full.inc()
         with self._download_lock:
-            self.msg = msg
-            self.set_params_from(msg)
+            installed = self.set_params_from(msg)
+            if installed:
+                self.msg = msg
+        if not installed:
+            # delta against a base we don't hold (dropped broadcast, stale
+            # server-side ledger): discard it and ask for a full sync. The
+            # handshake events deliberately stay unset — only an installed
+            # Download resumes the worker loop.
+            self._c_resyncs.inc()
+            self.log(
+                f"delta broadcast base {msg.model.delta_base!r} != installed "
+                f"{self._installed_version!r}; requesting full resync"
+            )
+            transport = self.transport
+            if transport is not None:
+                try:
+                    transport.emit(Events.Resync.value, {"client_id": self.client_id})
+                except Exception as exc:  # noqa: BLE001 - reconnect loop owns recovery
+                    self.log(f"resync request failed: {exc!r}")
+            return
         first = not self._first_download.is_set()
         self._first_download.set()
         self._resumed.set()  # reconnect handshake complete
@@ -281,14 +316,37 @@ class AbstractClient:
         self._resumed.set()
         self.handle_training_complete()
 
-    def set_params_from(self, msg: DownloadMsg) -> None:
+    def set_params_from(self, msg: DownloadMsg) -> bool:
         """Deserialize and install weights (reference ``setVars`` in tidy, ``:160-164``).
 
         Weights may arrive 16-bit (server ``weight_compression``);
         ``deserialize_tree`` lands every leaf back on the local model's own
-        param dtype, so the model never silently becomes half precision."""
+        param dtype, so the model never silently becomes half precision.
+
+        A *delta* broadcast (``msg.model.delta_base`` set) carries per-leaf
+        ``new - base`` for float leaves (full values for non-float leaves)
+        against the params of version ``delta_base``. It only installs when
+        our installed version matches that base; returns False otherwise so
+        the caller can request a full resync instead of applying a delta to
+        the wrong foundation."""
+        import jax
+
         template = self.model.get_params()
-        self.model.set_params(deserialize_tree(msg.model.vars, template))
+        m = msg.model
+        if m.delta_base is not None:
+            if m.delta_base != self._installed_version:
+                return False
+            delta = deserialize_tree(m.vars, template)
+
+            def apply_delta(t, d):
+                t = np.asarray(t)
+                return t + d if t.dtype.kind == "f" else d
+
+            self.model.set_params(jax.tree.map(apply_delta, template, delta))
+        else:
+            self.model.set_params(deserialize_tree(m.vars, template))
+        self._installed_version = m.version
+        return True
 
     # -- upload -------------------------------------------------------------
 
@@ -310,6 +368,12 @@ class AbstractClient:
         if msg.update_id is None:
             msg.update_id = uuid_lib.uuid4().hex
         self._c_uploads.inc()
+        if msg.gradients is not None:
+            self._c_up_bytes.inc(tree_wire_nbytes(msg.gradients.vars))
+            if any(s.indices is not None for s in msg.gradients.vars.values()):
+                self._c_up_sparse.inc()
+            else:
+                self._c_up_dense.inc()
         reconnects_at_start = self.reconnects
         transport_at_start = self.transport
         # ONE span covers every attempt: retries resend the same wire bytes
@@ -399,7 +463,7 @@ class AbstractClient:
         aggregation accumulates in float32 regardless). int8 goes through
         :meth:`serialize_grads` (it needs per-leaf scales on the wire)."""
         name = str(self.hyperparam("gradient_compression"))
-        if name in ("none", "int8"):
+        if name in ("none", "int8", "topk", "topk_int8"):
             return grads
         if name not in COMPRESSION_DTYPES:
             raise ValueError(
@@ -419,7 +483,15 @@ class AbstractClient:
         to the next upload, so the error accumulates into later updates
         instead of being lost (the standard convergence fix for quantized
         gradient push; over time the sum of dequantized uploads tracks the
-        sum of true gradients)."""
+        sum of true gradients).
+
+        ``"topk"``/``"topk_int8"`` ship only the top-|k| largest-magnitude
+        entries per leaf (``k = topk_fraction`` of the leaf size) as a
+        sparse :class:`SerializedArray` — indices + values, int8-quantized
+        values for ``topk_int8`` — with the same error feedback: the entire
+        un-sent mass (dropped entries + quantization error of the kept
+        ones) becomes the next residual, so nothing is lost, only delayed
+        (Deep Gradient Compression, Lin et al. 2018)."""
         import jax
 
         from distriflow_tpu.utils.serialization import (
@@ -427,15 +499,20 @@ class AbstractClient:
             quantize_array,
             sanitize_finite,
             serialize_tree,
+            topk_array,
         )
 
         name = str(self.hyperparam("gradient_compression"))
-        if name != "int8":
+        if name not in ("int8", "topk", "topk_int8"):
             return serialize_tree(self.compress_grads(grads))
+        topk_fraction = (
+            float(self.hyperparam("topk_fraction")) if name != "int8" else None
+        )
         flat, _ = jax.tree_util.tree_flatten_with_path(grads)
         if self._quant_error is None:
             self._quant_error = {}
         out = {}
+        residual_sq = 0.0
         for path, leaf in flat:
             key = jax.tree_util.keystr(path)
             # sanitize BEFORE the error-feedback arithmetic: an inf/nan
@@ -443,9 +520,17 @@ class AbstractClient:
             # poison every future upload of this leaf
             g = sanitize_finite(np.asarray(leaf, np.float32))
             g = g + self._quant_error.get(key, 0.0)  # carry prior residual
-            q = quantize_array(g)
-            self._quant_error[key] = g - deserialize_array(q)
-            out[key] = q
+            if name == "int8":
+                sa = quantize_array(g)
+            else:
+                sa = topk_array(g, topk_fraction, quantize=(name == "topk_int8"))
+            residual = g - deserialize_array(sa)
+            self._quant_error[key] = residual
+            residual_sq += float(np.vdot(residual, residual))
+            out[key] = sa
+        gauge = getattr(self, "_g_residual", None)
+        if gauge is not None:
+            gauge.set(float(np.sqrt(residual_sq)))
         return out
 
     # -- subclass hooks -------------------------------------------------------

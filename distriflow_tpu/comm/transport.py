@@ -50,7 +50,7 @@ import threading
 import sys
 import time
 import uuid
-from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from distriflow_tpu.comm.codec import checksum, decode, encode
 from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
@@ -567,6 +567,12 @@ class ServerTransport:
     @property
     def num_clients(self) -> int:
         return len(self._clients)
+
+    @property
+    def client_ids(self) -> List[str]:
+        """Snapshot of currently connected connection ids (per-connection
+        uuids — a reconnected client appears under a fresh id)."""
+        return list(self._clients)
 
 
 class ClientTransport:

@@ -507,6 +507,116 @@ def main() -> int:
 
     ok &= _check("straggler drill (lease re-dispatch + first-wins)", straggler)
 
+    def sparse_wire():
+        """Short async session with top-k + int8 uploads and delta
+        broadcasts under a seeded mid-session connection reset: the
+        dense-reconstructed mean of the sparse uploads matches the model's
+        constant gradient within the error-feedback + quantization bound,
+        and the reconnected client is repaired with a FULL broadcast —
+        exactly one beyond the handshake — while steady-state downloads
+        ship as deltas."""
+        import numpy as np
+
+        from distriflow_tpu.client.abstract_client import DistributedClientConfig
+        from distriflow_tpu.client.async_client import AsynchronousSGDClient
+        from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+        from distriflow_tpu.data.dataset import DistributedDataset
+        from distriflow_tpu.obs import Telemetry
+        from distriflow_tpu.server.abstract_server import DistributedServerConfig
+        from distriflow_tpu.server.async_server import AsynchronousSGDServer
+        from distriflow_tpu.server.models import DistributedServerInMemoryModel
+        from distriflow_tpu.utils.config import RetryPolicy
+        from distriflow_tpu.utils.serialization import mean_serialized
+
+        TinyModel = _tiny_model_cls()
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+        dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+        tel = Telemetry()
+        # reset while sending the SECOND download (the first post-apply
+        # delta): the client reconnects and must be repaired with a full
+        server_plan = FaultPlan(
+            seed=11,
+            schedule=[ScriptedFault(event="downloadVars", nth=2,
+                                    action="reset")],
+        )
+        collected = []
+        with tempfile.TemporaryDirectory() as d:
+            server = AsynchronousSGDServer(
+                DistributedServerInMemoryModel(TinyModel()),
+                dataset,
+                DistributedServerConfig(
+                    save_dir=d,
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+                    fault_plan=server_plan, telemetry=tel,
+                    client_hyperparams={
+                        "gradient_compression": "topk_int8",
+                        "topk_fraction": 0.5,
+                    },
+                ),
+            )
+            server.setup()
+            server.on_upload(
+                lambda m: collected.append(m.gradients.vars)
+                if m.gradients is not None else None
+            )
+            client = AsynchronousSGDClient(
+                server.address, TinyModel(),
+                DistributedClientConfig(
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+                    upload_timeout_s=2.0,
+                    upload_retry=RetryPolicy(
+                        max_retries=6, initial_backoff_s=0.05,
+                        max_backoff_s=0.5, seed=7,
+                    ),
+                    telemetry=tel,
+                ),
+            )
+            try:
+                client.setup(timeout=10.0)
+                client.train_until_complete(timeout=60.0)
+            finally:
+                client.dispose()
+                server.stop()
+        assert server.applied_updates == 4, (
+            f"expected 4 applied updates, got {server.applied_updates}"
+        )
+        assert collected, "no sparse uploads collected"
+        sparse = sum(
+            1 for u in collected
+            for s in u.values() if s.indices is not None
+        )
+        assert sparse, "uploads were not sparse (topk_int8 not in effect?)"
+        # (a) the EF invariant on the wire: the dense-reconstructed mean of
+        # the uploads tracks the constant 0.1 gradient — the un-sent mass is
+        # bounded by the residual carried across rounds plus the int8 grid
+        mean = mean_serialized(collected, {"w": np.zeros((4,), np.float32)})
+        tol = 0.2 / len(collected) + 0.01
+        err = float(np.max(np.abs(np.asarray(mean["w"]) - 0.1)))
+        assert err <= tol, (
+            f"dense-reconstructed mean off by {err:.4f} (> {tol:.4f}): "
+            f"{np.asarray(mean['w'])}"
+        )
+        # (b) delta-broadcast fallback: handshake full + exactly one repair
+        # full after the reset-forced reconnect; everything else is a delta
+        full = tel.counter_value("comm_broadcasts_full_total", role="server")
+        delta = tel.counter_value("comm_broadcasts_delta_total", role="server")
+        reconnects = tel.counter_value("client_reconnects_total")
+        assert reconnects == 1, f"expected 1 reconnect, got {reconnects:g}"
+        assert full == 2, (
+            f"expected 2 full broadcasts (handshake + post-reconnect "
+            f"repair), got {full:g}"
+        )
+        assert delta >= 1, "no delta broadcast in steady state"
+        up = tel.counter_value("comm_up_bytes_total", role="server")
+        return (f"{len(collected)} topk+int8 uploads ({sparse} sparse frames, "
+                f"{up:g} B up), mean within {tol:.3f} of truth; "
+                f"{full:g} full + {delta:g} delta broadcasts, "
+                f"1 reset-forced reconnect repaired with a full sync")
+
+    ok &= _check("sparse-wire drill (topk+int8 uploads, delta broadcasts)",
+                 sparse_wire)
+
     def native():
         from distriflow_tpu import native
 

@@ -15,9 +15,14 @@ directly — weights never leave the devices there.
 from __future__ import annotations
 
 import collections
+import concurrent.futures
 import dataclasses
+import queue
 import threading
 from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
 
 from distriflow_tpu.models.base import DistributedModel
 from distriflow_tpu.comm.transport import (
@@ -43,7 +48,11 @@ from distriflow_tpu.utils.config import (
 from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 from distriflow_tpu.utils.messages import DownloadMsg, Events, ModelMsg, UploadMsg
-from distriflow_tpu.utils.serialization import SerializedArray, serialize_tree
+from distriflow_tpu.utils.serialization import (
+    SerializedArray,
+    serialize_tree,
+    tree_wire_nbytes,
+)
 
 DEFAULT_SAVE_DIR = "./saved-models"  # reference federated_server.ts:37-43
 
@@ -80,6 +89,14 @@ class DistributedServerConfig:
     # guard); None uses the default QuarantinePolicy — pass
     # QuarantinePolicy(enabled=False) to switch the gate off entirely
     quarantine: Optional[QuarantinePolicy] = None
+    # apply pipeline: uploads are decoded on the transport's handler
+    # threads, then handed to ONE bounded-queue apply worker — so the
+    # deserialization of update N+1 overlaps the apply of update N, and a
+    # full queue backpressures the transport (the handler blocks, acks
+    # slow down, clients stop flooding). 0 applies inline on the handler
+    # thread (pre-pipeline behavior). The ack still carries the apply
+    # verdict either way — the handler waits on the queued apply's future.
+    apply_queue_depth: int = 8
     # fault injection (tests / chaos drills): consulted by the server's
     # per-client endpoints at every frame boundary
     fault_plan: Optional[FaultPlan] = None
@@ -136,6 +153,15 @@ class AbstractServer:
         self._c_uploads = self.telemetry.counter("server_uploads_total")
         self._c_dedup = self.telemetry.counter("server_dedup_hits_total")
         self._c_recoveries = self.telemetry.counter("server_recoveries_total")
+        # wire accounting (see docs/OBSERVABILITY.md comm_* table)
+        self._c_up_bytes = self.telemetry.counter("comm_up_bytes_total", role="server")
+        self._c_down_bytes = self.telemetry.counter("comm_down_bytes_total", role="server")
+        self._c_up_sparse = self.telemetry.counter("comm_uploads_sparse_total", role="server")
+        self._c_up_dense = self.telemetry.counter("comm_uploads_dense_total", role="server")
+        self._c_down_delta = self.telemetry.counter("comm_broadcasts_delta_total", role="server")
+        self._c_down_full = self.telemetry.counter("comm_broadcasts_full_total", role="server")
+        self._c_resyncs = self.telemetry.counter("comm_resyncs_total", role="server")
+        self._g_apply_queue = self.telemetry.gauge("comm_apply_queue_depth")
         self.logger = VerboseLogger(type(self).__name__, self.config.verbose)
         self.gate = GradientGate(
             self.config.quarantine or QuarantinePolicy(),
@@ -163,6 +189,18 @@ class AbstractServer:
         self._dedup_inflight: Dict[str, threading.Event] = {}
         self._dedup_lock = threading.Lock()
         self.duplicate_uploads = 0
+        # delta broadcasts: which version each CONNECTION was last sent
+        # (connection ids are per-dial uuids, so a reconnected client shows
+        # up base-less and automatically gets a full broadcast), plus a
+        # bounded window of host param snapshots to diff against. Guarded
+        # by a dedicated leaf lock — the send paths run outside self._lock.
+        self._delta_lock = threading.Lock()
+        self._client_bases: Dict[str, str] = {}
+        self._param_history: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+        # apply pipeline (config.apply_queue_depth): created in setup()
+        self._apply_queue: Optional["queue.Queue"] = None
+        self._apply_worker: Optional[threading.Thread] = None
+        self._apply_stop = threading.Event()
 
     # -- observability (reference abstract_server.ts:67-103) ---------------
 
@@ -180,18 +218,32 @@ class AbstractServer:
 
     # -- download message ---------------------------------------------------
 
+    #: how many past versions' params are retained for delta broadcasts; a
+    #: client whose base aged out of the window falls back to a full sync
+    _DELTA_HISTORY = 8
+
     def compute_download_msg(self) -> DownloadMsg:
         """Serialize current weights + version + pushed hyperparams
         (reference ``abstract_server.ts:81-89``). With the
         ``weight_compression`` server hyperparameter the weights go out
         16-bit — half the bytes of every broadcast; clients restore their
-        model's own param dtype on install (AbstractClient.set_params_from)."""
+        model's own param dtype on install (AbstractClient.set_params_from).
+
+        With ``delta_broadcast`` on, the (post-cast) params are also
+        snapshotted into the bounded delta history so later per-connection
+        sends can ship ``new - base`` instead of full weights."""
         params = self.model.get_params()
         wc = self.hyperparams.weight_compression
         if wc != "none":
             from distriflow_tpu.utils.serialization import cast_tree
 
             params = cast_tree(params, wc)
+        if self.hyperparams.delta_broadcast:
+            snap = jax.tree.map(lambda a: np.asarray(a), params)
+            with self._delta_lock:
+                self._param_history[self.model.version] = snap
+                while len(self._param_history) > self._DELTA_HISTORY:
+                    self._param_history.popitem(last=False)
         return DownloadMsg(
             model=ModelMsg(
                 version=self.model.version,
@@ -199,6 +251,64 @@ class AbstractServer:
             ),
             hyperparams=asdict(self.client_hyperparams),
         )
+
+    def download_model_msg(self, client_id: str) -> ModelMsg:
+        """Full-or-delta weights for ONE connection, with comm accounting.
+
+        Sends a delta (per-leaf ``new - base`` for float leaves, full
+        values for non-float leaves, through the same ``weight_compression``
+        cast) when the connection's last-sent version is known and its
+        params are still in the delta window; a FULL broadcast otherwise —
+        which covers exactly the fallback set the resumption/recovery
+        paths need: first download of a fresh connection, reconnect (new
+        connection id), post-restart (empty ledger + empty history), a
+        base that aged out of the window, and any connection whose ledger
+        entry was cleared by a version-token mismatch or a client resync.
+        The ledger is updated optimistically at send time; a dropped frame
+        surfaces as a client-side base mismatch and comes back to us as a
+        resync request (``Events.Resync``)."""
+        full = self.download_msg.model
+        delta: Optional[ModelMsg] = None
+        if self.hyperparams.delta_broadcast:
+            with self._delta_lock:
+                base_version = self._client_bases.get(client_id)
+            if base_version is not None:
+                delta = self._delta_model_msg(base_version, full)
+        with self._delta_lock:
+            self._client_bases[client_id] = full.version
+        msg = delta if delta is not None else full
+        self._c_down_bytes.inc(tree_wire_nbytes(msg.vars))
+        if delta is not None:
+            self._c_down_delta.inc()
+        else:
+            self._c_down_full.inc()
+        return msg
+
+    def _delta_model_msg(self, base_version: str, full: ModelMsg) -> Optional[ModelMsg]:
+        """``new - base`` ModelMsg, or None when the base (or the current
+        version) left the delta window — caller falls back to full."""
+        with self._delta_lock:
+            base = self._param_history.get(base_version)
+            new = self._param_history.get(full.version)
+        if base is None or new is None:
+            return None
+        try:
+            def diff(n, b):
+                n, b = np.asarray(n), np.asarray(b)
+                if n.dtype.kind != "f":
+                    return n  # non-float leaves ship whole; client replaces
+                return n.astype(np.float32) - b.astype(np.float32)
+
+            delta = jax.tree.map(diff, new, base)
+        except Exception:  # noqa: BLE001 - structure changed between versions
+            return None
+        wc = self.hyperparams.weight_compression
+        if wc != "none":
+            from distriflow_tpu.utils.serialization import cast_tree
+
+            delta = cast_tree(delta, wc)
+        return ModelMsg(version=full.version, vars=serialize_tree(delta),
+                        delta_base=base_version)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -219,10 +329,36 @@ class AbstractServer:
         self.transport.on_connect = self._on_connect
         self.transport.on_disconnect = self._on_disconnect
         self.transport.on(Events.Upload.value, self._on_upload_wire)
+        self.transport.on(Events.Resync.value, self._on_resync_wire)
+        if self.config.apply_queue_depth > 0:
+            self._apply_stop.clear()
+            self._apply_queue = queue.Queue(self.config.apply_queue_depth)
+            self._apply_worker = threading.Thread(
+                target=self._apply_loop, name="apply-worker", daemon=True
+            )
+            self._apply_worker.start()
         self.transport.start()
         self.log(f"serving on {self.transport.address}")
 
     def stop(self) -> None:
+        worker, q = self._apply_worker, self._apply_queue
+        if worker is not None and q is not None:
+            self._apply_stop.set()
+            try:
+                q.put_nowait(None)  # sentinel wakes a blocked get()
+            except queue.Full:
+                pass
+            worker.join(timeout=5.0)
+            # fail any stranded applies so their handler threads unblock
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    item[2].set_exception(RuntimeError("server stopped"))
+            self._apply_worker = None
+            self._apply_queue = None
         self.transport.stop()
 
     @property
@@ -247,13 +383,68 @@ class AbstractServer:
         with self._lock:
             self.num_clients -= 1
             n = self.num_clients
+        with self._delta_lock:
+            # connection ids never recur, so the gone connection's delta
+            # base is dead weight; the replacement dial starts base-less
+            self._client_bases.pop(client_id, None)
         self._g_clients.set(n)
         self.log(f"disconnection: {n} clients")
         self.callbacks.fire("disconnect", client_id)
         self.handle_disconnection(client_id)
 
     def _on_upload_wire(self, client_id: str, payload: Any) -> Any:
-        """Wire entry for uploads: decode, dedup by ``update_id``, apply.
+        """Wire entry for uploads: decode + account on the transport's
+        handler thread, then apply — inline when ``apply_queue_depth`` is 0,
+        otherwise through the single bounded-queue apply worker so the
+        deserialization of update N+1 overlaps the apply of update N. A
+        full queue blocks the handler (backpressure: acks slow down and
+        well-behaved clients stop flooding). Either way the ack carries
+        the apply verdict — the handler waits on the queued apply's future.
+        """
+        msg = UploadMsg.from_wire(payload)
+        self._c_uploads.inc()
+        if msg.gradients is not None:
+            self._c_up_bytes.inc(tree_wire_nbytes(msg.gradients.vars))
+            if any(s.indices is not None for s in msg.gradients.vars.values()):
+                self._c_up_sparse.inc()
+            else:
+                self._c_up_dense.inc()
+        if msg.metrics is not None:
+            self.log(f"client {msg.client_id} metrics: {msg.metrics}")
+        q = self._apply_queue
+        if q is None:
+            return self._process_upload(client_id, msg)
+        fut: "concurrent.futures.Future[Any]" = concurrent.futures.Future()
+        q.put((client_id, msg, fut))
+        self._g_apply_queue.set(q.qsize())
+        return fut.result()
+
+    def _apply_loop(self) -> None:
+        """Single apply worker: drains the bounded queue in FIFO order.
+
+        One worker (not a pool) keeps applies serial — the dedup in-flight
+        gate never self-blocks, and version arithmetic in the subclasses
+        sees uploads in arrival order, exactly as the inline path did."""
+        q = self._apply_queue
+        while True:
+            try:
+                item = q.get(timeout=0.2)
+            except queue.Empty:
+                if self._apply_stop.is_set():
+                    return
+                continue
+            if item is None:
+                return
+            client_id, msg, fut = item
+            try:
+                fut.set_result(self._process_upload(client_id, msg))
+            except BaseException as exc:  # noqa: BLE001 - relayed to the ack
+                fut.set_exception(exc)
+            finally:
+                self._g_apply_queue.set(q.qsize())
+
+    def _process_upload(self, client_id: str, msg: UploadMsg) -> Any:
+        """Dedup by ``update_id``, then apply.
 
         A retried upload (client resent after an ambiguous ack timeout) or a
         duplicate-delivered frame carries an ``update_id`` the server has
@@ -262,10 +453,6 @@ class AbstractServer:
         still mid-apply on another handler thread gates the duplicate until
         the owner finishes, so concurrent deliveries also apply exactly once.
         """
-        msg = UploadMsg.from_wire(payload)
-        self._c_uploads.inc()
-        if msg.metrics is not None:
-            self.log(f"client {msg.client_id} metrics: {msg.metrics}")
         uid = msg.update_id
         if uid is None:  # legacy client: no dedup possible
             with self.telemetry.span(
@@ -316,6 +503,34 @@ class AbstractServer:
             with self._dedup_lock:
                 self._dedup_inflight.pop(uid, None)
             gate.set()
+
+    def _on_resync_wire(self, client_id: str, payload: Any) -> Any:
+        """A client refused a delta whose base didn't match its installed
+        version (dropped frame, missed broadcast): clear this connection's
+        ledger entry so its next send is a FULL broadcast, then let the
+        subclass push one (and requeue any work the client abandoned)."""
+        self._c_resyncs.inc()
+        with self._delta_lock:
+            self._client_bases.pop(client_id, None)
+        self.log(f"resync requested by {client_id}: next broadcast is full")
+        self.handle_resync(client_id)
+        return True
+
+    def handle_resync(self, client_id: str) -> None:
+        """Default resync repair: push a fresh full download to the one
+        connection. Subclasses with per-client work queues override to also
+        re-dispatch whatever the client was chewing on."""
+        try:
+            self.transport.emit_to(
+                client_id,
+                Events.Download.value,
+                DownloadMsg(
+                    model=self.download_model_msg(client_id),
+                    hyperparams=self.download_msg.hyperparams,
+                ).to_wire(),
+            )
+        except KeyError:
+            pass  # connection vanished between the request and the reply
 
     # -- crash-consistent recovery (docs/ROBUSTNESS.md §8) ------------------
 

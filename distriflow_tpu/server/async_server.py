@@ -151,7 +151,9 @@ class AsynchronousSGDServer(AbstractServer):
             version=self.version_counter,
         ) as span:
             msg = DownloadMsg(
-                model=self.download_msg.model,
+                # full-or-delta weights for THIS connection (delta when the
+                # server knows what the connection last installed)
+                model=self.download_model_msg(client_id),
                 hyperparams=self.download_msg.hyperparams,
                 data=batch_to_data_msg(batch),
                 trace_id=span.trace_id or None,
@@ -200,6 +202,21 @@ class AsynchronousSGDServer(AbstractServer):
     def handle_connection(self, client_id: str) -> None:
         # weights + first batch to the new client (reference :59-63)
         self._send_next_batch(client_id)
+
+    def handle_resync(self, client_id: str) -> None:
+        """Resync repair for the dispatching plane: the client discarded the
+        broadcast (and the batch riding on it), so requeue its outstanding
+        batch and re-dispatch. The base was already cleared by the caller,
+        so the fresh dispatch carries FULL weights; the client's update-id
+        cache keeps the eventual re-train idempotent server-side."""
+        with self._lock:
+            outstanding = self._client_batches.pop(client_id, None)
+            self._client_versions.pop(client_id, None)
+            self._lease_deadlines.pop(client_id, None)
+        if outstanding is not None:
+            self.dataset.requeue(outstanding)
+        self._send_next_batch(client_id)
+        self._dispatch_waiting()
 
     def handle_disconnection(self, client_id: str) -> None:
         # failure recovery: requeue the batch the client died holding
@@ -255,6 +272,11 @@ class AsynchronousSGDServer(AbstractServer):
             # versions older than the token window.
             sent_version = self._version_tokens.get(msg.gradients.version)
             if sent_version is None:
+                # version-token mismatch: the gradient names weights outside
+                # the token window, so this connection's delta base can't be
+                # trusted either — force its next broadcast to a full sync
+                with self._delta_lock:
+                    self._client_bases.pop(client_id, None)
                 sent_version = self._client_versions.get(client_id, self.version_counter)
             staleness = self.version_counter - sent_version
             self._h_staleness.observe(staleness)

@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional
 
 
 from distriflow_tpu.server.abstract_server import AbstractServer
-from distriflow_tpu.utils.messages import Events, UploadMsg
+from distriflow_tpu.utils.messages import DownloadMsg, Events, UploadMsg
 from distriflow_tpu.utils.serialization import (
     SerializedArray,
     deserialize_array,
@@ -32,8 +32,17 @@ class FederatedServer(AbstractServer):
     dropped_uploads = 0
 
     def handle_connection(self, client_id: str) -> None:
-        # send current weights (reference :69)
-        self.transport.emit_to(client_id, Events.Download.value, self.download_msg.to_wire())
+        # send current weights (reference :69) — built per connection so the
+        # delta ledger records what THIS connection was sent (a fresh
+        # connection has no base, so this is always a full broadcast)
+        self.transport.emit_to(
+            client_id,
+            Events.Download.value,
+            DownloadMsg(
+                model=self.download_model_msg(client_id),
+                hyperparams=self.download_msg.hyperparams,
+            ).to_wire(),
+        )
 
     def handle_upload(self, client_id: str, msg: UploadMsg) -> bool:
         """Buffer or drop one gradient upload; maybe aggregate.
@@ -53,6 +62,11 @@ class FederatedServer(AbstractServer):
             except ValueError:
                 self.log(f"dropping upload with unknown version {msg.gradients.version!r}")
                 self.dropped_uploads += 1
+                # version-token mismatch (e.g. pre-restart gradient): the
+                # connection's delta base is equally untrustworthy — its
+                # next broadcast must be a full sync
+                with self._delta_lock:
+                    self._client_bases.pop(client_id, None)
                 return False
             if staleness > self.hyperparams.maximum_staleness or self.updating:
                 # reference drop rule :73 (exact-version + !updating), generalized
@@ -120,7 +134,20 @@ class FederatedServer(AbstractServer):
                 itemsize = _np_dtype(s.dtype).itemsize
             except Exception:
                 return False
-            if len(s.data) != itemsize * int(np.prod(s.shape, dtype=np.int64)):
+            n = int(np.prod(s.shape, dtype=np.int64))
+            if s.indices is not None:
+                # sparse leaf: one value per int32 index, k <= n, and every
+                # index inside the dense extent (shape stays the DENSE shape)
+                if len(s.indices) % 4:
+                    return False
+                k_count = len(s.indices) // 4
+                if k_count > n or len(s.data) != itemsize * k_count:
+                    return False
+                idx = np.frombuffer(s.indices, dtype=np.int32)
+                if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= n):
+                    return False
+                continue
+            if len(s.data) != itemsize * n:
                 return False
         return True
 
@@ -175,5 +202,19 @@ class FederatedServer(AbstractServer):
             self.model.save()
             self.download_msg = self.compute_download_msg()
         self.callbacks.fire("new_version", self.model.version)
-        # broadcast new weights to everyone (reference :80)
-        self.transport.broadcast(Events.Download.value, self.download_msg.to_wire())
+        # new weights to everyone (reference :80) — sent per connection so
+        # each client receives a delta against what IT last installed (full
+        # weights for anything the ledger doesn't know)
+        hyperparams = self.download_msg.hyperparams
+        for cid in self.transport.client_ids:
+            try:
+                self.transport.emit_to(
+                    cid,
+                    Events.Download.value,
+                    DownloadMsg(
+                        model=self.download_model_msg(cid),
+                        hyperparams=hyperparams,
+                    ).to_wire(),
+                )
+            except Exception:
+                pass  # client raced a disconnect; reconnect gets a full send

@@ -61,8 +61,11 @@ def asdict(cfg: Any) -> Dict[str, Any]:
     return dataclasses.asdict(cfg)
 
 
-# allowed gradient_compression values (shared with AbstractClient.compress_grads)
-COMPRESSION_DTYPES = ("none", "float16", "bfloat16", "int8")
+# allowed gradient_compression values (shared with AbstractClient.compress_grads).
+# "topk"/"topk_int8" are the sparse modes: ship only the top-|k| entries per
+# leaf (k = topk_fraction of the leaf size) with client-side error feedback;
+# "topk_int8" additionally int8-quantizes the kept values.
+COMPRESSION_DTYPES = ("none", "float16", "bfloat16", "int8", "topk", "topk_int8")
 
 # allowed weight_compression values (server weight broadcasts): no int8 —
 # quantization error on WEIGHTS compounds every round, unlike gradients
@@ -129,6 +132,12 @@ class ClientHyperparams:
     # before serialization, halving upload bytes; the server accumulates the
     # mean in float32 either way. One of COMPRESSION_DTYPES.
     gradient_compression: str = "none"
+    # sparse-upload knob (gradient_compression in ("topk", "topk_int8")):
+    # fraction of each leaf's entries shipped per update. The un-sent mass
+    # stays in the client's error-feedback residual, so smaller fractions
+    # trade convergence speed for wire bytes, not correctness (DGC, Lin et
+    # al. 2018). Ignored by the dense modes.
+    topk_fraction: float = 0.01
 
     def validate(self) -> "ClientHyperparams":
         if self.batch_size <= 0:
@@ -145,6 +154,10 @@ class ClientHyperparams:
             raise ValueError(
                 f"gradient_compression must be one of {COMPRESSION_DTYPES}, "
                 f"got {self.gradient_compression!r}"
+            )
+        if not 0.0 < self.topk_fraction <= 1.0:
+            raise ValueError(
+                f"topk_fraction must be in (0, 1], got {self.topk_fraction}"
             )
         return self
 
@@ -171,6 +184,13 @@ class ServerHyperparams:
     # offered here: quantization error on weights compounds every round,
     # unlike gradients where error feedback absorbs it.)
     weight_compression: str = "none"
+    # delta weight broadcasts: when True the server tracks the last params
+    # each connection is known to hold and ships per-leaf ``new - base``
+    # (through the same weight_compression cast) instead of full weights,
+    # falling back to a full broadcast whenever the client's base version
+    # is unknown, aged out of the retained window, or the connection is
+    # fresh (first download / reconnect / post-restart).
+    delta_broadcast: bool = True
 
     def validate(self) -> "ServerHyperparams":
         if self.aggregation not in ("mean", "sum"):

@@ -30,23 +30,37 @@ class Events(str, enum.Enum):
 
     Download = "downloadVars"
     Upload = "uploadVars"
+    Resync = "resyncVars"
     Connect = "connect"
     Disconnect = "disconnect"
 
 
 @dataclass
 class ModelMsg:
-    """Versioned weights (reference ``ModelMsg {version, vars}``, ``utils.ts:120-123``)."""
+    """Versioned weights (reference ``ModelMsg {version, vars}``, ``utils.ts:120-123``).
+
+    ``delta_base`` (optional, absent on the wire when unset — old frames
+    parse fine) marks a *delta broadcast*: ``vars`` holds per-leaf
+    ``new - base`` for float leaves (full values for non-float leaves)
+    against the params of version ``delta_base``. A receiver whose
+    installed version is not ``delta_base`` must discard the message and
+    request a full resync (``Events.Resync``) instead of installing.
+    """
 
     version: str
     vars: Dict[str, SerializedArray]
+    delta_base: Optional[str] = None
 
     def to_wire(self) -> Dict[str, Any]:
-        return {"version": self.version, "vars": pack_bytes(self.vars)}
+        d: Dict[str, Any] = {"version": self.version, "vars": pack_bytes(self.vars)}
+        if self.delta_base is not None:
+            d["delta_base"] = self.delta_base
+        return d
 
     @staticmethod
     def from_wire(d: Dict[str, Any]) -> "ModelMsg":
-        return ModelMsg(version=d["version"], vars=unpack_bytes(d["vars"]))
+        return ModelMsg(version=d["version"], vars=unpack_bytes(d["vars"]),
+                        delta_base=d.get("delta_base"))
 
 
 # A gradient message has the same shape as a model message: version it was

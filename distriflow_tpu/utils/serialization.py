@@ -54,16 +54,34 @@ class SerializedArray:
     symmetric-quantized payload: the logical array is
     ``frombuffer(data, dtype) * scale`` in float32 — how int8 gradient
     compression rides the same wire type (see :func:`quantize_array`).
+
+    ``indices`` (optional) marks a *sparse* payload: ``data`` holds only
+    the values at the int32 flat positions in ``indices``; ``shape`` stays
+    the dense shape and every unlisted position is zero. This is how top-k
+    sparsified gradients ride the wire (see :func:`topk_array`) — ``scale``
+    composes, so values may additionally be int8-quantized. Indices must
+    be unique and sorted ascending.
     """
 
     dtype: str
     shape: Tuple[int, ...]
     data: bytes
     scale: Optional[float] = None
+    indices: Optional[bytes] = None
+
+    @property
+    def is_sparse(self) -> bool:
+        return self.indices is not None
 
     @property
     def nbytes(self) -> int:
+        """Value-payload bytes only (the data blob's chunk length)."""
         return len(self.data)
+
+    @property
+    def wire_nbytes(self) -> int:
+        """Total payload bytes on the wire: values + index vector."""
+        return len(self.data) + (len(self.indices) if self.indices is not None else 0)
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -99,7 +117,25 @@ def _dequantize(raw: np.ndarray, scale: float) -> np.ndarray:
 def deserialize_array(s: SerializedArray) -> np.ndarray:
     """SerializedArray -> numpy array (reference ``deserializeVar``, ``utils.ts:77-84``).
 
-    Quantized payloads (``scale`` set) dequantize to float32."""
+    Quantized payloads (``scale`` set) dequantize to float32. Sparse
+    payloads (``indices`` set) densify: zeros at every unlisted position."""
+    if s.indices is not None:
+        idx = np.frombuffer(s.indices, dtype=np.int32)
+        raw = np.frombuffer(s.data, dtype=_np_dtype(s.dtype))
+        if idx.size != raw.size:
+            raise ValueError(
+                f"sparse payload mismatch: {idx.size} indices vs {raw.size} values"
+            )
+        n = int(np.prod(s.shape, dtype=np.int64)) if s.shape else 1
+        if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= n):
+            raise ValueError(f"sparse index out of range for dense shape {s.shape}")
+        if s.scale is not None:
+            dense = np.zeros(n, np.float32)
+            dense[idx] = _dequantize(raw, s.scale)
+        else:
+            dense = np.zeros(n, raw.dtype)
+            dense[idx] = raw
+        return dense.reshape(s.shape)
     raw = np.frombuffer(s.data, dtype=_np_dtype(s.dtype)).reshape(s.shape)
     if s.scale is not None:
         return _dequantize(raw, s.scale)
@@ -133,6 +169,49 @@ def quantize_array(x: Any) -> SerializedArray:
     q = np.clip(np.rint(arr / scale), -127, 127).astype(np.int8)
     return SerializedArray(dtype="int8", shape=tuple(arr.shape),
                            data=q.tobytes(), scale=scale)
+
+
+def topk_array(x: Any, fraction: float, quantize: bool = False) -> SerializedArray:
+    """Top-|k| sparsification: ship only the ``k = max(1, round(fraction*n))``
+    largest-magnitude entries as (sorted int32 flat indices, values).
+
+    The wire half of Deep-Gradient-Compression-style uploads: at
+    ``fraction=0.01`` the payload is ~2% of dense float32 (4-byte index +
+    4-byte value per kept entry), ~1.25% with ``quantize=True`` (4-byte
+    index + 1-byte value through the :func:`quantize_array` scale
+    machinery). Callers keep the un-sent mass as an error-feedback
+    residual — ``deserialize_array`` of the result gives exactly the dense
+    tensor the server will see, so ``residual = g - deserialize_array(sa)``
+    carries both the dropped entries and the quantization error forward.
+    Non-finite entries are zeroed first (:func:`sanitize_finite`).
+    """
+    arr = sanitize_finite(np.asarray(x, np.float32))
+    shape = tuple(arr.shape)
+    flat = arr.reshape(-1)
+    n = flat.size
+    if n == 0:
+        return SerializedArray(
+            dtype="int8" if quantize else "float32", shape=shape, data=b"",
+            scale=1.0 if quantize else None, indices=b"",
+        )
+    k = min(n, max(1, int(round(float(fraction) * n))))
+    if k >= n:
+        idx = np.arange(n, dtype=np.int32)
+    else:
+        part = np.argpartition(np.abs(flat), n - k)[n - k:]
+        idx = np.sort(part).astype(np.int32)
+    vals = flat[idx]
+    if quantize:
+        q = quantize_array(vals)
+        return SerializedArray(dtype="int8", shape=shape, data=q.data,
+                               scale=q.scale, indices=idx.tobytes())
+    return SerializedArray(dtype="float32", shape=shape,
+                           data=vals.tobytes(), indices=idx.tobytes())
+
+
+def tree_wire_nbytes(serialized: Dict[str, SerializedArray]) -> int:
+    """Total wire payload bytes of a serialized tree (values + sparse indices)."""
+    return sum(s.wire_nbytes for s in serialized.values())
 
 
 def cast_tree(tree: Any, dtype_name: str) -> Any:
@@ -212,6 +291,11 @@ def mean_serialized(
     independently): each update is decoded with its own dtype. Float leaves
     at <=32-bit accumulate in float32; float64/integer leaves accumulate in
     float64. The result always lands on the template leaf's dtype.
+
+    Sparse updates (top-k, ``indices`` set) scatter-add their values
+    directly into the dense accumulator — no per-update densified copy is
+    ever materialized. Quantized (int8) updates dequant-accumulate in one
+    fused vectorized pass through a reusable scratch buffer.
     """
     if not updates:
         raise ValueError("mean_serialized needs at least one update")
@@ -238,33 +322,79 @@ def mean_serialized(
             raise ValueError(
                 f"shape mismatch at {key!r}: update {first.shape} vs template {tuple(t_shape)}"
             )
-        def view(sa):
-            raw = np.frombuffer(sa.data, dtype=_np_dtype(sa.dtype)).reshape(first.shape)
-            if sa.scale is not None:  # quantized: dequantize to f32 (fast path eligible)
-                return _dequantize(raw, sa.scale)
-            return raw
+        leaf_updates = [u[key] for u in updates]
 
-        views = [view(u[key]) for u in updates]
-        t_dtype = np.dtype(getattr(template, "dtype", views[0].dtype))
-        all_f32 = all(v.dtype.kind == "f" and v.dtype.itemsize <= 4 for v in views)
-        if weights is None and all_f32:
+        def raw_view(sa):
+            return np.frombuffer(sa.data, dtype=_np_dtype(sa.dtype)).reshape(first.shape)
+
+        def sparse_parts(sa):
+            idx = np.frombuffer(sa.indices, dtype=np.int32)
+            raw = np.frombuffer(sa.data, dtype=_np_dtype(sa.dtype))
+            return idx, raw
+
+        has_sparse = any(sa.indices is not None for sa in leaf_updates)
+        has_quant = any(sa.scale is not None for sa in leaf_updates)
+        # float64/integer *unquantized dense* leaves force the wide path;
+        # quantized and sparse contributions always land as float32
+        wide = any(
+            sa.indices is None and sa.scale is None
+            and not (_np_dtype(sa.dtype).kind == "f" and _np_dtype(sa.dtype).itemsize <= 4)
+            for sa in leaf_updates
+        )
+        t_dtype = np.dtype(getattr(template, "dtype", None) or
+                           ("float32" if (has_quant or has_sparse) else leaf_updates[0].dtype))
+        if weights is None and not wide and not has_sparse and not has_quant:
             # fp32/16-bit floats: the C kernel casts each view to fp32
             # individually (leaf-sized copies, no stacked staging tensor)
-            mean = native.mean_buffers(views)
-        elif all_f32:
-            # weighted fp32 accumulation (same precision as the C kernel)
+            mean = native.mean_buffers([raw_view(sa) for sa in leaf_updates])
+        elif not wide:
+            # fp32 accumulation. Quantized updates dequant-accumulate in one
+            # fused pass through a single reusable scratch buffer — no
+            # per-update dequantized float32 copy. Sparse updates scatter-add
+            # straight into the accumulator without densifying.
             acc = np.zeros(first.shape, np.float32)
-            for w, v in zip(weights, views):
-                acc += np.float32(w) * v.astype(np.float32)
-            mean = acc / np.float32(len(views))
+            flat_acc = acc.reshape(-1)
+            scratch = None
+            for i, sa in enumerate(leaf_updates):
+                w = np.float32(1.0 if weights is None else weights[i])
+                if sa.indices is not None:
+                    idx, raw = sparse_parts(sa)
+                    vals = (_dequantize(raw, sa.scale) if sa.scale is not None
+                            else raw.astype(np.float32))
+                    if w != 1.0:
+                        vals = w * vals
+                    np.add.at(flat_acc, idx, vals)
+                elif sa.scale is not None:
+                    if scratch is None:
+                        scratch = np.empty(first.shape, np.float32)
+                    np.multiply(raw_view(sa), np.float32(sa.scale), out=scratch)
+                    if w != 1.0:
+                        scratch *= w
+                    acc += scratch
+                else:
+                    v = raw_view(sa)
+                    if w != 1.0:
+                        acc += w * v.astype(np.float32)
+                    else:
+                        acc += v.astype(np.float32, copy=False)
+            mean = acc / np.float32(len(leaf_updates))
         else:
             # float64 / integer leaves: float64 accumulation keeps the full
             # mantissa (int means are exact below 2^53)
             acc = np.zeros(first.shape, np.float64)
-            for i, v in enumerate(views):
+            flat_acc = acc.reshape(-1)
+            for i, sa in enumerate(leaf_updates):
                 w = 1.0 if weights is None else weights[i]
-                acc += w * v.astype(np.float64)
-            mean = acc / len(views)
+                if sa.indices is not None:
+                    idx, raw = sparse_parts(sa)
+                    vals = (_dequantize(raw, sa.scale) if sa.scale is not None else raw)
+                    np.add.at(flat_acc, idx, w * vals.astype(np.float64))
+                else:
+                    v = raw_view(sa)
+                    if sa.scale is not None:
+                        v = _dequantize(v, sa.scale)
+                    acc += w * v.astype(np.float64)
+            mean = acc / len(leaf_updates)
         if t_dtype.kind in "iu":
             mean = np.rint(mean)
         leaves.append(mean.astype(t_dtype) if mean.dtype != t_dtype else mean)
@@ -294,25 +424,37 @@ def stack_serialized(updates: Sequence[Dict[str, SerializedArray]]) -> Dict[str,
 
     Aggregation prep: after this, the server's mean is a single ``mean(axis=0)``
     per leaf (reference ``stackSerialized``, ``src/common/utils.ts:53-75``,
-    consumed by ``federated_server.ts:98-106``). The byte-level concat is kept:
-    buffers are joined without an intermediate decode.
+    consumed by ``federated_server.ts:98-106``). Homogeneous unquantized
+    leaves keep the byte-level concat: buffers are joined without an
+    intermediate decode. Quantized leaves carry per-update scales that a
+    byte concat would lose, so each update's scale is broadcast across its
+    payload during accumulation and the stacked leaf lands dense float32;
+    sparse (top-k) leaves densify the same way.
     """
     if not updates:
         raise ValueError("stack_serialized needs at least one update")
-    if any(s.scale is not None for u in updates for s in u.values()):
-        raise ValueError(
-            "quantized updates carry per-update scales and cannot be "
-            "byte-stacked; aggregate them with mean_serialized instead"
-        )
-    _validate_matching_leaves(updates)
+    _validate_matching_leaves(updates, check_dtype=False)
     out: Dict[str, SerializedArray] = {}
     n = len(updates)
     for key in updates[0]:
-        first = updates[0][key]
+        leaf_updates = [u[key] for u in updates]
+        first = leaf_updates[0]
+        if any(sa.scale is not None or sa.indices is not None for sa in leaf_updates):
+            stacked = np.empty((n,) + first.shape, np.float32)
+            for i, sa in enumerate(leaf_updates):
+                stacked[i] = deserialize_array(sa).astype(np.float32, copy=False)
+            out[key] = SerializedArray(
+                dtype="float32", shape=(n,) + first.shape, data=stacked.tobytes()
+            )
+            continue
+        if any(sa.dtype != first.dtype for sa in leaf_updates):
+            raise ValueError(
+                f"leaf {key!r} mixes dtypes across updates and cannot be byte-stacked"
+            )
         out[key] = SerializedArray(
             dtype=first.dtype,
             shape=(n,) + first.shape,
-            data=b"".join(u[key].data for u in updates),
+            data=b"".join(sa.data for sa in leaf_updates),
         )
     return out
 
@@ -325,11 +467,19 @@ def stack_serialized(updates: Sequence[Dict[str, SerializedArray]]) -> Dict[str,
 # ---------------------------------------------------------------------------
 
 _MAGIC = b"DFTP"  # DistriFlow-TPU packed format
-_VERSION = 1
+_VERSION = 1         # dense-only blobs (all pre-sparse readers parse these)
+_VERSION_SPARSE = 2  # >=1 sparse leaf: per-leaf encoding="sparse" + index chunk
 
 
 def flat_serialize(serialized: Dict[str, SerializedArray]) -> Tuple[bytes, Dict[str, Any]]:
-    """{path: SerializedArray} -> (packed data blob, meta dict)."""
+    """{path: SerializedArray} -> (packed data blob, meta dict).
+
+    Dense-only trees emit format version 1 — byte-identical to the
+    pre-sparse encoding, so old checkpoints and old readers are
+    unaffected. A tree with any sparse leaf emits version 2: the leaf's
+    value chunk is followed by its int32 index chunk, addressed by
+    ``indices_offset``/``indices_nbytes`` and tagged ``encoding="sparse"``.
+    """
     meta: Dict[str, Any] = {"format": "dftp-flat", "version": _VERSION, "leaves": []}
     chunks: List[bytes] = []
     offset = 0
@@ -344,9 +494,17 @@ def flat_serialize(serialized: Dict[str, SerializedArray]) -> Tuple[bytes, Dict[
         }
         if s.scale is not None:
             leaf_meta["scale"] = s.scale
-        meta["leaves"].append(leaf_meta)
         chunks.append(s.data)
         offset += s.nbytes
+        if s.indices is not None:
+            meta["version"] = _VERSION_SPARSE
+            leaf_meta["encoding"] = "sparse"
+            leaf_meta["index_dtype"] = "int32"
+            leaf_meta["indices_offset"] = offset
+            leaf_meta["indices_nbytes"] = len(s.indices)
+            chunks.append(s.indices)
+            offset += len(s.indices)
+        meta["leaves"].append(leaf_meta)
     return b"".join(chunks), meta
 
 
@@ -354,13 +512,24 @@ def flat_deserialize(data: bytes, meta: Dict[str, Any]) -> Dict[str, SerializedA
     """(packed blob, meta dict) -> {path: SerializedArray}."""
     if meta.get("format") != "dftp-flat":
         raise ValueError(f"not a dftp-flat blob: {meta.get('format')!r}")
+    version = meta.get("version", _VERSION)
+    if version not in (_VERSION, _VERSION_SPARSE):
+        raise ValueError(f"unsupported dftp-flat version: {version!r}")
     out: Dict[str, SerializedArray] = {}
     for leaf in meta["leaves"]:
         start = leaf["byte_offset"]
         end = start + leaf["nbytes"]
+        indices = None
+        if leaf.get("encoding") == "sparse":
+            if leaf.get("index_dtype", "int32") != "int32":
+                raise ValueError(
+                    f"unsupported sparse index dtype: {leaf.get('index_dtype')!r}"
+                )
+            i_start = leaf["indices_offset"]
+            indices = data[i_start : i_start + leaf["indices_nbytes"]]
         out[leaf["name"]] = SerializedArray(
             dtype=leaf["dtype"], shape=tuple(leaf["shape"]),
-            data=data[start:end], scale=leaf.get("scale")
+            data=data[start:end], scale=leaf.get("scale"), indices=indices
         )
     return out
 
@@ -386,7 +555,9 @@ def unpack_bytes(buf: bytes) -> Dict[str, SerializedArray]:
         raise ValueError(f"truncated dftp buffer: {len(buf)} bytes, meta needs {8 + meta_len}")
     meta = json.loads(buf[8 : 8 + meta_len].decode("utf-8"))
     blob = buf[8 + meta_len :]
-    expected = sum(leaf["nbytes"] for leaf in meta.get("leaves", []))
+    expected = sum(
+        leaf["nbytes"] + leaf.get("indices_nbytes", 0) for leaf in meta.get("leaves", [])
+    )
     if len(blob) < expected:
         raise ValueError(f"truncated dftp buffer: blob has {len(blob)} bytes, meta declares {expected}")
     return flat_deserialize(blob, meta)

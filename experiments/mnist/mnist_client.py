@@ -31,11 +31,13 @@ def main(argv=None) -> None:
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--seed", type=int, default=1, help="federated-mode local shard seed")
     p.add_argument("--gradient-compression",
-                   choices=("none", "float16", "bfloat16", "int8"),
+                   choices=("none", "float16", "bfloat16", "int8", "topk",
+                            "topk_int8"),
                    default=None,
                    help="upload compression (int8 = 4x fewer bytes with "
-                        "error feedback); default: whatever the server "
-                        "pushes, else none")
+                        "error feedback; topk/topk_int8 = sparse top-k, "
+                        "~50-80x on conv nets); default: whatever the "
+                        "server pushes, else none")
     args = p.parse_args(argv)
 
     hp = ({"gradient_compression": args.gradient_compression}

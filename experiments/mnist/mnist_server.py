@@ -49,6 +49,8 @@ def build_server(args: argparse.Namespace) -> AbstractServer:
         # pushed to every client on download (hyperparam precedence:
         # a client's local setting still wins)
         client_hp["gradient_compression"] = args.gradient_compression
+        if getattr(args, "topk_fraction", None):
+            client_hp["topk_fraction"] = args.topk_fraction
     if client_hp:
         config.client_hyperparams = client_hp
     if args.mode == "async":
@@ -87,8 +89,14 @@ def main(argv=None) -> None:
     p.add_argument("--weight-compression", choices=("float16", "bfloat16"),
                    default=None, help="16-bit weight broadcasts")
     p.add_argument("--gradient-compression",
-                   choices=("float16", "bfloat16", "int8"), default=None,
-                   help="push this upload compression to every client")
+                   choices=("float16", "bfloat16", "int8", "topk",
+                            "topk_int8"), default=None,
+                   help="push this upload compression to every client "
+                        "(topk*: sparse top-k with error feedback, see "
+                        "docs/PERFORMANCE.md §8)")
+    p.add_argument("--topk-fraction", type=float, default=None,
+                   help="fraction of gradient entries the topk modes keep "
+                        "per leaf (default 0.01)")
     p.add_argument("--quiet", action="store_true", help="suppress progress logs")
     p.add_argument("--verbose", action="store_true",
                    help="accepted for compatibility (progress logs are on by default)")

@@ -284,12 +284,27 @@ def test_mean_serialized_mixes_int8_and_float_updates():
     np.testing.assert_allclose(got["w"], np.mean(exact, 0), atol=2e-2)
 
 
-def test_stack_serialized_rejects_quantized():
-    from distriflow_tpu.utils.serialization import quantize_array, stack_serialized
+def test_stack_serialized_handles_quantized():
+    """Quantized updates stack too: each update's scale travels with it, so
+    the stacked leaf is the float32 dequantization — per-update scales are
+    honored even when they differ (the old byte-stack path couldn't and
+    raised)."""
+    from distriflow_tpu.utils.serialization import (
+        deserialize_array,
+        quantize_array,
+        stack_serialized,
+    )
 
-    q = {"w": quantize_array(np.ones((4,), np.float32))}
-    with pytest.raises(ValueError, match="byte-stacked"):
-        stack_serialized([q, q])
+    a = np.linspace(-1.0, 1.0, 8).astype(np.float32)
+    b = (4.0 * a).astype(np.float32)  # different max -> different scale
+    qa, qb = quantize_array(a), quantize_array(b)
+    assert qa.scale != qb.scale
+    stacked = stack_serialized([{"w": qa}, {"w": qb}])
+    got = deserialize_array(stacked["w"])
+    assert got.dtype == np.float32 and got.shape == (2, 8)
+    np.testing.assert_allclose(got[0], deserialize_array(qa))
+    np.testing.assert_allclose(got[1], deserialize_array(qb))
+    np.testing.assert_allclose(got, np.stack([a, b]), atol=4.0 / 127 + 1e-6)
 
 
 def test_int8_error_feedback_accumulates():

@@ -1,0 +1,415 @@
+"""Sparse/delta wire layer: golden encodings, error feedback, delta broadcasts.
+
+Covers the three legs of the sparse wire (docs/PERFORMANCE.md §8):
+
+- **encoding**: the versioned sparse leaf in ``flat_serialize``/``pack_bytes``
+  round-trips across every supported dtype, and the packed bytes match a
+  hand-built golden blob (the format is a compatibility contract — readers
+  in other incarnations parse these buffers);
+- **uploads**: top-k selection + error feedback converge to the dense loss
+  on a real MLP at 1% density, and the server-side sparse/quantized mean
+  is exact (scatter-add; the fused int8 pass is bit-identical to the old
+  two-step dequant-accumulate);
+- **broadcasts**: delta frames install only on a matching base; a mismatch
+  triggers the resync round trip and ends fully synced.
+"""
+
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.utils.serialization import (
+    SerializedArray,
+    deserialize_array,
+    deserialize_tree,
+    mean_serialized,
+    pack_bytes,
+    quantize_array,
+    serialize_tree,
+    topk_array,
+    tree_wire_nbytes,
+    unpack_bytes,
+)
+
+pytestmark = pytest.mark.wire
+
+
+# -- sparse leaf encoding ---------------------------------------------------
+
+
+def _sparse_leaf(dtype_name):
+    """A (dense reference, sparse SerializedArray) pair for one dtype."""
+    from distriflow_tpu.utils.serialization import _np_dtype
+
+    dt = _np_dtype(dtype_name)
+    if dtype_name == "bool":
+        vals = np.array([True, True, True], dt)
+    else:
+        vals = np.array([3, 1, 2], dt)
+    idx = np.array([0, 4, 8], np.int32)
+    dense = np.zeros(9, dt)
+    dense[idx] = vals
+    sa = SerializedArray(
+        dtype=dtype_name, shape=(3, 3), data=vals.tobytes(), indices=idx.tobytes()
+    )
+    return dense.reshape(3, 3), sa
+
+
+def test_sparse_round_trip_all_dtypes():
+    from distriflow_tpu.utils.serialization import _SUPPORTED_DTYPES
+
+    for name in sorted(_SUPPORTED_DTYPES):
+        dense, sa = _sparse_leaf(name)
+        out = unpack_bytes(pack_bytes({"g": sa}))["g"]
+        assert out.indices == sa.indices, name
+        assert out.shape == (3, 3) and out.dtype == name
+        got = deserialize_array(out)
+        assert got.dtype == dense.dtype, name
+        np.testing.assert_array_equal(got, dense, err_msg=name)
+
+
+def test_sparse_quantized_round_trip():
+    g = np.zeros(16, np.float32)
+    g[[2, 9]] = [0.5, -1.0]
+    sa = topk_array(g, 2 / 16, quantize=True)
+    out = unpack_bytes(pack_bytes({"g": sa}))["g"]
+    assert out.scale is not None and out.indices is not None
+    np.testing.assert_allclose(deserialize_array(out), g, atol=1.0 / 127 + 1e-7)
+
+
+def test_sparse_golden_packed_bytes():
+    """The exact on-the-wire bytes of a sparse frame are pinned: magic,
+    little-endian meta length, the version-2 meta JSON (field order
+    included), value chunk, then index chunk. Breaking this breaks every
+    peer that didn't upgrade in lockstep."""
+    vals = np.array([1.5, -2.0], np.float32)
+    idx = np.array([1, 3], np.int32)
+    sa = SerializedArray(
+        dtype="float32", shape=(4,), data=vals.tobytes(), indices=idx.tobytes()
+    )
+    meta = (
+        b'{"format":"dftp-flat","version":2,"leaves":['
+        b'{"name":"g","dtype":"float32","shape":[4],"byte_offset":0,"nbytes":8,'
+        b'"encoding":"sparse","index_dtype":"int32",'
+        b'"indices_offset":8,"indices_nbytes":8}]}'
+    )
+    expected = b"DFTP" + struct.pack("<I", len(meta)) + meta + vals.tobytes() + idx.tobytes()
+    assert pack_bytes({"g": sa}) == expected
+
+
+def test_dense_trees_still_emit_version_1():
+    """Dense-only blobs stay byte-identical to the pre-sparse format —
+    old readers (and old checkpoints) are unaffected."""
+    import json
+
+    buf = pack_bytes(serialize_tree({"w": np.ones((2,), np.float32)}))
+    (meta_len,) = struct.unpack("<I", buf[4:8])
+    meta = json.loads(buf[8 : 8 + meta_len])
+    assert meta["version"] == 1
+    assert "encoding" not in meta["leaves"][0]
+
+
+def test_unpack_rejects_truncated_sparse_blob():
+    buf = pack_bytes({"g": _sparse_leaf("float32")[1]})
+    with pytest.raises(ValueError):
+        unpack_bytes(buf[:-4])
+
+
+# -- top-k selection + error feedback ---------------------------------------
+
+
+def test_topk_keeps_largest_magnitudes():
+    g = np.array([0.1, -5.0, 0.2, 3.0, -0.05, 0.0, 4.0, -2.0], np.float32)
+    sa = topk_array(g, 3 / 8)
+    idx = np.frombuffer(sa.indices, np.int32)
+    assert sorted(idx.tolist()) == idx.tolist()  # ascending, unique
+    assert set(idx.tolist()) == {1, 3, 6}  # the three largest |g|
+    dense = deserialize_array(sa)
+    np.testing.assert_array_equal(dense[idx], g[idx])
+    assert np.count_nonzero(dense) == 3
+    # wire accounting: values + indices, ~k/n of the dense payload
+    assert tree_wire_nbytes({"g": sa}) == 3 * 4 + 3 * 4
+
+
+def test_topk_error_feedback_converges_to_dense_loss(devices):
+    """DGC's claim on our MLP: 1% top-k with error feedback reaches the
+    dense loss within tolerance — dropped mass is re-injected into later
+    uploads, not lost."""
+    from distriflow_tpu.client.abstract_client import (
+        AbstractClient,
+        DistributedClientConfig,
+    )
+    from distriflow_tpu.models import SpecModel, mnist_mlp
+
+    class _Probe(AbstractClient):
+        def __init__(self, mode):
+            self.config = DistributedClientConfig(
+                hyperparams={"gradient_compression": mode, "topk_fraction": 0.01}
+            )
+            self.msg = None
+            self._quant_error = None
+
+    rng = np.random.RandomState(0)
+    n = 256
+    x = rng.randn(n, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, n)
+    x[np.arange(n), 0, labels, 0] += 4.0
+    y = np.eye(10, dtype=np.float32)[labels]
+
+    def run(mode):
+        model = SpecModel(mnist_mlp(hidden=16), learning_rate=0.1)
+        model.setup()
+        probe = _Probe(mode) if mode != "none" else None
+        for step in range(60):
+            lo = (step * 32) % n
+            grads = model.fit(x[lo : lo + 32], y[lo : lo + 32])
+            if probe is not None:
+                sent = probe.serialize_grads(grads)
+                grads = deserialize_tree(sent, model.get_params())
+            model.update(grads)
+        return float(model.evaluate(x, y)[0])
+
+    dense_loss = run("none")
+    topk_loss = run("topk")
+    assert topk_loss < 1.0, f"top-k run failed to learn: {topk_loss}"
+    assert topk_loss <= dense_loss + 0.25, (dense_loss, topk_loss)
+
+
+# -- sparse / fused aggregation ---------------------------------------------
+
+
+def test_mean_serialized_scatter_adds_sparse_updates():
+    template = {"w": np.zeros((8,), np.float32)}
+    a = np.array([0, 4.0, 0, 0, -2.0, 0, 0, 0], np.float32)
+    b = np.array([1.0, 0, 0, 0, 0, 0, 0, 3.0], np.float32)
+    c = np.arange(8, dtype=np.float32)
+    updates = [
+        {"['w']": topk_array(a, 2 / 8)},
+        {"['w']": topk_array(b, 2 / 8)},
+        serialize_tree({"w": c}),
+    ]
+    got = mean_serialized(updates, template)
+    np.testing.assert_allclose(got["w"], (a + b + c) / 3, rtol=1e-6)
+    weighted = mean_serialized(updates, template, weights=[0.5, 1.0, 2.0])
+    np.testing.assert_allclose(
+        weighted["w"], (0.5 * a + 1.0 * b + 2.0 * c) / 3, rtol=1e-6
+    )
+
+
+def test_mean_serialized_sparse_quantized_within_tolerance():
+    template = {"w": np.zeros((32,), np.float32)}
+    rng = np.random.RandomState(3)
+    dense = [rng.randn(32).astype(np.float32) for _ in range(4)]
+    updates = [{"['w']": topk_array(g, 1.0, quantize=True)} for g in dense]
+    got = mean_serialized(updates, template)
+    scale = max(float(np.max(np.abs(g))) for g in dense) / 127
+    np.testing.assert_allclose(got["w"], np.mean(dense, 0), atol=scale + 1e-6)
+
+
+def test_mean_serialized_int8_fused_pass_is_bit_identical():
+    """The fused dequant-accumulate (one vectorized multiply into a scratch
+    buffer per update) must be BIT-identical to the old two-step path:
+    ``raw.astype(float32) * float32(scale)`` summed in float32."""
+    rng = np.random.RandomState(7)
+    shape = (33, 7)
+    dense = [(rng.randn(*shape) * 10.0 ** rng.randint(-2, 2)).astype(np.float32)
+             for _ in range(5)]
+    updates = [{"['w']": quantize_array(g)} for g in dense]
+    template = {"w": np.zeros(shape, np.float32)}
+
+    def reference(weights=None):
+        acc = np.zeros(shape, np.float32)
+        for i, u in enumerate(updates):
+            sa = u["['w']"]
+            v = np.frombuffer(sa.data, np.int8).reshape(shape).astype(np.float32)
+            v = v * np.float32(sa.scale)
+            acc += np.float32(weights[i]) * v if weights is not None else v
+        return acc / np.float32(len(updates))
+
+    got = mean_serialized(updates, template)
+    assert np.asarray(got["w"]).tobytes() == reference().tobytes()
+    w = [0.5, 1.0, 0.25, 2.0, 1.5]
+    got_w = mean_serialized(updates, template, weights=w)
+    assert np.asarray(got_w["w"]).tobytes() == reference(w).tobytes()
+
+
+# -- delta broadcasts --------------------------------------------------------
+
+
+class _InstallProbe:
+    """Just enough client to drive ``set_params_from``."""
+
+    def __init__(self, model):
+        self.model = model
+        self._installed_version = None
+
+    set_params_from = __import__(
+        "distriflow_tpu.client.abstract_client", fromlist=["AbstractClient"]
+    ).AbstractClient.set_params_from
+
+
+def test_set_params_from_applies_delta_only_on_matching_base():
+    from distriflow_tpu.utils.messages import DownloadMsg, ModelMsg
+
+    from mock_model import MockModel
+
+    m = MockModel()
+    probe = _InstallProbe(m)
+    base = {k: np.array(v, copy=True) for k, v in m.get_params().items()}
+    full = DownloadMsg(model=ModelMsg(version="v1", vars=serialize_tree(base)))
+    assert probe.set_params_from(full) is True
+    assert probe._installed_version == "v1"
+
+    delta = {"w": np.full((4,), 0.25, np.float32), "b": np.ones((2,), np.float32)}
+    ok = DownloadMsg(
+        model=ModelMsg(version="v2", vars=serialize_tree(delta), delta_base="v1")
+    )
+    assert probe.set_params_from(ok) is True
+    np.testing.assert_allclose(m.get_params()["w"], base["w"] + 0.25)
+    np.testing.assert_allclose(m.get_params()["b"], base["b"] + 1.0)
+    assert probe._installed_version == "v2"
+
+    # wrong foundation: refused, nothing installed, version unchanged
+    before = {k: np.array(v, copy=True) for k, v in m.get_params().items()}
+    bad = DownloadMsg(
+        model=ModelMsg(version="v3", vars=serialize_tree(delta), delta_base="bogus")
+    )
+    assert probe.set_params_from(bad) is False
+    assert probe._installed_version == "v2"
+    np.testing.assert_array_equal(m.get_params()["w"], before["w"])
+
+
+def _fed_pair(tmp_path, tel):
+    from distriflow_tpu.client import DistributedClientConfig, FederatedClient
+    from distriflow_tpu.server import (
+        DistributedServerConfig,
+        DistributedServerInMemoryModel,
+        FederatedServer,
+    )
+
+    from mock_model import MockModel
+
+    server = FederatedServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(
+            server_hyperparams={"min_updates_per_version": 1},
+            client_hyperparams={"examples_per_update": 2},
+            save_dir=str(tmp_path / "m"),
+            telemetry=tel,
+        ),
+    )
+    server.setup()
+    client = FederatedClient(
+        server.address, MockModel(), DistributedClientConfig(telemetry=tel)
+    )
+    client.setup()
+    return server, client
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while not pred() and time.time() < deadline:
+        time.sleep(0.01)
+    assert pred()
+
+
+def test_delta_broadcast_end_to_end(tmp_path):
+    """Handshake goes out full; the post-aggregation broadcast goes out as a
+    delta; the client lands on exactly the server's weights either way."""
+    from distriflow_tpu.obs.telemetry import Telemetry
+
+    tel = Telemetry()
+    server, client = _fed_pair(tmp_path, tel)
+    try:
+        assert tel.counter_value("comm_broadcasts_full_total", role="server") == 1
+        x = np.ones((2, 4), np.float32)
+        y = np.ones((2, 2), np.float32)
+        client.distributed_update(x, y)  # 2 examples -> upload -> aggregate
+        _wait(lambda: client._installed_version == server.model.version)
+        assert tel.counter_value("comm_broadcasts_delta_total", role="server") >= 1
+        assert tel.counter_value("comm_broadcasts_full_total", role="server") == 1
+        assert tel.counter_value("comm_resyncs_total", role="server") == 0
+        np.testing.assert_allclose(
+            np.asarray(client.model.get_params()["w"]),
+            np.asarray(server.model.get_params()["w"]),
+            rtol=1e-6,
+        )
+    finally:
+        client.dispose()
+        server.stop()
+
+
+def test_delta_mismatch_resyncs_to_full(tmp_path):
+    """A client whose base diverged (poisoned installed-version here; a
+    dropped broadcast in real life) refuses the delta, asks for a resync,
+    and is repaired with a FULL broadcast."""
+    from distriflow_tpu.obs.telemetry import Telemetry
+
+    tel = Telemetry()
+    server, client = _fed_pair(tmp_path, tel)
+    try:
+        client._installed_version = "poisoned"
+        x = np.ones((2, 4), np.float32)
+        y = np.ones((2, 2), np.float32)
+        client.distributed_update(x, y)  # delta broadcast -> refused -> resync
+        _wait(lambda: tel.counter_value("comm_resyncs_total", role="server") >= 1)
+        _wait(lambda: client._installed_version == server.model.version)
+        assert tel.counter_value("comm_resyncs_total", role="client") >= 1
+        # handshake full + resync-repair full
+        assert tel.counter_value("comm_broadcasts_full_total", role="server") >= 2
+        np.testing.assert_allclose(
+            np.asarray(client.model.get_params()["w"]),
+            np.asarray(server.model.get_params()["w"]),
+            rtol=1e-6,
+        )
+    finally:
+        client.dispose()
+        server.stop()
+
+
+def test_sparse_upload_counted_and_applied(tmp_path):
+    """topk uploads ride the wire end-to-end: the server's sparse-frame and
+    byte counters move, and the aggregated model still steps."""
+    from distriflow_tpu.client import DistributedClientConfig, FederatedClient
+    from distriflow_tpu.obs.telemetry import Telemetry
+    from distriflow_tpu.server import (
+        DistributedServerConfig,
+        DistributedServerInMemoryModel,
+        FederatedServer,
+    )
+
+    from mock_model import MockModel
+
+    tel = Telemetry()
+    server = FederatedServer(
+        DistributedServerInMemoryModel(MockModel()),
+        DistributedServerConfig(
+            server_hyperparams={"min_updates_per_version": 1},
+            client_hyperparams={
+                "examples_per_update": 2,
+                "gradient_compression": "topk",
+                "topk_fraction": 0.5,
+            },
+            save_dir=str(tmp_path / "m"),
+            telemetry=tel,
+        ),
+    )
+    server.setup()
+    client = FederatedClient(
+        server.address, MockModel(), DistributedClientConfig(telemetry=tel)
+    )
+    client.setup()
+    try:
+        x = np.ones((2, 4), np.float32)
+        y = np.ones((2, 2), np.float32)
+        client.distributed_update(x, y)
+        _wait(lambda: server.model.model.update_calls >= 1)
+        assert tel.counter_value("comm_uploads_sparse_total", role="server") >= 1
+        up = tel.counter_value("comm_up_bytes_total", role="server")
+        assert 0 < up < 6 * 4 * 2  # strictly less than the dense payload
+    finally:
+        client.dispose()
+        server.stop()
