@@ -228,10 +228,41 @@ def _timed_chunked(trainer, make_chunk, steps, rounds, batch, reps=3,
 
 def _mfu_or_none(trainer, batch, step_seconds):
     try:
-        return round(trainer.mfu(batch, step_seconds=step_seconds), 4)
+        mfu = round(trainer.mfu(batch, step_seconds=step_seconds), 4)
     except ValueError as e:  # unknown device kind (CPU runs) / no flop counts
         log(f"mfu unavailable: {e}")
         return None
+    # live-gauge cross-check (docs/OBSERVABILITY.md §6): mfu() mirrors its
+    # result into train_mfu{mode=sync} for the health sentinel — the bench
+    # reads the gauge back so a drift between the row and the SLO surface
+    # cannot go unnoticed
+    from distriflow_tpu.obs.telemetry import get_telemetry
+
+    g = get_telemetry().registry.find("train_mfu", mode="sync")
+    live = getattr(g, "value", None) if g is not None else None
+    if live is None or abs(live - mfu) > 1e-3:
+        log(f"WARN live train_mfu gauge {live!r} != row mfu {mfu}")
+    return mfu
+
+
+def _phase_digest(role):
+    """(count, sum_ms) per phase/step digest of ``role``'s continuous
+    profiler (docs/OBSERVABILITY.md §5) — (0, 0.0) for digests with no
+    samples yet, so callers can diff before/after a timed section."""
+    from distriflow_tpu.obs.telemetry import get_telemetry
+
+    reg = get_telemetry().registry
+    out = {}
+    probes = [("fit", ("phase_ms",), {"phase": "fit", "role": role}),
+              ("submit", ("phase_ms",), {"phase": "submit", "role": role}),
+              ("wall", ("phase_step_wall_ms",), {"role": role}),
+              ("overlap", ("phase_step_overlap_ms",), {"role": role}),
+              ("idle", ("phase_step_idle_ms",), {"role": role})]
+    for key, (metric,), labels in probes:
+        h = reg.find(metric, **labels)
+        s = h.summary() if h is not None else None
+        out[key] = (s["count"], s["sum"]) if s else (0, 0.0)
+    return out
 
 
 # -- config #1: MNIST MLP sync-SGD ----------------------------------------
@@ -497,6 +528,9 @@ def bench_cifar_async(matrix):
     warm_uploads = trainer.applied_updates + trainer.rejected_updates
     for k in trainer.phase_ms:
         trainer.phase_ms[k] = 0.0
+    # the continuous profiler kept recording through the warm-up; diff its
+    # digests across the timed train() only (docs/OBSERVABILITY.md §5)
+    prof_base = _phase_digest("trainer")
 
     workers = 4
     start = time.perf_counter()
@@ -520,6 +554,28 @@ def bench_cifar_async(matrix):
                           if k != "drain")
     unattributed_ms = wall_ms - drain_ms - dispatch_sum_ms / workers
     phases = {k: round(v / uploads, 1) for k, v in trainer.phase_ms.items()}
+
+    # profiler digest deltas: per-upload phase means plus the step-level
+    # overlap/idle attribution, and the reconciliation the acceptance gate
+    # checks — per-worker step wall + drain must land within 5% of wall
+    prof_now = _phase_digest("trainer")
+
+    def _delta_mean(key):
+        c = prof_now[key][0] - prof_base[key][0]
+        s = prof_now[key][1] - prof_base[key][1]
+        return round(s / c, 1) if c else None
+
+    fit_ms = _delta_mean("fit")
+    submit_ms = _delta_mean("submit")
+    overlap_ms = _delta_mean("overlap")
+    idle_ms = _delta_mean("idle")
+    step_wall_sum = prof_now["wall"][1] - prof_base["wall"][1]
+    recon_est_ms = step_wall_sum / workers + drain_ms
+    recon_pct = round(100.0 * abs(recon_est_ms - wall_ms) / wall_ms, 1)
+    log(f"#3p profiler: fit {fit_ms} submit {submit_ms} overlap {overlap_ms} "
+        f"idle {idle_ms} ms/step; step-wall {step_wall_sum:.0f}/{workers} "
+        f"workers + drain {drain_ms:.0f} = {recon_est_ms:.0f} vs wall "
+        f"{wall_ms:.0f} ms ({recon_pct}% off)")
 
     # wire-cost columns (docs/PERFORMANCE.md §8): what ONE update/broadcast
     # of this model costs on the multi-process wire, dense f32 vs 1% top-k
@@ -566,6 +622,11 @@ def bench_cifar_async(matrix):
         "drain_ms": round(drain_ms, 0),
         "dispatch_ms": round(dispatch_sum_ms / workers, 0),
         "unattributed_ms": round(unattributed_ms, 0),
+        "fit_ms": fit_ms,
+        "submit_ms": submit_ms,
+        "overlap_ms": overlap_ms,
+        "idle_ms": idle_ms,
+        "recon_pct": recon_pct,
         "floor_ms": round(dispatch_floor_ms, 1),
         "ceiling_sps": round(ceiling, 0),
         "up_bytes_per_update": up_dense,
@@ -1185,6 +1246,7 @@ def bench_transformer_large(n_chips):
 # window (never expected — the flat schema sits well under it — but the
 # window must be enforced mechanically, not hoped about)
 _DROP_ORDER = [
+    "recon_pct", "idle_ms", "overlap_ms", "submit_ms", "fit_ms",
     "drain_ms", "dispatch_ms", "ceiling_sps", "seq_ms", "conc_ms",
     "params_m", "round_ms", "workers", "step_ms", "mfu_med", "top2_mfu",
     "top2_tok_s", "i8_ms_tok_1k", "hbm_frac_4k", "wall_ms",

@@ -34,6 +34,7 @@ from distriflow_tpu.comm.transport import (
     FaultPlan,
 )
 from distriflow_tpu.models.base import DistributedModel, ModelSource, fetch_model
+from distriflow_tpu.obs.profiler import NOOP_PROFILER
 from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
 from distriflow_tpu.utils.config import (
     COMPRESSION_DTYPES,
@@ -113,6 +114,11 @@ def resolve_client_id(config: DistributedClientConfig) -> str:
 
 
 class AbstractClient:
+    #: class-level default so protocol probes (test stubs that skip
+    #: ``__init__``) still serialize/upload; real instances rebind to
+    #: their telemetry's profiler in ``__init__``
+    _prof = NOOP_PROFILER
+
     def __init__(
         self,
         server_address: str,
@@ -165,6 +171,10 @@ class AbstractClient:
         self._c_down_full = self.telemetry.counter("comm_broadcasts_full_total", role="client")
         self._c_resyncs = self.telemetry.counter("comm_resyncs_total", role="client")
         self._g_residual = self.telemetry.gauge("comm_residual_norm")
+        # continuous phase profiler (docs/OBSERVABILITY.md §5): the
+        # client step decomposes into fit / ef_compress / serialize /
+        # submit / ack_wait; shared no-op handles when telemetry is off
+        self._prof = self.telemetry.profiler("client")
         # int8/topk gradient compression: per-leaf compression residual
         # carried into the next upload (error feedback); keyed by tree path
         self._quant_error: Optional[Dict[str, Any]] = None
@@ -387,41 +397,48 @@ class AbstractClient:
         ) as span:
             msg.trace_id = span.trace_id or msg.trace_id
             msg.span_id = span.span_id or msg.span_id
-            wire = msg.to_wire()
+            with self._prof.phase("serialize"):
+                wire = msg.to_wire()
             policy = self.config.upload_retry.validate()
             last_exc: Optional[Exception] = None
             delays = [None, *policy.delays()]  # first attempt is immediate
             attempts = 0
             try:
-                for attempt, delay in enumerate(delays):
-                    if self._disposed:
-                        raise last_exc or ConnectionLost("client disposed")
-                    attempts = attempt + 1
-                    if delay is not None:
-                        self._c_retries.inc()
-                        time.sleep(delay)
-                        # if a reconnect is in flight, wait (bounded) for the
-                        # fresh transport instead of burning the attempt on a
-                        # dead one
-                        self._transport_ready.wait(timeout)
-                    transport = self.transport
-                    if transport is None:
-                        last_exc = ConnectionLost("not connected")
-                        continue
-                    try:
-                        result = transport.request(Events.Upload.value, wire,
-                                                   timeout)
-                        break
-                    except (AckTimeout, ConnectionLost) as exc:
-                        last_exc = exc
-                        self.log(
-                            f"upload attempt {attempt + 1}/{len(delays)} failed "
-                            f"({type(exc).__name__}: {exc}); "
-                            f"update_id={msg.update_id}"
-                        )
-                else:
-                    assert last_exc is not None
-                    raise last_exc
+                # `submit` bounds the whole retry loop; `ack_wait` nests
+                # inside it around each request->ack round trip (the step
+                # attribution counts only the outermost, so the pair does
+                # not double-count)
+                with self._prof.phase("submit"):
+                    for attempt, delay in enumerate(delays):
+                        if self._disposed:
+                            raise last_exc or ConnectionLost("client disposed")
+                        attempts = attempt + 1
+                        if delay is not None:
+                            self._c_retries.inc()
+                            time.sleep(delay)
+                            # if a reconnect is in flight, wait (bounded) for
+                            # the fresh transport instead of burning the
+                            # attempt on a dead one
+                            self._transport_ready.wait(timeout)
+                        transport = self.transport
+                        if transport is None:
+                            last_exc = ConnectionLost("not connected")
+                            continue
+                        try:
+                            with self._prof.phase("ack_wait"):
+                                result = transport.request(
+                                    Events.Upload.value, wire, timeout)
+                            break
+                        except (AckTimeout, ConnectionLost) as exc:
+                            last_exc = exc
+                            self.log(
+                                f"upload attempt {attempt + 1}/{len(delays)} "
+                                f"failed ({type(exc).__name__}: {exc}); "
+                                f"update_id={msg.update_id}"
+                            )
+                    else:
+                        assert last_exc is not None
+                        raise last_exc
             finally:
                 # EVERY exit — success, exhausted retries, dispose, abort —
                 # records how many reconnects the span straddled, so chaos
@@ -513,21 +530,23 @@ class AbstractClient:
             self._quant_error = {}
         out = {}
         residual_sq = 0.0
-        for path, leaf in flat:
-            key = jax.tree_util.keystr(path)
-            # sanitize BEFORE the error-feedback arithmetic: an inf/nan
-            # gradient entry would otherwise land in the residual and
-            # poison every future upload of this leaf
-            g = sanitize_finite(np.asarray(leaf, np.float32))
-            g = g + self._quant_error.get(key, 0.0)  # carry prior residual
-            if name == "int8":
-                sa = quantize_array(g)
-            else:
-                sa = topk_array(g, topk_fraction, quantize=(name == "topk_int8"))
-            residual = g - deserialize_array(sa)
-            self._quant_error[key] = residual
-            residual_sq += float(np.vdot(residual, residual))
-            out[key] = sa
+        with self._prof.phase("ef_compress"):
+            for path, leaf in flat:
+                key = jax.tree_util.keystr(path)
+                # sanitize BEFORE the error-feedback arithmetic: an inf/nan
+                # gradient entry would otherwise land in the residual and
+                # poison every future upload of this leaf
+                g = sanitize_finite(np.asarray(leaf, np.float32))
+                g = g + self._quant_error.get(key, 0.0)  # carry prior residual
+                if name == "int8":
+                    sa = quantize_array(g)
+                else:
+                    sa = topk_array(g, topk_fraction,
+                                    quantize=(name == "topk_int8"))
+                residual = g - deserialize_array(sa)
+                self._quant_error[key] = residual
+                residual_sq += float(np.vdot(residual, residual))
+                out[key] = sa
         gauge = getattr(self, "_g_residual", None)
         if gauge is not None:
             gauge.set(float(np.sqrt(residual_sq)))

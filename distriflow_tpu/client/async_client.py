@@ -63,45 +63,51 @@ class AsynchronousSGDClient(AbstractClient):
         same ``update_id``, no recompute, no ``batches_processed`` bump.
         """
         key = (msg.data.epoch, msg.data.batch, msg.model.version)
-        # downloads dispatch on concurrent executor threads, so a duplicate-
-        # delivered frame can race the original: the whole check-compute-
-        # insert is one critical section, and the update_id is stamped here
-        # (not lazily in upload()) so both racers send the same id
-        with self._update_lock:
-            upload = self._recent_uploads.get(key)
-            if upload is not None:
-                self.log(f"re-upload of already-computed batch {key}")
-            else:
-                x = jnp.asarray(deserialize_array(msg.data.x))
-                y = jnp.asarray(deserialize_array(msg.data.y))
-                metrics: Optional[List[float]] = None
-                if self.config.send_metrics:
-                    metrics = self.model.evaluate(x, y)
-                with self.time("fit"):
-                    grads = self.model.fit(x, y)
-                upload = UploadMsg(
-                    client_id=self.client_id,
-                    batch=msg.data.batch,
-                    gradients=GradientMsg(
-                        version=msg.model.version,
-                        vars=self.serialize_grads(grads),
-                    ),
-                    metrics=metrics,
-                    update_id=uuid_lib.uuid4().hex,
-                    # join the dispatch's trace (rides the download header):
-                    # dispatch -> train -> upload -> apply is one trace, and
-                    # a redelivered batch re-uploads this same cached message
-                    # — same trace — so duplicates share it by construction
-                    trace_id=msg.trace_id,
-                )
-                self._recent_uploads[key] = upload
-                while len(self._recent_uploads) > _RECENT_UPLOADS:
-                    self._recent_uploads.popitem(last=False)
-                # count before the upload ack: the server may emit
-                # trainingComplete the instant it receives this upload,
-                # racing the ack back to us
-                self.batches_processed += 1
-        self.upload(upload)
+        # one profiler step bounds the whole round (fit -> compress ->
+        # serialize -> submit/ack): its wall-vs-busy digests are the
+        # overlap/idle attribution docs/OBSERVABILITY.md §5 describes
+        with self._prof.step():
+            # downloads dispatch on concurrent executor threads, so a
+            # duplicate-delivered frame can race the original: the whole
+            # check-compute-insert is one critical section, and the
+            # update_id is stamped here (not lazily in upload()) so both
+            # racers send the same id
+            with self._update_lock:
+                upload = self._recent_uploads.get(key)
+                if upload is not None:
+                    self.log(f"re-upload of already-computed batch {key}")
+                else:
+                    x = jnp.asarray(deserialize_array(msg.data.x))
+                    y = jnp.asarray(deserialize_array(msg.data.y))
+                    metrics: Optional[List[float]] = None
+                    if self.config.send_metrics:
+                        metrics = self.model.evaluate(x, y)
+                    with self.time("fit"), self._prof.phase("fit"):
+                        grads = self.model.fit(x, y)
+                    upload = UploadMsg(
+                        client_id=self.client_id,
+                        batch=msg.data.batch,
+                        gradients=GradientMsg(
+                            version=msg.model.version,
+                            vars=self.serialize_grads(grads),
+                        ),
+                        metrics=metrics,
+                        update_id=uuid_lib.uuid4().hex,
+                        # join the dispatch's trace (rides the download
+                        # header): dispatch -> train -> upload -> apply is
+                        # one trace, and a redelivered batch re-uploads this
+                        # same cached message — same trace — so duplicates
+                        # share it by construction
+                        trace_id=msg.trace_id,
+                    )
+                    self._recent_uploads[key] = upload
+                    while len(self._recent_uploads) > _RECENT_UPLOADS:
+                        self._recent_uploads.popitem(last=False)
+                    # count before the upload ack: the server may emit
+                    # trainingComplete the instant it receives this upload,
+                    # racing the ack back to us
+                    self.batches_processed += 1
+            self.upload(upload)
 
     def train_until_complete(self, timeout: float = 300.0) -> int:
         """Block until the server signals completion; returns batches done.

@@ -275,6 +275,36 @@ def main() -> int:
                     a["parent_id"] in span_ids for a in applies):
                 break
             time.sleep(0.02)
+        # phase-digest quiescence (docs/OBSERVABILITY.md §5): the continuous
+        # profiler must have booked one server apply phase per applied
+        # update and one client fit phase per batch before the digests are
+        # judged — a snapshot taken mid-flight would under-count
+        reg = tel.registry
+        want_applies = chaos_state["applied_updates"]
+
+        def _digest_count(metric, **labels):
+            h = reg.find(metric, **labels)
+            return h.summary()["count"] if h is not None else 0
+
+        while time.monotonic() < deadline:
+            if (_digest_count("phase_ms", phase="apply", role="server")
+                    >= want_applies
+                    and _digest_count("phase_ms", phase="fit", role="client")
+                    >= want_applies):
+                break
+            time.sleep(0.02)
+        for phase, role in (("apply", "server"), ("decode", "server"),
+                            ("fit", "client"), ("submit", "client")):
+            n = _digest_count("phase_ms", phase=phase, role=role)
+            assert n >= want_applies, (
+                f"phase_ms{{phase={phase},role={role}}} has {n} samples, "
+                f"expected >= {want_applies}"
+            )
+        steps = _digest_count("phase_step_wall_ms", role="client")
+        assert steps >= want_applies, (
+            f"client step digest has {steps} samples, "
+            f"expected >= {want_applies}"
+        )
         plans = (("client", chaos_state["client_plan"]),
                  ("server", chaos_state["server_plan"]))
         for action, counter in (
@@ -312,7 +342,8 @@ def main() -> int:
         return (f"counters == injected faults; offered == frames_seen; "
                 f"{len(spanning)} upload trace(s) span a reconnect; "
                 f"{len(applies)} applies + {len(dedup_spans)} dedup'd "
-                "duplicates all linked to client traces")
+                "duplicates all linked to client traces; phase digests "
+                f"booked >= {want_applies} samples per hot phase")
 
     ok &= _check("telemetry reconciliation (snapshot vs FaultPlan)",
                  telemetry_reconciliation)
@@ -616,6 +647,105 @@ def main() -> int:
 
     ok &= _check("sparse-wire drill (topk+int8 uploads, delta broadcasts)",
                  sparse_wire)
+
+    def sentinel():
+        """Health-sentinel drill (docs/OBSERVABILITY.md §6), both ways: a
+        clean loopback run checked against the stock ack-latency band must
+        raise ZERO breaches and write no flight bundle; the SAME run with a
+        scripted 0.4 s ack delay must trip the band exactly once — one
+        ``obs_slo_breach_total`` increment (edge-triggered: a second
+        ``check()`` must not re-fire) and exactly one postmortem bundle on
+        disk."""
+        import os
+
+        import numpy as np
+
+        from distriflow_tpu.client.abstract_client import DistributedClientConfig
+        from distriflow_tpu.client.async_client import AsynchronousSGDClient
+        from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+        from distriflow_tpu.data.dataset import DistributedDataset
+        from distriflow_tpu.obs import Telemetry
+        from distriflow_tpu.obs.flight_recorder import read_bundles
+        from distriflow_tpu.obs.health import HealthSentinel, default_bands
+        from distriflow_tpu.server.abstract_server import DistributedServerConfig
+        from distriflow_tpu.server.async_server import AsynchronousSGDServer
+        from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+        TinyModel = _tiny_model_cls()
+
+        def run_once(fault_plan, dump_dir):
+            x = np.arange(8, dtype=np.float32).reshape(8, 1)
+            y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+            dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+            tel = Telemetry()
+            watch = HealthSentinel(
+                tel, bands=default_bands(ack_p99_ms=250.0),
+                dump_dir=dump_dir)
+            server = AsynchronousSGDServer(
+                DistributedServerInMemoryModel(TinyModel()),
+                dataset,
+                DistributedServerConfig(
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+                    telemetry=tel,
+                ),
+            )
+            server.setup()
+            client = AsynchronousSGDClient(
+                server.address, TinyModel(),
+                DistributedClientConfig(
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+                    upload_timeout_s=2.0, fault_plan=fault_plan,
+                    telemetry=tel,
+                ),
+            )
+            try:
+                client.setup(timeout=10.0)
+                client.train_until_complete(timeout=60.0)
+            finally:
+                client.dispose()
+                server.stop()
+            entered = watch.check()
+            watch.check()  # edge trigger: still in breach, must not re-fire
+            count = tel.counter_value(
+                "obs_slo_breach_total", band="ack_latency_p99")
+            return entered, count, read_bundles(dump_dir)
+
+        with tempfile.TemporaryDirectory() as d:
+            clean_dir = os.path.join(d, "clean")
+            fault_dir = os.path.join(d, "fault")
+            entered, count, bundles = run_once(None, clean_dir)
+            assert not entered and count == 0, (
+                f"clean run breached the SLO: {entered} (count {count:g})"
+            )
+            assert not bundles, (
+                f"clean run wrote {len(bundles)} flight bundle(s)"
+            )
+            plan = FaultPlan(seed=13, schedule=[
+                ScriptedFault(event="uploadVars", nth=2, action="delay",
+                              delay_s=0.4)])
+            entered, count, bundles = run_once(plan, fault_dir)
+            assert [e["band"] for e in entered] == ["ack_latency_p99"], (
+                f"expected exactly the ack band to enter breach: {entered}"
+            )
+            assert count == 1, (
+                f"obs_slo_breach_total{{band=ack_latency_p99}} = {count:g}, "
+                "expected exactly 1 (edge trigger)"
+            )
+            assert len(bundles) == 1, (
+                f"expected exactly 1 flight bundle, got {len(bundles)}"
+            )
+            assert bundles[0]["trigger"] == "slo_ack_latency_p99"
+            assert any(e["kind"] == "slo_breach"
+                       for e in bundles[0]["events"]), (
+                "breach event missing from the bundle"
+            )
+            observed = entered[0]["observed"]
+        return (f"clean run: 0 breaches, 0 bundles; 0.4 s scripted ack "
+                f"delay: ack p99 {observed:.0f} ms > 250 ms tripped "
+                "ack_latency_p99 exactly once (1 counter increment, "
+                "1 flight bundle, edge-triggered)")
+
+    ok &= _check("health-sentinel drill (SLO breach + flight dump)", sentinel)
 
     def native():
         from distriflow_tpu import native

@@ -17,6 +17,21 @@ Offline, ``python -m distriflow_tpu.obs.dump <dir>`` summarizes a run's
 the metric-name and span-schema reference.
 """
 
+from distriflow_tpu.obs.flight_recorder import (
+    FlightRecorder,
+    NOOP_FLIGHT,
+)
+from distriflow_tpu.obs.health import (
+    FleetTable,
+    HealthSentinel,
+    SLOBand,
+    default_bands,
+)
+from distriflow_tpu.obs.profiler import (
+    NOOP_PHASE,
+    NOOP_PROFILER,
+    PhaseProfiler,
+)
 from distriflow_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -40,14 +55,23 @@ from distriflow_tpu.obs.tracing import (
 
 __all__ = [
     "Counter",
+    "FleetTable",
+    "FlightRecorder",
     "Gauge",
+    "HealthSentinel",
     "Histogram",
     "MetricsRegistry",
+    "NOOP_FLIGHT",
     "NOOP_HANDLE",
+    "NOOP_PHASE",
+    "NOOP_PROFILER",
     "NOOP_SPAN",
+    "PhaseProfiler",
+    "SLOBand",
     "Span",
     "Telemetry",
     "Tracer",
+    "default_bands",
     "get_telemetry",
     "new_span_id",
     "new_trace_id",

@@ -9,7 +9,14 @@ optional — missing files are reported, not fatal) and prints:
   and a server-side ``apply`` span (the cross-endpoint join wire tracing
   exists to provide), and how many upload spans recorded a reconnect.
 
-Exit code is 0 when at least one of the two files existed, 2 otherwise.
+``--flight`` additionally summarizes the postmortem bundles the flight
+recorder wrote under ``<dir>/flight/`` (trigger, event counts, context —
+see ``docs/OBSERVABILITY.md``). ``--watch`` tails the run live instead:
+every ``--interval`` seconds it re-reads the latest snapshot row and
+prints which counters/gauges moved (``--iterations`` bounds the loop;
+0 = forever).
+
+Exit code is 0 when at least one summarized source existed, 2 otherwise.
 """
 
 from __future__ import annotations
@@ -17,6 +24,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 from typing import Any, Dict, List
 
 from distriflow_tpu.obs.tracing import SPANS_FILENAME
@@ -79,12 +87,92 @@ def summarize_spans(path: str) -> List[str]:
     return lines
 
 
+def summarize_flight(run_dir: str) -> List[str]:
+    from distriflow_tpu.obs.flight_recorder import FLIGHT_DIRNAME, read_bundles
+
+    bundles = read_bundles(run_dir)
+    lines = [f"flight: {len(bundles)} bundle(s) "
+             f"({os.path.join(run_dir, FLIGHT_DIRNAME)})"]
+    for b in bundles:
+        events = b.get("events", [])
+        kinds: Dict[str, int] = {}
+        for e in events:
+            k = str(e.get("kind", "?"))
+            kinds[k] = kinds.get(k, 0) + 1
+        dropped = int(b.get("events_dropped", 0) or 0)
+        line = (f"  {b.get('_file')}: trigger={b.get('trigger')} "
+                f"pid={b.get('pid')} events={len(events)}")
+        if dropped:
+            line += f" (+{dropped} dropped for size)"
+        if kinds:
+            line += " [" + " ".join(
+                f"{k}x{n}" for k, n in sorted(kinds.items())) + "]"
+        lines.append(line)
+        ctx = b.get("context") or {}
+        if ctx:
+            lines.append("    context: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(ctx.items())))
+    return lines
+
+
+def watch(run_dir: str, interval: float, iterations: int) -> int:
+    """Live mode: poll the latest snapshot row and print counter/gauge
+    movement between polls. Returns 0 once a metrics file was seen."""
+    metrics_path = os.path.join(run_dir, METRICS_FILENAME)
+    prev: Dict[str, float] = None
+    seen = False
+    i = 0
+    while iterations <= 0 or i < iterations:
+        if i:  # no sleep before the first poll: --iterations 1 is instant
+            time.sleep(interval)
+        i += 1
+        if not os.path.exists(metrics_path):
+            print(f"watch[{i}] (waiting for {METRICS_FILENAME} in "
+                  f"{run_dir})", flush=True)
+            continue
+        seen = True
+        rows = [r for r in read_metrics(metrics_path)
+                if r.get("kind") == "telemetry_snapshot"]
+        if not rows:
+            print(f"watch[{i}] (no telemetry_snapshot rows yet)", flush=True)
+            continue
+        vals = {k: float(v) for k, v in rows[-1].items()
+                if k.startswith(("counter:", "gauge:"))
+                and isinstance(v, (int, float))}
+        changed = sorted(vals) if prev is None else sorted(
+            k for k in vals if vals[k] != prev.get(k))
+        parts = []
+        for k in changed[:12]:
+            name = k.split(":", 1)[1]
+            if prev is not None and k in prev:
+                parts.append(f"{name} {prev[k]:g}->{vals[k]:g}")
+            else:
+                parts.append(f"{name}={vals[k]:g}")
+        if len(changed) > 12:
+            parts.append(f"(+{len(changed) - 12} more)")
+        print(f"watch[{i}] {len(rows)} snapshot(s); "
+              + ("; ".join(parts) if parts else "no change"), flush=True)
+        prev = vals
+    return 0 if seen else 2
+
+
 def main(argv: List[str] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m distriflow_tpu.obs.dump",
         description="Summarize a run directory's metrics.jsonl/spans.jsonl.")
     parser.add_argument("run_dir", help="directory holding the JSONL files")
+    parser.add_argument("--flight", action="store_true",
+                        help="also summarize flight-recorder bundles")
+    parser.add_argument("--watch", action="store_true",
+                        help="poll the latest snapshot and print deltas")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="seconds between --watch polls (default 2)")
+    parser.add_argument("--iterations", type=int, default=0,
+                        help="stop --watch after N polls (0 = forever)")
     args = parser.parse_args(argv)
+
+    if args.watch:
+        return watch(args.run_dir, args.interval, args.iterations)
 
     metrics_path = os.path.join(args.run_dir, METRICS_FILENAME)
     spans_path = os.path.join(args.run_dir, SPANS_FILENAME)
@@ -96,6 +184,10 @@ def main(argv: List[str] = None) -> int:
             print("\n".join(fn(path)))
         else:
             print(f"(no {os.path.basename(path)} in {args.run_dir})")
+    if args.flight:
+        lines = summarize_flight(args.run_dir)
+        found = found or len(lines) > 1  # bundles count as a found source
+        print("\n".join(lines))
     return 0 if found else 2
 
 

@@ -1,0 +1,209 @@
+"""Health sentinel (declared SLO bands) + per-connection fleet table.
+
+**SLO bands** declare what "healthy" means as numbers — an MFU floor, an
+ack-latency p99 ceiling, an apply-queue depth ceiling, a slot-occupancy
+ceiling — each bound to one registry metric (gauge value or histogram
+window quantile, i.e. a rolling window). :meth:`HealthSentinel.check`
+evaluates every band against the live registry; a band *entering*
+breach increments ``obs_slo_breach_total{band=...}`` exactly once
+(edge-triggered — staying in breach is not a new event) and triggers a
+flight-recorder postmortem bundle (``obs/flight_recorder.py``). A band
+whose metric does not exist yet, or whose histogram has fewer than
+``min_count`` samples, is *unknown* and never breaches — a cold process
+is not an incident.
+
+**FleetTable** is the server-side per-connection health surface the
+ROADMAP router/soak items consume: round latency, staleness, quarantine
+hits, wire bytes, last-seen per client, exposed through
+``Telemetry.snapshot()["fleet"]`` (absent when no table is registered,
+so the disabled-telemetry snapshot contract is untouched).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+BREACH_COUNTER = "obs_slo_breach_total"
+
+#: histogram stats a band may bind to (anything else reads ``.value``)
+_HIST_STATS = ("p50", "p95", "p99", "min", "max", "count", "sum")
+
+
+@dataclass(frozen=True)
+class SLOBand:
+    """One declared objective: ``lower <= stat(metric{labels}) <= upper``."""
+
+    name: str                 # band identity (label on the breach counter)
+    metric: str               # registry metric name
+    stat: str = "value"       # "value" for gauges/counters, else a hist stat
+    labels: Mapping[str, Any] = field(default_factory=dict)
+    upper: Optional[float] = None
+    lower: Optional[float] = None
+    min_count: int = 1        # histogram bands: samples required to judge
+
+
+def default_bands(*, mfu_floor: Optional[float] = None,
+                  ack_p99_ms: Optional[float] = None,
+                  apply_queue_max: Optional[float] = None,
+                  slots_max: Optional[float] = None) -> List[SLOBand]:
+    """The four stock bands from docs/OBSERVABILITY.md §6; pass only the
+    thresholds you want enforced."""
+    bands: List[SLOBand] = []
+    if mfu_floor is not None:
+        bands.append(SLOBand("mfu_floor", "train_mfu", "value",
+                             {"mode": "sync"}, lower=mfu_floor))
+    if ack_p99_ms is not None:
+        bands.append(SLOBand("ack_latency_p99", "transport_ack_latency_ms",
+                             "p99", {"role": "client"}, upper=ack_p99_ms))
+    if apply_queue_max is not None:
+        # the gauge is registered unlabeled (abstract_server caches one
+        # handle per process), so the band must match it label-free
+        bands.append(SLOBand("apply_queue_depth", "comm_apply_queue_depth",
+                             "value", {}, upper=apply_queue_max))
+    if slots_max is not None:
+        bands.append(SLOBand("slot_occupancy", "serving_slots_active",
+                             "value", {}, upper=slots_max))
+    return bands
+
+
+class HealthSentinel:
+    """Evaluates SLO bands against a Telemetry's registry, edge-triggered."""
+
+    def __init__(self, telemetry: Any = None,
+                 bands: Optional[List[SLOBand]] = None,
+                 dump_dir: Optional[str] = None):
+        if telemetry is None:
+            from distriflow_tpu.obs.telemetry import get_telemetry
+            telemetry = get_telemetry()
+        self.telemetry = telemetry
+        self.bands = list(bands or [])
+        self.dump_dir = dump_dir
+        self._in_breach: Dict[str, bool] = {}
+
+    def observe(self, band: SLOBand) -> Optional[float]:
+        """Current value of a band's bound stat, or None when unknown."""
+        m = self.telemetry.registry.find(band.metric, **band.labels)
+        if m is None:
+            return None
+        if band.stat in _HIST_STATS and hasattr(m, "percentiles"):
+            s = m.summary()
+            if s.get("count", 0) < band.min_count:
+                return None
+            return float(s[band.stat])
+        return float(m.value)
+
+    def check(self) -> List[Dict[str, Any]]:
+        """Evaluate every band; returns the bands that newly ENTERED
+        breach this call (each already counted and flight-dumped)."""
+        entered: List[Dict[str, Any]] = []
+        for band in self.bands:
+            observed = self.observe(band)
+            breached = observed is not None and (
+                (band.upper is not None and observed > band.upper)
+                or (band.lower is not None and observed < band.lower))
+            was = self._in_breach.get(band.name, False)
+            self._in_breach[band.name] = breached
+            if breached and not was:
+                detail = {
+                    "band": band.name, "metric": band.metric,
+                    "stat": band.stat, "observed": observed,
+                    "upper": band.upper, "lower": band.lower,
+                }
+                self.telemetry.counter(BREACH_COUNTER, band=band.name).inc()
+                flight = self.telemetry.flight
+                flight.record("slo_breach", **detail)
+                bundle = flight.dump(f"slo_{band.name}",
+                                     save_dir=self.dump_dir, **detail)
+                detail["bundle"] = bundle
+                entered.append(detail)
+        return entered
+
+    def breached(self) -> List[str]:
+        """Names of the bands currently in breach (as of the last check)."""
+        return sorted(n for n, b in self._in_breach.items() if b)
+
+
+class FleetTable:
+    """Per-connection health rows: the router/soak admission substrate.
+
+    Thread-safe; rows survive disconnects (marked ``connected=False``)
+    up to ``capacity`` total, evicting the longest-gone disconnected row
+    first so a churny fleet cannot grow the table without bound.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = int(capacity)
+        self._rows: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def _row(self, client_id: str) -> Dict[str, Any]:
+        row = self._rows.get(client_id)
+        if row is None:
+            if len(self._rows) >= self.capacity:
+                gone = [(r["last_seen"], cid) for cid, r in self._rows.items()
+                        if not r["connected"]]
+                if gone:
+                    self._rows.pop(min(gone)[1], None)
+            row = self._rows[client_id] = {
+                "connected": False, "connected_at": None, "last_seen": 0.0,
+                "uploads": 0, "round_ms": None, "staleness": None,
+                "quarantine_hits": 0, "resyncs": 0,
+                "up_bytes": 0, "down_bytes": 0, "_last_down_t": None,
+            }
+        return row
+
+    def connect(self, client_id: str) -> None:
+        now = time.time()
+        with self._lock:
+            row = self._row(client_id)
+            row["connected"] = True
+            row["connected_at"] = now
+            row["last_seen"] = now
+
+    def disconnect(self, client_id: str) -> None:
+        with self._lock:
+            row = self._rows.get(client_id)
+            if row is not None:
+                row["connected"] = False
+                row["last_seen"] = time.time()
+
+    def note_upload(self, client_id: str, nbytes: int = 0) -> None:
+        """One gradient upload arrived; round latency is measured from
+        the last weight send to this connection (dispatch -> upload)."""
+        now = time.time()
+        with self._lock:
+            row = self._row(client_id)
+            row["last_seen"] = now
+            row["uploads"] += 1
+            row["up_bytes"] += int(nbytes)
+            t = row["_last_down_t"]
+            if t is not None:
+                row["round_ms"] = round((now - t) * 1e3, 3)
+
+    def note_download(self, client_id: str, nbytes: int = 0) -> None:
+        with self._lock:
+            row = self._row(client_id)
+            row["down_bytes"] += int(nbytes)
+            row["_last_down_t"] = time.time()
+
+    def note_staleness(self, client_id: str, staleness: float) -> None:
+        with self._lock:
+            self._row(client_id)["staleness"] = staleness
+
+    def note_quarantine(self, client_id: str) -> None:
+        with self._lock:
+            self._row(client_id)["quarantine_hits"] += 1
+
+    def note_resync(self, client_id: str) -> None:
+        with self._lock:
+            self._row(client_id)["resyncs"] += 1
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """JSON-able ``{client_id: row}`` (internal fields stripped)."""
+        with self._lock:
+            return {cid: {k: v for k, v in row.items()
+                          if not k.startswith("_")}
+                    for cid, row in self._rows.items()}

@@ -214,6 +214,12 @@ class MetricsRegistry:
 
     # -- read side ---------------------------------------------------------
 
+    def find(self, name: str, **labels: Any) -> Optional[Any]:
+        """Existing handle for an exact ``(name, labels)`` key, or None —
+        a pure lookup that never registers (the factories would create an
+        empty metric, which a reader like the health sentinel must not)."""
+        return self._metrics.get(_key(name, labels))
+
     def counter_value(self, name: str, **labels: Any) -> float:
         """Exact-key counter read; 0.0 when never incremented."""
         m = self._metrics.get(_key(name, labels))

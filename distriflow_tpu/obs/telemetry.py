@@ -16,6 +16,7 @@ the snapshot can be reconciled against a shared ``FaultPlan``.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -39,6 +40,10 @@ class Telemetry:
                                         histogram_window=histogram_window)
         self.tracer = Tracer(enabled=self.enabled, save_dir=save_dir)
         self._metrics_logger = None
+        self._profilers: Dict[str, Any] = {}
+        self._profilers_lock = threading.Lock()
+        self._flight = None
+        self._fleet_providers: Dict[Any, Any] = {}
 
     # -- handle factories (delegate to the registry) -----------------------
 
@@ -56,6 +61,48 @@ class Telemetry:
         return self.tracer.span(name, trace_id=trace_id,
                                 parent_id=parent_id, **attrs)
 
+    def profiler(self, role: str):
+        """Phase profiler for one role, cached per role (the shared
+        ``NOOP_PROFILER`` when disabled — nothing allocated per step)."""
+        from distriflow_tpu.obs.profiler import NOOP_PROFILER, PhaseProfiler
+        if not self.enabled:
+            return NOOP_PROFILER
+        p = self._profilers.get(role)  # fast path: no lock on hit
+        if p is None:
+            with self._profilers_lock:
+                p = self._profilers.get(role)
+                if p is None:
+                    p = PhaseProfiler(self.registry, role)
+                    self._profilers[role] = p
+        return p
+
+    @property
+    def flight(self):
+        """The process flight recorder (lazy; the shared ``NOOP_FLIGHT``
+        when disabled). Bundles land under ``<save_dir>/flight/`` — a
+        dump with no ``save_dir`` anywhere is a no-op returning None."""
+        from distriflow_tpu.obs.flight_recorder import (
+            NOOP_FLIGHT, FlightRecorder)
+        if not self.enabled:
+            return NOOP_FLIGHT
+        if self._flight is None:
+            with self._profilers_lock:
+                if self._flight is None:
+                    self._flight = FlightRecorder(save_dir=self.save_dir)
+        return self._flight
+
+    # -- fleet health table -------------------------------------------------
+
+    def register_fleet(self, key: Any, provider) -> None:
+        """Attach a per-connection health provider (a zero-arg callable
+        returning ``{client_id: row}``); its rows merge into
+        ``snapshot()["fleet"]``. No-op when disabled."""
+        if self.enabled:
+            self._fleet_providers[key] = provider
+
+    def unregister_fleet(self, key: Any) -> None:
+        self._fleet_providers.pop(key, None)
+
     # -- read side ---------------------------------------------------------
 
     def counter_value(self, name: str, **labels: Any) -> float:
@@ -65,8 +112,20 @@ class Telemetry:
         return self.registry.total(name)
 
     def snapshot(self) -> Dict[str, Any]:
-        """Plain dict of every counter/gauge/histogram currently registered."""
-        return self.registry.snapshot()
+        """Plain dict of every counter/gauge/histogram currently
+        registered, plus a ``"fleet"`` key (per-connection health rows)
+        when a server has registered its table — absent otherwise, so
+        the disabled-telemetry empty-snapshot contract is unchanged."""
+        snap = self.registry.snapshot()
+        if self._fleet_providers:
+            fleet: Dict[str, Any] = {}
+            for provider in list(self._fleet_providers.values()):
+                try:
+                    fleet.update(provider())
+                except Exception:
+                    pass  # a dead provider must not break the snapshot
+            snap["fleet"] = fleet
+        return snap
 
     def prometheus(self) -> str:
         """Prometheus text-exposition rendering of the current state."""

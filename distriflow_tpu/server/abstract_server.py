@@ -45,6 +45,7 @@ from distriflow_tpu.utils.config import (
     client_hyperparams,
     server_hyperparams,
 )
+from distriflow_tpu.obs.health import FleetTable
 from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 from distriflow_tpu.utils.messages import DownloadMsg, Events, ModelMsg, UploadMsg
@@ -162,6 +163,13 @@ class AbstractServer:
         self._c_down_full = self.telemetry.counter("comm_broadcasts_full_total", role="server")
         self._c_resyncs = self.telemetry.counter("comm_resyncs_total", role="server")
         self._g_apply_queue = self.telemetry.gauge("comm_apply_queue_depth")
+        # continuous phase profiler (docs/OBSERVABILITY.md §5): the upload
+        # lifecycle decomposes into decode / quarantine / apply / broadcast
+        self._prof = self.telemetry.profiler("server")
+        # per-connection health rows (docs/OBSERVABILITY.md §6): round
+        # latency, staleness, quarantine hits, wire bytes, last-seen —
+        # merged into Telemetry.snapshot()["fleet"] while setup
+        self.fleet = FleetTable()
         self.logger = VerboseLogger(type(self).__name__, self.config.verbose)
         self.gate = GradientGate(
             self.config.quarantine or QuarantinePolicy(),
@@ -267,22 +275,25 @@ class AbstractServer:
         The ledger is updated optimistically at send time; a dropped frame
         surfaces as a client-side base mismatch and comes back to us as a
         resync request (``Events.Resync``)."""
-        full = self.download_msg.model
-        delta: Optional[ModelMsg] = None
-        if self.hyperparams.delta_broadcast:
+        with self._prof.phase("broadcast"):
+            full = self.download_msg.model
+            delta: Optional[ModelMsg] = None
+            if self.hyperparams.delta_broadcast:
+                with self._delta_lock:
+                    base_version = self._client_bases.get(client_id)
+                if base_version is not None:
+                    delta = self._delta_model_msg(base_version, full)
             with self._delta_lock:
-                base_version = self._client_bases.get(client_id)
-            if base_version is not None:
-                delta = self._delta_model_msg(base_version, full)
-        with self._delta_lock:
-            self._client_bases[client_id] = full.version
-        msg = delta if delta is not None else full
-        self._c_down_bytes.inc(tree_wire_nbytes(msg.vars))
-        if delta is not None:
-            self._c_down_delta.inc()
-        else:
-            self._c_down_full.inc()
-        return msg
+                self._client_bases[client_id] = full.version
+            msg = delta if delta is not None else full
+            nbytes = tree_wire_nbytes(msg.vars)
+            self._c_down_bytes.inc(nbytes)
+            self.fleet.note_download(client_id, nbytes)
+            if delta is not None:
+                self._c_down_delta.inc()
+            else:
+                self._c_down_full.inc()
+            return msg
 
     def _delta_model_msg(self, base_version: str, full: ModelMsg) -> Optional[ModelMsg]:
         """``new - base`` ModelMsg, or None when the base (or the current
@@ -337,6 +348,7 @@ class AbstractServer:
                 target=self._apply_loop, name="apply-worker", daemon=True
             )
             self._apply_worker.start()
+        self.telemetry.register_fleet(id(self), self.fleet.snapshot)
         self.transport.start()
         self.log(f"serving on {self.transport.address}")
 
@@ -359,6 +371,7 @@ class AbstractServer:
                     item[2].set_exception(RuntimeError("server stopped"))
             self._apply_worker = None
             self._apply_queue = None
+        self.telemetry.unregister_fleet(id(self))
         self.transport.stop()
 
     @property
@@ -375,6 +388,8 @@ class AbstractServer:
             self.num_clients += 1
             n = self.num_clients
         self._g_clients.set(n)
+        self.fleet.connect(client_id)
+        self.telemetry.flight.record("connect", client_id=client_id, clients=n)
         self.log(f"connection: {n} clients")
         self.callbacks.fire("connect", client_id)
         self.handle_connection(client_id)
@@ -388,6 +403,9 @@ class AbstractServer:
             # base is dead weight; the replacement dial starts base-less
             self._client_bases.pop(client_id, None)
         self._g_clients.set(n)
+        self.fleet.disconnect(client_id)
+        self.telemetry.flight.record("disconnect", client_id=client_id,
+                                     clients=n)
         self.log(f"disconnection: {n} clients")
         self.callbacks.fire("disconnect", client_id)
         self.handle_disconnection(client_id)
@@ -401,23 +419,32 @@ class AbstractServer:
         well-behaved clients stop flooding). Either way the ack carries
         the apply verdict — the handler waits on the queued apply's future.
         """
-        msg = UploadMsg.from_wire(payload)
-        self._c_uploads.inc()
-        if msg.gradients is not None:
-            self._c_up_bytes.inc(tree_wire_nbytes(msg.gradients.vars))
-            if any(s.indices is not None for s in msg.gradients.vars.values()):
-                self._c_up_sparse.inc()
-            else:
-                self._c_up_dense.inc()
-        if msg.metrics is not None:
-            self.log(f"client {msg.client_id} metrics: {msg.metrics}")
-        q = self._apply_queue
-        if q is None:
-            return self._process_upload(client_id, msg)
-        fut: "concurrent.futures.Future[Any]" = concurrent.futures.Future()
-        q.put((client_id, msg, fut))
-        self._g_apply_queue.set(q.qsize())
-        return fut.result()
+        # one profiler step bounds the handler's upload lifecycle: with the
+        # apply pipelined, busy is the decode and idle the queue + future
+        # wait — the overlap the pipeline exists to create shows up here
+        with self._prof.step():
+            with self._prof.phase("decode"):
+                msg = UploadMsg.from_wire(payload)
+            self._c_uploads.inc()
+            nbytes = 0
+            if msg.gradients is not None:
+                nbytes = tree_wire_nbytes(msg.gradients.vars)
+                self._c_up_bytes.inc(nbytes)
+                if any(s.indices is not None
+                       for s in msg.gradients.vars.values()):
+                    self._c_up_sparse.inc()
+                else:
+                    self._c_up_dense.inc()
+            self.fleet.note_upload(client_id, nbytes)
+            if msg.metrics is not None:
+                self.log(f"client {msg.client_id} metrics: {msg.metrics}")
+            q = self._apply_queue
+            if q is None:
+                return self._process_upload(client_id, msg)
+            fut: "concurrent.futures.Future[Any]" = concurrent.futures.Future()
+            q.put((client_id, msg, fut))
+            self._g_apply_queue.set(q.qsize())
+            return fut.result()
 
     def _apply_loop(self) -> None:
         """Single apply worker: drains the bounded queue in FIFO order.
@@ -458,7 +485,7 @@ class AbstractServer:
             with self.telemetry.span(
                 "apply", trace_id=msg.trace_id, parent_id=msg.span_id,
                 client_id=msg.client_id,
-            ):
+            ), self._prof.phase("apply"):
                 self.callbacks.fire("upload", msg)
                 return self.handle_upload(client_id, msg)
         while True:
@@ -490,7 +517,7 @@ class AbstractServer:
             with self.telemetry.span(
                 "apply", trace_id=msg.trace_id, parent_id=msg.span_id,
                 client_id=msg.client_id, update_id=uid, dedup=False,
-            ) as span:
+            ) as span, self._prof.phase("apply"):
                 self.callbacks.fire("upload", msg)
                 result = self.handle_upload(client_id, msg)
                 span.set(accepted=bool(result))
@@ -512,6 +539,11 @@ class AbstractServer:
         self._c_resyncs.inc()
         with self._delta_lock:
             self._client_bases.pop(client_id, None)
+        self.fleet.note_resync(client_id)
+        # a resync means a client refused our delta — worth a postmortem
+        # bundle (no-op without a telemetry save_dir)
+        self.telemetry.flight.record("resync", client_id=client_id)
+        self.telemetry.flight.dump("resync", client_id=client_id)
         self.log(f"resync requested by {client_id}: next broadcast is full")
         self.handle_resync(client_id)
         return True

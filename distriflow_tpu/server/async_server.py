@@ -280,6 +280,7 @@ class AsynchronousSGDServer(AbstractServer):
                 sent_version = self._client_versions.get(client_id, self.version_counter)
             staleness = self.version_counter - sent_version
             self._h_staleness.observe(staleness)
+            self.fleet.note_staleness(client_id, staleness)
             if staleness > self.hyperparams.maximum_staleness:
                 self.rejected_updates += 1
                 self._c_rejected.inc()
@@ -302,16 +303,24 @@ class AsynchronousSGDServer(AbstractServer):
             # quarantine gate: a non-finite or norm-outlier gradient is
             # rejected BEFORE it can touch the canonical model, and its
             # payload is dumped for postmortem (docs/ROBUSTNESS.md §8)
-            verdict = self.gate.check(grads)
+            with self._prof.phase("quarantine"):
+                verdict = self.gate.check(grads)
             if not verdict.ok:
                 self.rejected_updates += 1
                 self._c_rejected.inc()
+                self.fleet.note_quarantine(client_id)
                 self.log(f"quarantined update from {msg.client_id}: {verdict.reason}")
                 self.gate.quarantine(
                     msg.gradients.vars, verdict.reason,
                     client_id=msg.client_id, update_id=msg.update_id,
                     batch=msg.batch, version=msg.gradients.version,
                 )
+                self.telemetry.flight.record(
+                    "quarantine", client_id=msg.client_id,
+                    update_id=msg.update_id, reason=verdict.reason)
+                self.telemetry.flight.dump(
+                    "quarantine", client_id=msg.client_id,
+                    reason=verdict.reason)
                 return False
             if decay != 1.0:
                 grads = jax.tree.map(lambda g: g * decay, grads)
@@ -329,6 +338,7 @@ class AsynchronousSGDServer(AbstractServer):
                     self.rejected_updates += 1
                     self._c_rejected.inc()
                     self.gate.record_rollback()
+                    self.fleet.note_quarantine(client_id)
                     self.log(f"rolled back update from {msg.client_id}: "
                              "params went non-finite")
                     self.gate.quarantine(
@@ -336,6 +346,11 @@ class AsynchronousSGDServer(AbstractServer):
                         client_id=msg.client_id, update_id=msg.update_id,
                         batch=msg.batch, version=msg.gradients.version,
                     )
+                    self.telemetry.flight.record(
+                        "rollback", client_id=msg.client_id,
+                        update_id=msg.update_id)
+                    self.telemetry.flight.dump(
+                        "rollback", client_id=msg.client_id)
                     return False
                 self.gate.accept(verdict.norm)
                 # state mutations BEFORE save(): the manifest written by the
@@ -375,6 +390,10 @@ class AsynchronousSGDServer(AbstractServer):
             for cid, batch in expired:
                 self.lease_expirations += 1
                 self._c_lease_expired.inc()
+                self.telemetry.flight.record("lease_expiry", client_id=cid,
+                                             batch=batch)
+                self.telemetry.flight.dump("lease_expiry", client_id=cid,
+                                           batch=batch)
                 self.log(f"lease expired on batch {batch} held by {cid[:8]}; "
                          "speculative re-dispatch")
                 self.dataset.requeue(batch)

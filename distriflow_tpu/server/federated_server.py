@@ -86,11 +86,13 @@ class FederatedServer(AbstractServer):
             # buffered now would poison the whole aggregated round later —
             # reject it alone, dump the payload for postmortem
             if self.gate.active:
-                verdict = self.gate.check(
-                    {k: deserialize_array(s) for k, s in vars_.items()}
-                )
+                with self._prof.phase("quarantine"):
+                    verdict = self.gate.check(
+                        {k: deserialize_array(s) for k, s in vars_.items()}
+                    )
                 if not verdict.ok:
                     self.dropped_uploads += 1
+                    self.fleet.note_quarantine(client_id)
                     self.log(f"quarantined upload from {msg.client_id}: "
                              f"{verdict.reason}")
                     self.gate.quarantine(
@@ -98,6 +100,12 @@ class FederatedServer(AbstractServer):
                         client_id=msg.client_id, update_id=msg.update_id,
                         version=msg.gradients.version,
                     )
+                    self.telemetry.flight.record(
+                        "quarantine", client_id=msg.client_id,
+                        update_id=msg.update_id, reason=verdict.reason)
+                    self.telemetry.flight.dump(
+                        "quarantine", client_id=msg.client_id,
+                        reason=verdict.reason)
                     return False
                 self.gate.accept(verdict.norm)
             # decay folds into aggregation as a per-contribution weight
@@ -198,6 +206,10 @@ class FederatedServer(AbstractServer):
                     mean_grads, "post-apply-non-finite",
                     contributions=len(updates), version=self.model.version,
                 )
+                self.telemetry.flight.record(
+                    "rollback", contributions=len(updates))
+                self.telemetry.flight.dump(
+                    "rollback", contributions=len(updates))
                 return
             self.model.save()
             self.download_msg = self.compute_download_msg()

@@ -199,6 +199,10 @@ class InferenceServer:
         self._m_slots = tel.gauge("serving_slots_active")
         self._m_qwait = tel.histogram("serving_queue_wait_ms")
         self._m_tpot = tel.histogram("serving_time_per_output_token_ms")
+        # continuous phase profiler (docs/OBSERVABILITY.md §5): serving
+        # records phases only — the engine loop mostly idles in _gather, so
+        # a per-iteration step() would drown the digests in idle wall time
+        self._prof = tel.profiler("serving")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -420,30 +424,36 @@ class InferenceServer:
             free -= head.prompt.shape[0]
             admit.append(self._backlog.popleft())
         if not admit:
+            # phase("admission") opens only when there is work: the engine
+            # loop polls here continuously and near-zero idle samples would
+            # bury the digest's real admission cost
             return
-        if self._slot_cache is None:
-            with self._device_lock:
-                self._slot_cache = slot_cache(
-                    self.config, self.params, self.serving.max_slots)
-        now = time_mod.monotonic()
-        groups: Dict[int, List[Tuple[_Request, int]]] = {}
-        for req in admit:
-            req.admit_t = now
-            self._m_qwait.observe((now - req.enq_t) * 1000.0)
-            for row in range(req.prompt.shape[0]):
-                groups.setdefault(req.prompt.shape[1], []).append((req, row))
-        for plen, members in sorted(groups.items()):
-            try:
-                self._admit_group(plen, members)
-            except Exception as e:
-                # contain a failed prefill to its own group: any slots the
-                # group already claimed stay unrecorded (free), so the next
-                # insert simply overwrites those cache rows
-                for req in {id(r): r for r, _ in members}.values():
-                    self._finish_error(req, e)
-        self.batched_requests += len(admit)
-        self._m_admitted.inc(len(admit))
-        self._m_slots.set(sum(1 for r in self._slot_req if r is not None))
+        with self._prof.phase("admission"):
+            if self._slot_cache is None:
+                with self._device_lock:
+                    self._slot_cache = slot_cache(
+                        self.config, self.params, self.serving.max_slots)
+            now = time_mod.monotonic()
+            groups: Dict[int, List[Tuple[_Request, int]]] = {}
+            for req in admit:
+                req.admit_t = now
+                self._m_qwait.observe((now - req.enq_t) * 1000.0)
+                for row in range(req.prompt.shape[0]):
+                    groups.setdefault(
+                        req.prompt.shape[1], []).append((req, row))
+            for plen, members in sorted(groups.items()):
+                try:
+                    self._admit_group(plen, members)
+                except Exception as e:
+                    # contain a failed prefill to its own group: any slots
+                    # the group already claimed stay unrecorded (free), so
+                    # the next insert simply overwrites those cache rows
+                    for req in {id(r): r for r, _ in members}.values():
+                        self._finish_error(req, e)
+            self.batched_requests += len(admit)
+            self._m_admitted.inc(len(admit))
+            self._m_slots.set(
+                sum(1 for r in self._slot_req if r is not None))
 
     def _admit_group(self, plen: int, members: List[Tuple[_Request, int]]) -> None:
         """Prefill + insert + first-token for all rows of one prompt
@@ -478,7 +488,7 @@ class InferenceServer:
         prefill, extend = _build_prefill(self.config)
         insert, pick_rows, _ = _build_slot_fns(
             self.config, srv.decode_chunk, sampling)
-        with self._device_lock, self.logger.time(
+        with self._prof.phase("prefill"), self._device_lock, self.logger.time(
             f"admit[{n}->{bucket}x{plen}]"
         ):
             pc = srv.prefill_chunk
@@ -533,51 +543,55 @@ class InferenceServer:
         if not active:
             self._m_slots.set(0)
             return
-        sampling = bool((self._temps[active] > 0).any())
-        _insert, _pick, decode = _build_slot_fns(
-            self.config, srv.decode_chunk, sampling)
-        t0 = time_mod.monotonic()
-        with self._device_lock:
-            cache, tok, done, toks = decode(
-                self.params, self._slot_cache, self._tok, self._done,
-                self._temps, self._top_ks, self._top_ps, self._seeds,
-                self._eos)
-            self._slot_cache = cache
-            # np.array, not np.asarray: device outputs arrive as read-only
-            # views, and the slot state is mutated in place below
-            tok = np.array(tok)
-            done = np.array(done)
-            toks = np.array(toks)
-        elapsed_ms = (time_mod.monotonic() - t0) * 1000.0
-        self._m_tpot.observe(elapsed_ms / srv.decode_chunk)
-        self.decode_batches += 1
-        self._m_batches.inc()
-        self._tok = tok
-        self._done = done
-        emitted_now = 0
-        for s in active:
-            req = self._slot_req[s]
-            row = int(self._slot_row[s])
-            have = int(self._slot_emitted[s])
-            take = min(srv.decode_chunk, req.n_tokens - have)
-            chunk_toks = toks[s, :take].astype(np.int32)
-            emitted_now += take
-            self._slot_emitted[s] = have + take
-            req.rows_out[row] = np.concatenate([req.rows_out[row], chunk_toks])
-            if done[s]:
-                # row froze to eos inside the scan; pad the remaining
-                # budget with eos — bit-identical to the solo path's
-                # frozen-row output — and answer the caller NOW
-                pad = req.n_tokens - have - take
-                if pad:
-                    req.rows_out[row] = np.concatenate([
-                        req.rows_out[row],
-                        np.full((pad,), req.eos, np.int32)])
-                self._complete_row(s)
-            elif have + take >= req.n_tokens:
-                self._complete_row(s)
-        self._m_tokens.inc(emitted_now)
-        self._m_slots.set(sum(1 for r in self._slot_req if r is not None))
+        with self._prof.phase("decode_iter"):
+            sampling = bool((self._temps[active] > 0).any())
+            _insert, _pick, decode = _build_slot_fns(
+                self.config, srv.decode_chunk, sampling)
+            t0 = time_mod.monotonic()
+            with self._device_lock:
+                cache, tok, done, toks = decode(
+                    self.params, self._slot_cache, self._tok, self._done,
+                    self._temps, self._top_ks, self._top_ps, self._seeds,
+                    self._eos)
+                self._slot_cache = cache
+                # np.array, not np.asarray: device outputs arrive as
+                # read-only views, and the slot state is mutated in place
+                # below
+                tok = np.array(tok)
+                done = np.array(done)
+                toks = np.array(toks)
+            elapsed_ms = (time_mod.monotonic() - t0) * 1000.0
+            self._m_tpot.observe(elapsed_ms / srv.decode_chunk)
+            self.decode_batches += 1
+            self._m_batches.inc()
+            self._tok = tok
+            self._done = done
+            emitted_now = 0
+            for s in active:
+                req = self._slot_req[s]
+                row = int(self._slot_row[s])
+                have = int(self._slot_emitted[s])
+                take = min(srv.decode_chunk, req.n_tokens - have)
+                chunk_toks = toks[s, :take].astype(np.int32)
+                emitted_now += take
+                self._slot_emitted[s] = have + take
+                req.rows_out[row] = np.concatenate(
+                    [req.rows_out[row], chunk_toks])
+                if done[s]:
+                    # row froze to eos inside the scan; pad the remaining
+                    # budget with eos — bit-identical to the solo path's
+                    # frozen-row output — and answer the caller NOW
+                    pad = req.n_tokens - have - take
+                    if pad:
+                        req.rows_out[row] = np.concatenate([
+                            req.rows_out[row],
+                            np.full((pad,), req.eos, np.int32)])
+                    self._complete_row(s)
+                elif have + take >= req.n_tokens:
+                    self._complete_row(s)
+            self._m_tokens.inc(emitted_now)
+            self._m_slots.set(
+                sum(1 for r in self._slot_req if r is not None))
 
     def _complete_row(self, s: int) -> None:
         """Finish one slot's row (its tokens already sit in ``rows_out``):
@@ -596,10 +610,11 @@ class InferenceServer:
         scan leaves it inert; its cache row is fully overwritten by the
         next insert, and any writes past max_seq are dropped by the
         scatter's FILL_OR_DROP mode."""
-        self._slot_req[s] = None
-        self._done[s] = True
-        self._temps[s] = 0.0
-        self._eos[s] = -1
+        with self._prof.phase("retire"):
+            self._slot_req[s] = None
+            self._done[s] = True
+            self._temps[s] = 0.0
+            self._eos[s] = -1
 
     def _finish_error(self, req: _Request, err: Exception) -> None:
         if not req.done.is_set():

@@ -93,6 +93,11 @@ class AsyncSGDTrainer:
         self._h_staleness = _t.histogram("train_gradient_staleness", mode="async")
         self._c_applied = _t.counter("train_updates_applied_total", mode="async")
         self._c_rejected = _t.counter("train_updates_rejected_total", mode="async")
+        # continuous phase profiler (docs/OBSERVABILITY.md §5): _phase()
+        # feeds the same dt into rolling digests, and worker_loop bounds
+        # each pull->fit->submit span with a step() so wall-vs-busy yields
+        # the overlap/idle attribution bench.py reports
+        self._prof = _t.profiler("trainer")
 
         # SSP-style admission control (round-4, verdict #3): bounded
         # staleness by CONSTRUCTION instead of by discard. Two pieces:
@@ -316,6 +321,7 @@ class AsyncSGDTrainer:
         dt = (time.perf_counter() - t0) * 1e3
         with self._phase_lock:
             self.phase_ms[name] += dt
+        self._prof.record(name, dt)
         return time.perf_counter()
 
     # -- lifecycle ---------------------------------------------------------
@@ -432,59 +438,66 @@ class AsyncSGDTrainer:
             budget = self.steps_per_upload
             if max_steps is not None:
                 budget = min(budget, max_steps - steps)
-            t0 = time.perf_counter()
-            group = self._take_batches(budget, device)
-            if not group:
-                if self.dataset.exhausted:
-                    break
-                continue  # starved; re-check
-            if self.stage_dataset:
-                t0 = self._phase("stage", t0)  # device-resident: no transfer
-            else:
-                staged = [g[1] for g in group] + [g[2] for g in group]
-                t0 = self._phase("stage", t0, *staged)
-            ticket = None
-            try:
-                if self.admission_control:
-                    # SSP span: window slot + submit-order ticket (ctor
-                    # comment) — the wait replaces what used to be
-                    # discarded compute
-                    ticket, params, version = self._admit()
-                    t0 = self._phase("admission_wait", t0)
-                else:
-                    params, version = self.snapshot()
-                local_params = jax.device_put(params, device)
-                t0 = self._phase("snapshot", t0, local_params)
+            # one profiler step bounds the whole pull->fit->submit span,
+            # INCLUDING the take: a starved iteration records wall with no
+            # phase time, which is exactly the idle attribution we want
+            with self._prof.step():
+                t0 = time.perf_counter()
+                group = self._take_batches(budget, device)
+                if not group:
+                    if self.dataset.exhausted:
+                        break
+                    continue  # starved; re-check
                 if self.stage_dataset:
-                    grads = self._staged_fit(local_params, group, device)
+                    # device-resident: no transfer
+                    t0 = self._phase("stage", t0)
                 else:
-                    grads = self._host_fit(local_params, group)
-                t0 = self._phase("fit", t0, grads)
-                if ticket is not None:
-                    # ordering wait books under admission_wait, NOT submit:
-                    # with heterogeneous workers the FIFO wait can dominate
-                    # and the phase breakdown must localize it correctly
-                    self._await_turn(ticket)
-                    t0 = self._phase("admission_wait", t0)
-                self.submit(grads, version,
-                            client_id=f"worker-{worker_index}")
-                self._phase("submit", t0,
-                            self.params if self.profile_phases else ())
-            except BaseException:
-                # failure recovery: return the batches to the queue so another
-                # worker picks them up (the redelivery role of reference
-                # dataset.ts:56-60, triggered by actual failure here)
+                    staged = [g[1] for g in group] + [g[2] for g in group]
+                    t0 = self._phase("stage", t0, *staged)
+                ticket = None
+                try:
+                    if self.admission_control:
+                        # SSP span: window slot + submit-order ticket (ctor
+                        # comment) — the wait replaces what used to be
+                        # discarded compute
+                        ticket, params, version = self._admit()
+                        t0 = self._phase("admission_wait", t0)
+                    else:
+                        params, version = self.snapshot()
+                    local_params = jax.device_put(params, device)
+                    t0 = self._phase("snapshot", t0, local_params)
+                    if self.stage_dataset:
+                        grads = self._staged_fit(local_params, group, device)
+                    else:
+                        grads = self._host_fit(local_params, group)
+                    t0 = self._phase("fit", t0, grads)
+                    if ticket is not None:
+                        # ordering wait books under admission_wait, NOT
+                        # submit: with heterogeneous workers the FIFO wait
+                        # can dominate and the phase breakdown must localize
+                        # it correctly
+                        self._await_turn(ticket)
+                        t0 = self._phase("admission_wait", t0)
+                    self.submit(grads, version,
+                                client_id=f"worker-{worker_index}")
+                    self._phase("submit", t0,
+                                self.params if self.profile_phases else ())
+                except BaseException:
+                    # failure recovery: return the batches to the queue so
+                    # another worker picks them up (the redelivery role of
+                    # reference dataset.ts:56-60, triggered by failure here)
+                    for b, _, _ in group:
+                        self.dataset.requeue(b.batch)
+                    raise
+                finally:
+                    if ticket is not None:
+                        self._close_span(ticket)
+                # ack regardless of staleness-acceptance: the batches were
+                # consumed (reference acks before applying,
+                # asynchronousSGD_server.ts:66-72)
                 for b, _, _ in group:
-                    self.dataset.requeue(b.batch)
-                raise
-            finally:
-                if ticket is not None:
-                    self._close_span(ticket)
-            # ack regardless of staleness-acceptance: the batches were consumed
-            # (reference acks before applying, asynchronousSGD_server.ts:66-72)
-            for b, _, _ in group:
-                self.dataset.complete_batch(b.batch)
-            steps += len(group)
+                    self.dataset.complete_batch(b.batch)
+                steps += len(group)
         return steps
 
     def _host_fit(self, local_params, group):

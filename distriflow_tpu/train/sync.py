@@ -503,7 +503,13 @@ class SyncTrainer:
                 "compiled-step cost analysis reports no 'flops' on this "
                 f"backend (keys: {sorted(analysis)}); MFU unavailable"
             )
-        return float(analysis["flops"]) / (step_seconds * peak_flops_per_chip)
+        value = float(analysis["flops"]) / (step_seconds * peak_flops_per_chip)
+        # live MFU surface: the health sentinel's mfu_floor band and the
+        # bench cross-check read this gauge (docs/OBSERVABILITY.md §6);
+        # set only on success so a backend without flop counts leaves the
+        # gauge unregistered rather than pinned at a stale value
+        get_telemetry().gauge("train_mfu", mode="sync").set(value)
+        return value
 
     # -- checkpointing -----------------------------------------------------
 

@@ -1,0 +1,140 @@
+"""Flight recorder: bounded event ring + postmortem bundles.
+
+Pins the contract from docs/OBSERVABILITY.md: the ring evicts oldest
+first under a fixed capacity; a dump is a single self-contained JSON
+bundle — size-bounded (oldest events dropped first), scrubbed of
+secret-looking fields and raw payload bytes, written atomically, and
+readable back via ``python -m distriflow_tpu.obs.dump <dir> --flight``.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from distriflow_tpu.obs.flight_recorder import (
+    FLIGHT_DIRNAME,
+    FlightRecorder,
+    NOOP_FLIGHT,
+    read_bundles,
+)
+
+pytestmark = pytest.mark.obs
+
+
+def test_ring_evicts_oldest_first():
+    fr = FlightRecorder(capacity=4)
+    for i in range(6):
+        fr.record("tick", i=i)
+    evts = fr.events()
+    assert [e["i"] for e in evts] == [2, 3, 4, 5]
+    assert [e["seq"] for e in evts] == [2, 3, 4, 5]  # seq survives eviction
+
+
+def test_dump_contents_scrubbed_and_bounded(tmp_path):
+    fr = FlightRecorder(capacity=32, save_dir=str(tmp_path))
+    fr.record("connect", client_id="c1",
+              auth_token="hunter2", api_key="hunter2")
+    fr.record("upload", payload=b"\x00" * 4096, note="x" * 1000)
+    path = fr.dump("quarantine", client_id="c1", reason="non-finite")
+    assert path is not None and os.path.exists(path)
+    raw = open(path).read()
+    assert "hunter2" not in raw  # secret-looking fields never reach disk
+    bundle = json.loads(raw)
+    assert bundle["trigger"] == "quarantine"
+    assert bundle["context"] == {"client_id": "c1", "reason": "non-finite"}
+    evts = {e["kind"]: e for e in bundle["events"]}
+    assert evts["connect"]["auth_token"] == "<redacted>"
+    assert evts["connect"]["api_key"] == "<redacted>"
+    assert evts["upload"]["payload"] == "<4096 bytes>"  # bytes -> placeholder
+    assert evts["upload"]["note"].endswith("...")  # long strings truncated
+    assert len(evts["upload"]["note"]) <= 260
+
+
+def test_dump_size_bound_drops_oldest(tmp_path):
+    fr = FlightRecorder(capacity=256, save_dir=str(tmp_path),
+                        max_bundle_bytes=4096)
+    for i in range(256):
+        fr.record("tick", i=i, pad="p" * 64)
+    path = fr.dump("slo_test")
+    assert os.path.getsize(path) <= 4096
+    bundle = json.loads(open(path).read())
+    assert bundle["events_dropped"] > 0
+    # the SURVIVING events are the newest ones (oldest dropped first)
+    assert bundle["events"][-1]["i"] == 255
+
+
+def test_dump_without_dir_is_silent_noop():
+    fr = FlightRecorder()
+    fr.record("x")
+    assert fr.dump("trigger") is None
+    assert fr.dumped == []
+    # the shared no-op mirrors the same surface
+    NOOP_FLIGHT.record("x", secret="s")
+    assert NOOP_FLIGHT.events() == []
+    assert NOOP_FLIGHT.dump("t") is None
+
+
+def test_concurrent_writers_keep_unique_ordered_seqs():
+    fr = FlightRecorder(capacity=4096)
+    n_threads, per_thread = 8, 200
+
+    def writer(tid):
+        for i in range(per_thread):
+            fr.record("w", tid=tid, i=i)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evts = fr.events()
+    assert len(evts) == n_threads * per_thread
+    seqs = [e["seq"] for e in evts]
+    assert len(set(seqs)) == len(seqs)  # no duplicate sequence numbers
+    assert seqs == sorted(seqs)  # ring order == stamp order
+
+
+def test_excepthook_dumps_crash_bundle(tmp_path):
+    fr = FlightRecorder(save_dir=str(tmp_path))
+    fr.record("step", n=7)
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: None  # swallow the chained print
+    try:
+        fr.install_excepthook()
+        sys.excepthook(ValueError, ValueError("boom"), None)
+    finally:
+        sys.excepthook = prev
+    bundles = read_bundles(str(tmp_path))
+    assert len(bundles) == 1
+    assert bundles[0]["trigger"] == "crash"
+    assert bundles[0]["context"]["error"] == "ValueError: boom"
+    assert any(e["kind"] == "crash" for e in bundles[0]["events"])
+
+
+def test_dump_cli_flight_round_trip(tmp_path, capsys):
+    from distriflow_tpu.obs import dump
+
+    fr = FlightRecorder(save_dir=str(tmp_path))
+    fr.record("quarantine", client_id="c9")
+    fr.dump("rollback", contributions=3)
+    # bundles alone (no metrics/spans jsonl) count as a found source
+    assert dump.main([str(tmp_path), "--flight"]) == 0
+    out = capsys.readouterr().out
+    assert "trigger=rollback" in out and "quarantinex1" in out
+    assert "contributions=3" in out
+    # read_bundles agrees with what the CLI printed
+    bundles = read_bundles(str(tmp_path))
+    assert len(bundles) == 1 and bundles[0]["trigger"] == "rollback"
+    assert bundles[0]["_file"].endswith(".json")
+    # an empty dir stays exit-2, --flight or not
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert dump.main([str(empty), "--flight"]) == 2
+    # torn bundle (crash mid-write): skipped, not fatal
+    torn = tmp_path / FLIGHT_DIRNAME / "flight_0_9999_torn.json"
+    torn.write_text('{"truncated')
+    assert len(read_bundles(str(tmp_path))) == 1

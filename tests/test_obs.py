@@ -10,7 +10,12 @@ The design contract pinned here (see docs/OBSERVABILITY.md):
   hit at construction and one attribute bump per event;
 - trace ids ride the wire (UploadMsg/DownloadMsg headers) and survive
   retries, reconnects, and dedup — every applied update's server span
-  links back to the client upload span that produced it.
+  links back to the client upload span that produced it;
+- the continuous phase profiler (§5) keeps the same bargain: disabled ->
+  shared no-ops within a pinned tight-loop budget; enabled -> rolling
+  digests plus per-step wall/overlap/idle attribution;
+- the health sentinel (§6) is edge-triggered: one counter increment and
+  one flight bundle per breach ENTRY, never per check.
 """
 
 import json
@@ -285,3 +290,195 @@ def test_trace_propagation_under_chaos(tmp_path):
         assert tel.counter_value(
             "transport_frames_offered_total", role=role
         ) == sum(plan.seen().values())
+
+
+# -- continuous phase profiler (docs/OBSERVABILITY.md §5) -------------------
+
+
+def test_profiler_digests_and_step_attribution():
+    from distriflow_tpu.obs.profiler import STEP_IDLE, STEP_OVERLAP, STEP_WALL
+
+    t = Telemetry()
+    prof = t.profiler("client")
+    assert prof is t.profiler("client")  # cached per role
+    assert prof is not t.profiler("server")
+
+    with prof.step():
+        with prof.phase("fit"):
+            time.sleep(0.002)
+        with prof.phase("submit"):
+            # nested phase: gets its own digest but must NOT double-count
+            # in step busy (outermost-only attribution)
+            with prof.phase("ack_wait"):
+                time.sleep(0.001)
+    d = prof.digests()
+    assert set(d) >= {"fit", "submit", "ack_wait"}
+    assert d["fit"]["count"] == 1 and d["fit"]["p50"] >= 1.0
+    sd = prof.step_digest()
+    assert sd["wall"]["count"] == 1
+    wall = sd["wall"]["sum"]
+    # busy == fit + submit (ack_wait folded into submit): overlap ~ 0
+    assert sd["overlap"]["sum"] < 0.5 * wall
+    # everything flows through the one registry -> snapshot/prometheus free
+    snap = t.snapshot()
+    assert "phase_ms{phase=fit,role=client}" in snap["histograms"]
+    assert f"{STEP_WALL}{{role=client}}" in snap["histograms"]
+    assert f"{STEP_OVERLAP}{{role=client}}" in snap["histograms"]
+    assert f"{STEP_IDLE}{{role=client}}" in snap["histograms"]
+
+
+def test_profiler_record_books_async_overlap():
+    """record() is the dispatch-time path (async trainer): booked busy can
+    exceed the step's wall, and the digest must attribute it as overlap."""
+    t = Telemetry()
+    prof = t.profiler("trainer")
+    with prof.step():
+        prof.record("fit", 100.0)  # 100 ms of booked work, ~0 ms of wall
+    sd = prof.step_digest()
+    assert sd["overlap"]["sum"] > 80.0
+    assert sd["idle"]["sum"] < 20.0
+    assert prof.digests()["fit"]["count"] == 1
+
+
+def test_profiler_idle_attribution():
+    t = Telemetry()
+    prof = t.profiler("trainer")
+    with prof.step():
+        time.sleep(0.005)  # wall with no booked phase -> pure idle
+    sd = prof.step_digest()
+    assert sd["idle"]["sum"] >= 3.0
+    assert sd["overlap"]["sum"] < 1.0
+
+
+def test_profiler_disabled_is_shared_noop_and_cheap():
+    from distriflow_tpu.obs import NOOP_FLIGHT, NOOP_PHASE, NOOP_PROFILER
+
+    t = Telemetry(enabled=False)
+    prof = t.profiler("client")
+    assert prof is NOOP_PROFILER
+    assert prof.phase("fit") is NOOP_PHASE
+    assert prof.step() is NOOP_PHASE or prof.step() is not None  # no-op ctx
+    assert t.flight is NOOP_FLIGHT
+    t.register_fleet("k", dict)  # must not leak into the snapshot
+    assert t.registry._metrics == {}
+    assert t.snapshot() == {"counters": {}, "gauges": {}, "histograms": {}}
+    # the pinned overhead budget: the disabled hot path is two context
+    # managers over shared singletons — 100k step+phase rounds must stay
+    # comfortably inside 1 s even on a loaded CI box
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        with prof.step():
+            with prof.phase("fit"):
+                pass
+    assert time.perf_counter() - t0 < 1.0
+
+
+# -- fleet health table (docs/OBSERVABILITY.md §6) --------------------------
+
+
+def test_fleet_table_rows_and_snapshot_merge():
+    from distriflow_tpu.obs import FleetTable
+
+    t = Telemetry()
+    fleet = FleetTable()
+    t.register_fleet("srv", fleet.snapshot)
+    fleet.connect("c1")
+    fleet.note_download("c1", 100)
+    fleet.note_upload("c1", 40)
+    fleet.note_staleness("c1", 2)
+    fleet.note_quarantine("c1")
+    snap = t.snapshot()
+    row = snap["fleet"]["c1"]
+    assert row["connected"] and row["uploads"] == 1
+    assert row["up_bytes"] == 40 and row["down_bytes"] == 100
+    assert row["staleness"] == 2 and row["quarantine_hits"] == 1
+    assert row["round_ms"] is not None  # download -> upload latency
+    assert not any(k.startswith("_") for k in row)  # internals stripped
+    fleet.disconnect("c1")
+    assert not t.snapshot()["fleet"]["c1"]["connected"]
+    t.unregister_fleet("srv")
+    assert "fleet" not in t.snapshot()
+
+
+def test_fleet_table_evicts_longest_gone_disconnected():
+    from distriflow_tpu.obs import FleetTable
+
+    fleet = FleetTable(capacity=2)
+    fleet.connect("a")
+    fleet.disconnect("a")
+    fleet.connect("b")
+    fleet.disconnect("b")
+    fleet.connect("c")  # at capacity: evicts "a" (longest gone)
+    rows = fleet.snapshot()
+    assert set(rows) == {"b", "c"}
+
+
+# -- health sentinel (docs/OBSERVABILITY.md §6) -----------------------------
+
+
+def test_health_sentinel_edge_trigger_and_bundle(tmp_path):
+    from distriflow_tpu.obs.flight_recorder import read_bundles
+    from distriflow_tpu.obs.health import HealthSentinel, default_bands
+
+    t = Telemetry()
+    h = t.histogram("transport_ack_latency_ms", role="client")
+    watch = HealthSentinel(
+        t, bands=default_bands(ack_p99_ms=250.0, mfu_floor=0.05),
+        dump_dir=str(tmp_path))
+    # unknown metric (train_mfu never set) must not breach
+    assert watch.check() == []
+    for _ in range(20):
+        h.observe(500.0)
+    entered = watch.check()
+    assert [e["band"] for e in entered] == ["ack_latency_p99"]
+    assert entered[0]["observed"] == 500.0
+    assert watch.check() == []  # still in breach: edge-triggered
+    assert t.counter_value("obs_slo_breach_total",
+                           band="ack_latency_p99") == 1
+    assert watch.breached() == ["ack_latency_p99"]
+    bundles = read_bundles(str(tmp_path))
+    assert len(bundles) == 1
+    assert bundles[0]["trigger"] == "slo_ack_latency_p99"
+    assert any(e["kind"] == "slo_breach" for e in bundles[0]["events"])
+    # recovery then relapse re-fires (window pushes p99 back under)
+    for _ in range(2000):
+        h.observe(1.0)
+    assert watch.check() == [] and watch.breached() == []
+    for _ in range(2000):
+        h.observe(500.0)
+    assert [e["band"] for e in watch.check()] == ["ack_latency_p99"]
+    assert t.counter_value("obs_slo_breach_total",
+                           band="ack_latency_p99") == 2
+
+
+def test_health_sentinel_min_count_gate():
+    from distriflow_tpu.obs.health import HealthSentinel, SLOBand
+
+    t = Telemetry()
+    t.histogram("lat", role="x").observe(999.0)
+    band = SLOBand("lat_p99", "lat", "p99", {"role": "x"},
+                   upper=10.0, min_count=5)
+    watch = HealthSentinel(t, bands=[band])
+    assert watch.check() == []  # 1 sample < min_count: not judged
+    for _ in range(5):
+        t.histogram("lat", role="x").observe(999.0)
+    assert [e["band"] for e in watch.check()] == ["lat_p99"]
+
+
+# -- dump --watch -----------------------------------------------------------
+
+
+def test_dump_watch_smoke(tmp_path, capsys):
+    from distriflow_tpu.obs import dump
+
+    t = Telemetry(save_dir=str(tmp_path))
+    t.counter("frames_total").inc(3)
+    t.export_snapshot()
+    assert dump.main([str(tmp_path), "--watch", "--iterations", "2",
+                      "--interval", "0.01"]) == 0
+    out = capsys.readouterr().out
+    assert "watch[1]" in out and "frames_total=3" in out
+    assert "watch[2]" in out and "no change" in out
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert dump.main([str(empty), "--watch", "--iterations", "1"]) == 2
