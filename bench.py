@@ -265,6 +265,24 @@ def _phase_digest(role):
     return out
 
 
+def _trace_mark():
+    """Current length of the global tracer's finished-span deque — a
+    cursor for assembling only the rounds a timed section emits."""
+    from distriflow_tpu.obs.telemetry import get_telemetry
+
+    return len(get_telemetry().tracer.finished())
+
+
+def _assemble_since(mark):
+    """Assemble the trace rows emitted after ``mark`` (the deque is
+    bounded, so a wrapped window assembles what survived)."""
+    from distriflow_tpu.obs.telemetry import get_telemetry
+    from distriflow_tpu.obs.trace_assembler import assemble
+
+    rows = get_telemetry().tracer.finished()
+    return assemble(rows[mark:] if mark <= len(rows) else rows)
+
+
 # -- config #1: MNIST MLP sync-SGD ----------------------------------------
 
 
@@ -531,6 +549,7 @@ def bench_cifar_async(matrix):
     # the continuous profiler kept recording through the warm-up; diff its
     # digests across the timed train() only (docs/OBSERVABILITY.md §5)
     prof_base = _phase_digest("trainer")
+    trace_mark = _trace_mark()
 
     workers = 4
     start = time.perf_counter()
@@ -576,6 +595,22 @@ def bench_cifar_async(matrix):
         f"idle {idle_ms} ms/step; step-wall {step_wall_sum:.0f}/{workers} "
         f"workers + drain {drain_ms:.0f} = {recon_est_ms:.0f} vs wall "
         f"{wall_ms:.0f} ms ({recon_pct}% off)")
+
+    # round-trip assembly (docs/OBSERVABILITY.md §9): the same rounds the
+    # profiler digested, rebuilt from their trace rows — bound_by names the
+    # phase that owned the most critical-path time, and the assembler's
+    # overlap must agree with the profiler's (both are busy - wall per
+    # round; the acceptance gate pins them within 10%)
+    asm = _assemble_since(trace_mark).attribution()
+    bound_by = asm["bound_by"]
+    asm_overlap_ms = asm["overlap_ms"]
+    prof_overlap = overlap_ms if overlap_ms is not None else 0.0
+    tol = max(abs(prof_overlap) * 0.10, 1.0)  # 10%, 1 ms noise floor
+    agree = abs(asm_overlap_ms - prof_overlap) <= tol
+    log(f"#3t assembler: {asm['applied']}/{asm['rounds']} rounds, "
+        f"bound_by={bound_by}, overlap {asm_overlap_ms} vs profiler "
+        f"{prof_overlap} ms/step "
+        f"({'consistent' if agree else 'INCONSISTENT'})")
 
     # wire-cost columns (docs/PERFORMANCE.md §8): what ONE update/broadcast
     # of this model costs on the multi-process wire, dense f32 vs 1% top-k
@@ -627,6 +662,8 @@ def bench_cifar_async(matrix):
         "overlap_ms": overlap_ms,
         "idle_ms": idle_ms,
         "recon_pct": recon_pct,
+        "bound_by": bound_by,
+        "asm_overlap_ms": asm_overlap_ms,
         "floor_ms": round(dispatch_floor_ms, 1),
         "ceiling_sps": round(ceiling, 0),
         "up_bytes_per_update": up_dense,
@@ -662,11 +699,13 @@ def bench_fedavg():
         np.eye(10, dtype=np.float32)[rng.randint(0, 10, (w, k, b))], sharding)
     _fetch(x), _fetch(y)  # stage the round data on device before timing
     trainer.round(x, y)  # compile + warm
+    trace_mark = _trace_mark()  # assemble only the timed rounds below
     rounds = 2 if FAST else 5
     start = time.perf_counter()
     for _ in range(rounds):
         loss = trainer.round(x, y)
     elapsed = time.perf_counter() - start
+    asm = _assemble_since(trace_mark).attribution()
     sps = w * k * b * rounds / elapsed
     # honesty note (round-2 verdict weak item 4): with one physical chip,
     # workers == 1 and the round's defining weight-pmean is a no-op — this
@@ -684,6 +723,7 @@ def bench_fedavg():
         "value": round(sps, 1),
         "round_ms": round(elapsed * 1e3 / rounds, 2),
         "workers": w,
+        "bound_by": asm["bound_by"],
         "up_bytes_per_update": up_dense,
         "down_bytes_per_broadcast": down_dense,
     }
@@ -1246,13 +1286,16 @@ def bench_transformer_large(n_chips):
 # window (never expected — the flat schema sits well under it — but the
 # window must be enforced mechanically, not hoped about)
 _DROP_ORDER = [
-    "recon_pct", "idle_ms", "overlap_ms", "submit_ms", "fit_ms",
-    "drain_ms", "dispatch_ms", "ceiling_sps", "seq_ms", "conc_ms",
+    "recon_pct", "asm_overlap_ms", "idle_ms", "overlap_ms", "submit_ms",
+    "fit_ms", "drain_ms", "dispatch_ms", "ceiling_sps", "seq_ms", "conc_ms",
     "params_m", "round_ms", "workers", "step_ms", "mfu_med", "top2_mfu",
     "top2_tok_s", "i8_ms_tok_1k", "hbm_frac_4k", "wall_ms",
     "unattributed_ms", "topk_int8_bytes", "topk_int8_reduction_x",
     "topk_fraction", "down_bytes_per_broadcast", "dense_bytes",
     "up_bytes_per_update", "reduction_x",
+    # bound_by drops dead last: it is the one column the ROADMAP-4 overlap
+    # work pins its before/after on
+    "bound_by",
 ]
 
 
@@ -1382,6 +1425,30 @@ def main() -> None:
         base = baselines.get(entry.get("config"))
         if base and "value" in entry:
             entry["vs_baseline"] = round(entry["value"] * n_chips / base, 3)
+
+    # bench regression ledger (docs/PERFORMANCE.md §9): every successful
+    # row is verdict-checked against history (ok/warn/regress to stderr)
+    # and then appended to BENCH_LEDGER.jsonl with its tolerance band
+    # pinned — the BENCH_r*.json eyeballing, mechanized
+    try:
+        from distriflow_tpu.obs.ledger import BenchLedger
+
+        ledger = BenchLedger()
+        run_id = f"bench-{int(_T0)}"
+        for entry in matrix:
+            cfg = entry.get("config")
+            if not cfg or "error" in entry:
+                continue
+            numbers = {k: v for k, v in entry.items()
+                       if isinstance(v, (int, float))
+                       and not isinstance(v, bool)}
+            if not numbers:
+                continue
+            verdict = ledger.compare(cfg, numbers)
+            log(ledger.summary(verdict))
+            ledger.record(cfg, numbers, run_id=run_id)
+    except Exception as e:  # the ledger must never cost the record line
+        log(f"ledger update failed: {e!r}")
 
     # headline: the CIFAR sync row — a real model with a real measured
     # torch baseline (the round-2 verdict: don't headline the MNIST

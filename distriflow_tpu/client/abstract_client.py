@@ -293,7 +293,18 @@ class AbstractClient:
         else:
             self._c_down_full.inc()
         with self._download_lock:
-            installed = self.set_params_from(msg)
+            if msg.trace_id:
+                # join the dispatch's trace so the assembler can place the
+                # install leg on the round's critical path
+                with self.telemetry.span(
+                    "install", trace_id=msg.trace_id, parent_id=msg.span_id,
+                    client_id=self.client_id, model_version=msg.model.version,
+                    delta=msg.model.delta_base is not None,
+                ) as ispan:
+                    installed = self.set_params_from(msg)
+                    ispan.set(installed=installed)
+            else:
+                installed = self.set_params_from(msg)
             if installed:
                 self.msg = msg
         if not installed:
@@ -397,8 +408,16 @@ class AbstractClient:
         ) as span:
             msg.trace_id = span.trace_id or msg.trace_id
             msg.span_id = span.span_id or msg.span_id
+            if msg.gradients is not None:
+                span.set(model_version=msg.gradients.version)
+            t_ser = time.perf_counter()
             with self._prof.phase("serialize"):
                 wire = msg.to_wire()
+            # sub-durations the trace assembler carves the span with:
+            # serialize_ms heads the span, ack_wait_ms sums the in-flight
+            # request->ack waits across attempts (backoff sleeps excluded)
+            span.set(serialize_ms=(time.perf_counter() - t_ser) * 1e3)
+            ack_wait_ms = 0.0
             policy = self.config.upload_retry.validate()
             last_exc: Optional[Exception] = None
             delays = [None, *policy.delays()]  # first attempt is immediate
@@ -424,12 +443,15 @@ class AbstractClient:
                         if transport is None:
                             last_exc = ConnectionLost("not connected")
                             continue
+                        t_ack = time.perf_counter()
                         try:
                             with self._prof.phase("ack_wait"):
                                 result = transport.request(
                                     Events.Upload.value, wire, timeout)
+                            ack_wait_ms += (time.perf_counter() - t_ack) * 1e3
                             break
                         except (AckTimeout, ConnectionLost) as exc:
+                            ack_wait_ms += (time.perf_counter() - t_ack) * 1e3
                             last_exc = exc
                             self.log(
                                 f"upload attempt {attempt + 1}/{len(delays)} "
@@ -453,7 +475,8 @@ class AbstractClient:
                     # swap of the transport object is the ground truth that
                     # a reconnect happened inside this span
                     spanned = 1
-                span.set(attempts=attempts, reconnects_spanned=spanned)
+                span.set(attempts=attempts, reconnects_spanned=spanned,
+                         ack_wait_ms=ack_wait_ms)
         version = msg.gradients.version if msg.gradients is not None else None
         if version is not None:
             self.version_update_counts[version] = (

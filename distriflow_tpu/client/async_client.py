@@ -11,6 +11,7 @@ bookkeeping. The loop ends when the server signals ``trainingComplete``.
 from __future__ import annotations
 
 import collections
+import contextlib
 import threading
 import time
 import uuid as uuid_lib
@@ -27,6 +28,10 @@ from distriflow_tpu.utils.serialization import deserialize_array
 # for reconnect reconciliation; a worker only ever holds one batch at a time,
 # so this comfortably covers redelivery races
 _RECENT_UPLOADS = 16
+
+# stand-in when a download arrived without a trace header: a fit span with
+# no trace would assemble as its own orphan round
+_NULL_CTX = contextlib.nullcontext()
 
 
 class AsynchronousSGDClient(AbstractClient):
@@ -82,7 +87,16 @@ class AsynchronousSGDClient(AbstractClient):
                     metrics: Optional[List[float]] = None
                     if self.config.send_metrics:
                         metrics = self.model.evaluate(x, y)
-                    with self.time("fit"), self._prof.phase("fit"):
+                    # the fit leg joins the dispatch's trace (when one rode
+                    # the download header) so the assembler can place client
+                    # compute on the round's critical path
+                    with self.time("fit"), self._prof.phase("fit"), \
+                            self.telemetry.span(
+                                "fit", trace_id=msg.trace_id,
+                                parent_id=msg.span_id,
+                                client_id=self.client_id,
+                                model_version=msg.model.version,
+                            ) if msg.trace_id else _NULL_CTX:
                         grads = self.model.fit(x, y)
                     upload = UploadMsg(
                         client_id=self.client_id,

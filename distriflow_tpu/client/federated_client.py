@@ -11,13 +11,17 @@ and drops the consumed rows.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from distriflow_tpu.client.abstract_client import AbstractClient
+from distriflow_tpu.obs.tracing import new_trace_id
 from distriflow_tpu.utils.messages import GradientMsg, UploadMsg
+
+_NULL_CTX = contextlib.nullcontext()
 
 
 class FederatedClient(AbstractClient):
@@ -60,9 +64,16 @@ class FederatedClient(AbstractClient):
             metrics: Optional[List[float]] = None
             if self.config.send_metrics:
                 metrics = self.model.evaluate(jnp.asarray(cx), jnp.asarray(cy))
-            with self.time("fit"):
-                grads = self.model.fit(jnp.asarray(cx), jnp.asarray(cy))
             version = self.msg.model.version
+            # no dispatch opened this round (data is client-local), so the
+            # client roots the trace itself at fit time and threads it
+            # through the upload — fit/serialize/submit/apply still join
+            tid = new_trace_id() if self.telemetry.enabled else None
+            with self.time("fit"), self.telemetry.span(
+                "fit", trace_id=tid, client_id=self.client_id,
+                model_version=version,
+            ) if tid else _NULL_CTX:
+                grads = self.model.fit(jnp.asarray(cx), jnp.asarray(cy))
             with self.time("upload"):
                 self.upload(
                     UploadMsg(
@@ -72,6 +83,7 @@ class FederatedClient(AbstractClient):
                             vars=self.serialize_grads(grads),
                         ),
                         metrics=metrics,
+                        trace_id=tid,
                     )
                 )
             uploads += 1

@@ -27,8 +27,19 @@ silent socket.io hang). Checks, in order:
 9. straggler drill: one artificially slow client, a short batch lease —
    the run must complete via speculative re-dispatch and the straggler's
    late gradient must be suppressed by first-wins arbitration;
-10. native C++ host library presence (optional — numpy fallback is fine);
-11. checkpoint write/read round trip in a temp dir.
+10. sparse-wire drill: top-k + int8 uploads with error feedback and
+    delta broadcasts reconstruct the dense mean within tolerance, and a
+    forced reconnect is repaired with a full sync;
+11. health-sentinel drill: a scripted 0.4 s ack delay must trip the
+    ack-latency SLO band exactly once (edge-triggered) and dump exactly
+    one flight bundle; a clean run must trip nothing;
+12. critical-path drill: assembled round traces must attribute a clean
+    run to its dominant compute phase, shift ``bound_by`` to ``submit``
+    under a scripted 0.3 s upload delay (and only then), and the bench
+    ledger must flag a synthetically slowed row as ``regress`` on
+    exactly one metric (see ``docs/OBSERVABILITY.md`` §9);
+13. native C++ host library presence (optional — numpy fallback is fine);
+14. checkpoint write/read round trip in a temp dir.
 
 Exit code 0 when every mandatory check passes; each check prints
 ``ok``/``FAIL`` with a one-line detail, so CI and humans read the same
@@ -746,6 +757,136 @@ def main() -> int:
                 "1 flight bundle, edge-triggered)")
 
     ok &= _check("health-sentinel drill (SLO breach + flight dump)", sentinel)
+
+    def critical_path():
+        """Critical-path drill (docs/OBSERVABILITY.md §9), both ways: a
+        clean loopback async run (fit padded to ~30 ms so the round has a
+        real dominant phase) must NOT attribute its rounds to ``submit``;
+        the SAME run with every upload frame under a scripted 0.3 s delay
+        must shift every applied round's ``bound_by`` to ``submit``. Then
+        the ledger gate: three baseline rows plus one synthetically slowed
+        candidate must produce a ``regress`` verdict on exactly one
+        metric."""
+        import os
+
+        import numpy as np
+
+        from distriflow_tpu.client.abstract_client import DistributedClientConfig
+        from distriflow_tpu.client.async_client import AsynchronousSGDClient
+        from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+        from distriflow_tpu.data.dataset import DistributedDataset
+        from distriflow_tpu.obs import Telemetry
+        from distriflow_tpu.obs.dump import summarize_critical_path
+        from distriflow_tpu.obs.ledger import BenchLedger
+        from distriflow_tpu.obs.trace_assembler import assemble_dir
+        from distriflow_tpu.server.abstract_server import DistributedServerConfig
+        from distriflow_tpu.server.async_server import AsynchronousSGDServer
+        from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+        TinyModel = _tiny_model_cls()
+
+        class SlowFitModel(TinyModel):
+            # a measurable compute phase: without it every phase is
+            # sub-ms noise and "what bounds the round" is a coin flip
+            def fit(self, x, y):
+                time.sleep(0.03)
+                return super().fit(x, y)
+
+        def run_once(fault_plan, save_dir):
+            x = np.arange(8, dtype=np.float32).reshape(8, 1)
+            y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+            dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+            tel = Telemetry(save_dir=save_dir)  # spans.jsonl on disk
+            server = AsynchronousSGDServer(
+                DistributedServerInMemoryModel(SlowFitModel()),
+                dataset,
+                DistributedServerConfig(
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+                    telemetry=tel,
+                ),
+            )
+            server.setup()
+            client = AsynchronousSGDClient(
+                server.address, SlowFitModel(),
+                DistributedClientConfig(
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+                    upload_timeout_s=2.0, fault_plan=fault_plan,
+                    telemetry=tel,
+                ),
+            )
+            try:
+                client.setup(timeout=10.0)
+                client.train_until_complete(timeout=60.0)
+            finally:
+                client.dispose()
+                server.stop()
+            # assembled from DISK — the same path `obs.dump
+            # --critical-path` takes, so the drill covers the full
+            # emit -> jsonl -> assemble pipeline
+            return assemble_dir(save_dir), server.applied_updates, save_dir
+
+        with tempfile.TemporaryDirectory() as d:
+            base, applied, base_dir = run_once(None, os.path.join(d, "base"))
+            agg = base.attribution()
+            assert agg["applied"] == applied == 4, (
+                f"expected 4 applied rounds, assembled {agg['applied']} "
+                f"(server applied {applied})"
+            )
+            assert not base.orphans, (
+                f"{len(base.orphans)} orphan span(s) in a clean run"
+            )
+            assert agg["bound_by"] != "submit", (
+                f"clean run attributed to submit: {agg}"
+            )
+            baseline_bound = agg["bound_by"]
+            # the CLI rendering over the same run dir must survive too
+            lines = summarize_critical_path(base_dir)
+            assert any("bound_by" in ln for ln in lines), lines
+
+            plan = FaultPlan(seed=11, schedule=[
+                ScriptedFault(event="uploadVars", nth=n, action="delay",
+                              delay_s=0.3) for n in (1, 2, 3, 4)])
+            slow, applied, _ = run_once(plan, os.path.join(d, "slow"))
+            agg_slow = slow.attribution()
+            assert agg_slow["applied"] == applied == 4
+            assert agg_slow["bound_by"] == "submit", (
+                f"0.3 s submit delay did not shift attribution: {agg_slow}"
+            )
+            # per-round: allow ONE round to lose to a scheduler hiccup
+            # (a loopback event-loop stall shows up as an idle gap that
+            # can outweigh that round's 0.3 s submit segment); the
+            # aggregate above is the hard gate
+            assert agg_slow["bound_counts"].get("submit", 0) >= 3, (
+                f"delayed rounds not submit-bound: "
+                f"{agg_slow['bound_counts']}"
+            )
+
+            # ledger gate: 3 healthy rows, then one slowed candidate —
+            # regress on exactly one metric, and only for the slowed row
+            led = BenchLedger(os.path.join(d, "BENCH_LEDGER.jsonl"))
+            for i in range(3):
+                led.record("drill_async",
+                           {"value": 1000.0 + i, "round_ms": 50.0})
+            healthy = led.compare("drill_async",
+                                  {"value": 1001.0, "round_ms": 50.5})
+            assert healthy["verdict"] == "ok", healthy
+            slowed = led.compare("drill_async",
+                                 {"value": 600.0, "round_ms": 51.0})
+            assert slowed["verdict"] == "regress", slowed
+            n_regress = sum(1 for e in slowed["metrics"].values()
+                            if e["verdict"] == "regress")
+            assert n_regress == 1, (
+                f"expected regress on exactly 1 metric, got {n_regress}: "
+                f"{slowed['metrics']}"
+            )
+        submit_mean = agg_slow["phase_mean_ms"].get("submit", 0.0)
+        return (f"clean run bound_by={baseline_bound} (4 rounds, 0 "
+                f"orphans); 0.3 s scripted upload delay shifted all 4 "
+                f"rounds to submit ({submit_mean:.0f} ms/round); ledger: "
+                "healthy row ok, slowed row regressed exactly 1 metric")
+
+    ok &= _check("critical-path drill (submit-delay attribution + "
+                 "ledger gate)", critical_path)
 
     def native():
         from distriflow_tpu import native

@@ -27,6 +27,8 @@ from distriflow_tpu.obs.health import (
     SLOBand,
     default_bands,
 )
+from distriflow_tpu.obs.jax_hooks import install_jax_hooks
+from distriflow_tpu.obs.ledger import BenchLedger, band_for, lower_is_better
 from distriflow_tpu.obs.profiler import (
     NOOP_PHASE,
     NOOP_PROFILER,
@@ -45,6 +47,12 @@ from distriflow_tpu.obs.telemetry import (
     get_telemetry,
     set_telemetry,
 )
+from distriflow_tpu.obs.trace_assembler import (
+    Assembly,
+    Round,
+    assemble,
+    assemble_dir,
+)
 from distriflow_tpu.obs.tracing import (
     NOOP_SPAN,
     Span,
@@ -54,6 +62,8 @@ from distriflow_tpu.obs.tracing import (
 )
 
 __all__ = [
+    "Assembly",
+    "BenchLedger",
     "Counter",
     "FleetTable",
     "FlightRecorder",
@@ -67,12 +77,18 @@ __all__ = [
     "NOOP_PROFILER",
     "NOOP_SPAN",
     "PhaseProfiler",
+    "Round",
     "SLOBand",
     "Span",
     "Telemetry",
     "Tracer",
+    "assemble",
+    "assemble_dir",
+    "band_for",
     "default_bands",
     "get_telemetry",
+    "install_jax_hooks",
+    "lower_is_better",
     "new_span_id",
     "new_trace_id",
     "render_prometheus",

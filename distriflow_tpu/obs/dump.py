@@ -9,6 +9,15 @@ optional — missing files are reported, not fatal) and prints:
   and a server-side ``apply`` span (the cross-endpoint join wire tracing
   exists to provide), and how many upload spans recorded a reconnect.
 
+Malformed JSONL lines (a crashed run truncates its last line) are
+skipped and COUNTED, never fatal — each summary reports its skipped
+count.
+
+``--critical-path`` runs the trace assembler over ``spans.jsonl``
+instead: per-round tables (wall, bound_by, idle, top phases, gaps) plus
+the aggregate critical-path attribution — see ``docs/OBSERVABILITY.md``
+§9 for the taxonomy.
+
 ``--flight`` additionally summarizes the postmortem bundles the flight
 recorder wrote under ``<dir>/flight/`` (trigger, event counts, context —
 see ``docs/OBSERVABILITY.md``). ``--watch`` tails the run live instead:
@@ -28,7 +37,7 @@ import time
 from typing import Any, Dict, List
 
 from distriflow_tpu.obs.tracing import SPANS_FILENAME
-from distriflow_tpu.utils.metrics_log import read_metrics
+from distriflow_tpu.utils.metrics_log import read_metrics, read_metrics_counted
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -41,9 +50,17 @@ def _pctl(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def _rows_line(kind: str, path: str, rows: List[Any],
+               skipped: int) -> str:
+    line = f"{kind}: {len(rows)} rows ({path})"
+    if skipped:
+        line += f" [{skipped} malformed line(s) skipped]"
+    return line
+
+
 def summarize_metrics(path: str) -> List[str]:
-    rows = list(read_metrics(path))
-    lines = [f"metrics: {len(rows)} rows ({path})"]
+    rows, skipped = read_metrics_counted(path)
+    lines = [_rows_line("metrics", path, rows, skipped)]
     snaps = [r for r in rows if r.get("kind") == "telemetry_snapshot"]
     if snaps:
         last = snaps[-1]
@@ -55,8 +72,8 @@ def summarize_metrics(path: str) -> List[str]:
 
 
 def summarize_spans(path: str) -> List[str]:
-    rows = list(read_metrics(path))  # same torn-tail-safe JSONL reader
-    lines = [f"spans: {len(rows)} rows ({path})"]
+    rows, skipped = read_metrics_counted(path)
+    lines = [_rows_line("spans", path, rows, skipped)]
 
     by_name: Dict[str, List[Dict[str, Any]]] = {}
     for r in rows:
@@ -115,6 +132,18 @@ def summarize_flight(run_dir: str) -> List[str]:
     return lines
 
 
+def summarize_critical_path(run_dir: str, max_rounds: int = 20) -> List[str]:
+    """Assemble ``spans.jsonl`` into rounds and render the attribution."""
+    from distriflow_tpu.obs.trace_assembler import assemble_dir, render
+
+    spans_path = os.path.join(run_dir, SPANS_FILENAME)
+    if not os.path.exists(spans_path):
+        return [f"(no {SPANS_FILENAME} in {run_dir} — nothing to assemble)"]
+    assembly = assemble_dir(run_dir)
+    return [f"critical path ({spans_path}):"] + render(
+        assembly, max_rounds=max_rounds)
+
+
 def watch(run_dir: str, interval: float, iterations: int) -> int:
     """Live mode: poll the latest snapshot row and print counter/gauge
     movement between polls. Returns 0 once a metrics file was seen."""
@@ -163,6 +192,13 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("run_dir", help="directory holding the JSONL files")
     parser.add_argument("--flight", action="store_true",
                         help="also summarize flight-recorder bundles")
+    parser.add_argument("--critical-path", action="store_true",
+                        help="assemble spans.jsonl into rounds and print "
+                             "per-round + aggregate critical-path "
+                             "attribution")
+    parser.add_argument("--max-rounds", type=int, default=20,
+                        help="cap per-round lines in --critical-path "
+                             "output (default 20)")
     parser.add_argument("--watch", action="store_true",
                         help="poll the latest snapshot and print deltas")
     parser.add_argument("--interval", type=float, default=2.0,
@@ -173,6 +209,12 @@ def main(argv: List[str] = None) -> int:
 
     if args.watch:
         return watch(args.run_dir, args.interval, args.iterations)
+
+    if args.critical_path:
+        spans_path = os.path.join(args.run_dir, SPANS_FILENAME)
+        print("\n".join(summarize_critical_path(
+            args.run_dir, max_rounds=args.max_rounds)))
+        return 0 if os.path.exists(spans_path) else 2
 
     metrics_path = os.path.join(args.run_dir, METRICS_FILENAME)
     spans_path = os.path.join(args.run_dir, SPANS_FILENAME)

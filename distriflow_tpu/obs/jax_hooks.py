@@ -1,0 +1,93 @@
+"""JAX-runtime hooks: recompile counting + device-memory watermark.
+
+The phase profiler times what our code does; it cannot see the two
+silent perf killers inside the runtime — shape churn (every new input
+shape recompiles the jit cache, turning a 5 ms step into a 500 ms one)
+and HBM creep (fragmentation/leaks that only show as a late OOM). This
+module surfaces both through the normal telemetry registry:
+
+- ``jit_recompiles_total`` — bumped from a ``jax.monitoring`` duration
+  listener on ``/jax/core/compile/backend_compile_duration``, which
+  fires per backend compile and NOT on executable-cache hits, so a
+  steady-state loop holds the counter flat and any drift means churn.
+- ``device_peak_bytes`` (gauge, labelled by device) — high-water
+  ``peak_bytes_in_use`` from ``device.memory_stats()``, refreshed by a
+  snapshot-time sampler (CPU backends report no stats; the gauge is
+  simply absent there).
+
+``install_jax_hooks`` is idempotent per telemetry object and safe
+without jax: everything is guarded, a missing API degrades to a no-op.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+#: monitoring event that fires once per actual backend compile (and not
+#: on compile-cache hits) — the recompile signal.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_INSTALLED_ATTR = "_jax_hooks_installed"
+
+
+def _sample_device_memory(telemetry: Any) -> None:
+    """Refresh per-device peak-memory gauges (no-op when the backend
+    reports no stats, e.g. CPU)."""
+    try:
+        import jax
+        devices = jax.devices()
+    except Exception:
+        return
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        if peak is None:
+            continue
+        label = f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', 0)}"
+        telemetry.gauge("device_peak_bytes", device=label).set(float(peak))
+
+
+def install_jax_hooks(telemetry: Optional[Any] = None) -> bool:
+    """Wire the recompile counter and memory sampler into ``telemetry``
+    (the process-global one by default). Idempotent per telemetry
+    object; returns True when the hooks are (already) installed.
+
+    jax's listener registry is append-only process-global state, so the
+    listener resolves the counter lazily from the telemetry it was
+    installed for — a later ``set_telemetry`` swap needs a fresh
+    ``install_jax_hooks`` call, matching how profilers bind.
+    """
+    if telemetry is None:
+        from distriflow_tpu.obs.telemetry import get_telemetry
+        telemetry = get_telemetry()
+    if not getattr(telemetry, "enabled", False):
+        return False
+    if getattr(telemetry, _INSTALLED_ATTR, False):
+        return True
+    try:
+        import jax.monitoring as monitoring
+    except Exception:
+        return False
+
+    counter = telemetry.counter("jit_recompiles_total")
+
+    def _on_duration(event: str, duration_secs: float, **kwargs: Any) -> None:
+        if event == _COMPILE_EVENT:
+            counter.inc()
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    try:
+        telemetry.register_sampler(
+            lambda: _sample_device_memory(telemetry))
+    except AttributeError:
+        pass
+    setattr(telemetry, _INSTALLED_ATTR, True)
+    return True

@@ -44,6 +44,7 @@ class Telemetry:
         self._profilers_lock = threading.Lock()
         self._flight = None
         self._fleet_providers: Dict[Any, Any] = {}
+        self._samplers: list = []
 
     # -- handle factories (delegate to the registry) -----------------------
 
@@ -103,6 +104,15 @@ class Telemetry:
     def unregister_fleet(self, key: Any) -> None:
         self._fleet_providers.pop(key, None)
 
+    def register_sampler(self, fn) -> None:
+        """Attach a zero-arg callable run at the top of every
+        ``snapshot()`` to refresh pull-style gauges (device memory
+        watermarks, queue depths read from foreign objects). Sampler
+        errors are swallowed — a dead device must not break a snapshot.
+        No-op when disabled."""
+        if self.enabled:
+            self._samplers.append(fn)
+
     # -- read side ---------------------------------------------------------
 
     def counter_value(self, name: str, **labels: Any) -> float:
@@ -116,6 +126,11 @@ class Telemetry:
         registered, plus a ``"fleet"`` key (per-connection health rows)
         when a server has registered its table — absent otherwise, so
         the disabled-telemetry empty-snapshot contract is unchanged."""
+        for sampler in list(self._samplers):
+            try:
+                sampler()
+            except Exception:
+                pass  # pull-gauge refresh must never break a snapshot
         snap = self.registry.snapshot()
         if self._fleet_providers:
             fleet: Dict[str, Any] = {}

@@ -10,11 +10,21 @@ can never show. A child span carries ``parent_id`` = the upstream span's
 ``span_id``.
 
 Span row schema (JSONL, one object per line, written next to
-``metrics.jsonl``)::
+``metrics.jsonl``; pinned by the golden-row test in
+``tests/test_trace_assembler.py``)::
 
     {"name": "upload", "trace_id": "…32 hex…", "span_id": "…16 hex…",
-     "parent_id": "…16 hex…" | null, "start": <unix s>, "dur_ms": <float>,
+     "parent_id": "…16 hex…" | null, "start": <unix s>, "mono": <monotonic s>,
+     "pid": <int>, "dur_ms": <float>,
      "status": "ok" | "error:<Type>", ...free-form attributes}
+
+Two clock anchors ride every row: ``start`` is an epoch wall stamp (the
+only clock that means anything ACROSS processes) and ``mono`` is the
+process-monotonic stamp the duration was measured against (immune to
+wall-clock steps WITHIN a process). The trace assembler
+(``obs/trace_assembler.py``) orders same-``pid`` rows by ``mono`` and
+aligns clock domains via the median wall-minus-mono offset, so one NTP
+step mid-run cannot shuffle a round's timeline.
 
 Retries do NOT open new traces: the client stamps ``trace_id`` once per
 update (alongside ``update_id``), so a duplicate delivery dedup'd by the
@@ -53,7 +63,7 @@ class Span:
     """Mutable in-flight span; finished by the ``Tracer.span`` context."""
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
-                 "attrs", "status")
+                 "mono", "attrs", "status")
 
     def __init__(self, name: str, trace_id: Optional[str],
                  parent_id: Optional[str], attrs: Dict[str, Any]):
@@ -62,11 +72,22 @@ class Span:
         self.span_id = new_span_id()
         self.parent_id = parent_id
         self.start = time.time()
+        self.mono = time.monotonic()
         self.attrs = attrs
         self.status = "ok"
 
     def set(self, **attrs: Any) -> None:
         self.attrs.update(attrs)
+
+    def adopt(self, trace_id: Optional[str],
+              parent_id: Optional[str] = None) -> None:
+        """Late-join an existing trace — for spans whose linkage is only
+        known after they open (e.g. the server's decode span learns the
+        message's trace_id by decoding it)."""
+        if trace_id:
+            self.trace_id = trace_id
+        if parent_id:
+            self.parent_id = parent_id
 
     def to_row(self, dur_ms: float) -> Dict[str, Any]:
         row = {
@@ -75,6 +96,8 @@ class Span:
             "span_id": self.span_id,
             "parent_id": self.parent_id,
             "start": self.start,
+            "mono": self.mono,
+            "pid": os.getpid(),
             "dur_ms": dur_ms,
             "status": self.status,
         }
@@ -97,6 +120,10 @@ class _NoopSpan:
     def set(self, **attrs: Any) -> None:
         pass
 
+    def adopt(self, trace_id: Optional[str],
+              parent_id: Optional[str] = None) -> None:
+        pass
+
 
 NOOP_SPAN = _NoopSpan()
 
@@ -109,6 +136,7 @@ class Tracer:
         self.enabled = bool(enabled)
         self._spans: collections.deque = collections.deque(maxlen=max_spans)
         self._lock = threading.Lock()
+        self._tls = threading.local()  # per-thread open-span stack
         self._logger = None
         if self.enabled and save_dir is not None:
             # Deferred import: obs must stay importable without utils and
@@ -132,6 +160,10 @@ class Tracer:
             yield NOOP_SPAN
             return
         s = Span(name, trace_id, parent_id, attrs)
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(s)
         t0 = time.perf_counter()
         try:
             yield s
@@ -139,14 +171,42 @@ class Tracer:
             s.status = f"error:{type(e).__name__}"
             raise
         finally:
+            stack.pop()
             self._finish(s, (time.perf_counter() - t0) * 1000.0)
 
-    def _finish(self, s: Span, dur_ms: float) -> None:
+    def current(self) -> Any:
+        """The innermost span open on THIS thread (``NOOP_SPAN`` when none
+        or disabled) — lets deep code (a quarantine gate three calls below
+        the apply span) enrich the round's span without threading it
+        through every signature."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else NOOP_SPAN
+
+    def emit(self, name: str, trace_id: Optional[str] = None,
+             parent_id: Optional[str] = None, dur_ms: float = 0.0,
+             start: Optional[float] = None, mono: Optional[float] = None,
+             **attrs: Any) -> Optional[Dict[str, Any]]:
+        """Record an externally timed span in one shot (no context
+        manager) — the async trainer's ``_phase`` accounting measures its
+        own durations and publishes them here so the trace rows can never
+        drift from the ``phase_ms`` digests. ``start``/``mono`` override
+        the anchors to the phase's true begin; returns the appended row."""
+        if not self.enabled:
+            return None
+        s = Span(name, trace_id, parent_id, attrs)
+        if start is not None:
+            s.start = float(start)
+        if mono is not None:
+            s.mono = float(mono)
+        return self._finish(s, float(dur_ms))
+
+    def _finish(self, s: Span, dur_ms: float) -> Dict[str, Any]:
         row = s.to_row(dur_ms)
         with self._lock:
             self._spans.append(row)
         if self._logger is not None:
             self._logger.log(**row)
+        return row
 
     def finished(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
         """Finished-span rows (optionally filtered by span name)."""

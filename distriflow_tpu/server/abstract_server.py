@@ -19,6 +19,7 @@ import concurrent.futures
 import dataclasses
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -423,8 +424,18 @@ class AbstractServer:
         # apply pipelined, busy is the decode and idle the queue + future
         # wait — the overlap the pipeline exists to create shows up here
         with self._prof.step():
+            t0_wall, t0_mono = time.time(), time.monotonic()
             with self._prof.phase("decode"):
                 msg = UploadMsg.from_wire(payload)
+            if msg.trace_id:
+                # the decode leg only learns its trace BY decoding, so it is
+                # emitted after the fact (legacy traceless clients get no
+                # span — a fresh trace here would assemble as a ghost round)
+                self.telemetry.tracer.emit(
+                    "decode", trace_id=msg.trace_id, parent_id=msg.span_id,
+                    dur_ms=(time.monotonic() - t0_mono) * 1e3,
+                    start=t0_wall, mono=t0_mono,
+                    **self._apply_span_attrs(msg, client_id=True))
             self._c_uploads.inc()
             nbytes = 0
             if msg.gradients is not None:
@@ -442,7 +453,10 @@ class AbstractServer:
             if q is None:
                 return self._process_upload(client_id, msg)
             fut: "concurrent.futures.Future[Any]" = concurrent.futures.Future()
-            q.put((client_id, msg, fut))
+            # queue depth AT ENQUEUE rides to the apply span: it is the
+            # backpressure signal at the moment this update joined the line
+            depth = q.qsize()
+            q.put((client_id, msg, fut, depth))
             self._g_apply_queue.set(q.qsize())
             return fut.result()
 
@@ -462,15 +476,34 @@ class AbstractServer:
                 continue
             if item is None:
                 return
-            client_id, msg, fut = item
+            client_id, msg, fut = item[:3]
+            depth = item[3] if len(item) > 3 else 0
             try:
-                fut.set_result(self._process_upload(client_id, msg))
+                fut.set_result(self._process_upload(client_id, msg,
+                                                    queue_depth=depth))
             except BaseException as exc:  # noqa: BLE001 - relayed to the ack
                 fut.set_exception(exc)
             finally:
                 self._g_apply_queue.set(q.qsize())
 
-    def _process_upload(self, client_id: str, msg: UploadMsg) -> Any:
+    def _apply_span_attrs(self, msg: UploadMsg, queue_depth: int = None,
+                          client_id: bool = False) -> Dict[str, Any]:
+        """The assembler's join keys, added only when known — a ``None``
+        attr would be dropped by the JSONL writer but kept in the
+        in-memory deque, and the two views must stay identical."""
+        attrs: Dict[str, Any] = {}
+        if client_id:
+            attrs["client_id"] = msg.client_id
+        if queue_depth is not None:
+            attrs["queue_depth"] = queue_depth
+        if msg.update_id is not None:
+            attrs["update_id"] = msg.update_id
+        if msg.gradients is not None and msg.gradients.version is not None:
+            attrs["model_version"] = msg.gradients.version
+        return attrs
+
+    def _process_upload(self, client_id: str, msg: UploadMsg,
+                        queue_depth: int = 0) -> Any:
         """Dedup by ``update_id``, then apply.
 
         A retried upload (client resent after an ambiguous ack timeout) or a
@@ -484,10 +517,12 @@ class AbstractServer:
         if uid is None:  # legacy client: no dedup possible
             with self.telemetry.span(
                 "apply", trace_id=msg.trace_id, parent_id=msg.span_id,
-                client_id=msg.client_id,
-            ), self._prof.phase("apply"):
+                **self._apply_span_attrs(msg, queue_depth, client_id=True),
+            ) as span, self._prof.phase("apply"):
                 self.callbacks.fire("upload", msg)
-                return self.handle_upload(client_id, msg)
+                result = self.handle_upload(client_id, msg)
+                span.set(accepted=bool(result))
+                return result
         while True:
             with self._dedup_lock:
                 if uid in self._applied_ids:
@@ -501,7 +536,9 @@ class AbstractServer:
                     # shows every delivery of the update — applied or not
                     with self.telemetry.span(
                         "apply", trace_id=msg.trace_id, parent_id=msg.span_id,
-                        client_id=msg.client_id, update_id=uid, dedup=True,
+                        dedup=True, accepted=False,
+                        **self._apply_span_attrs(msg, queue_depth,
+                                                 client_id=True),
                     ):
                         pass
                     return result
@@ -516,7 +553,8 @@ class AbstractServer:
         try:
             with self.telemetry.span(
                 "apply", trace_id=msg.trace_id, parent_id=msg.span_id,
-                client_id=msg.client_id, update_id=uid, dedup=False,
+                dedup=False,
+                **self._apply_span_attrs(msg, queue_depth, client_id=True),
             ) as span, self._prof.phase("apply"):
                 self.callbacks.fire("upload", msg)
                 result = self.handle_upload(client_id, msg)

@@ -281,9 +281,16 @@ class AsynchronousSGDServer(AbstractServer):
             staleness = self.version_counter - sent_version
             self._h_staleness.observe(staleness)
             self.fleet.note_staleness(client_id, staleness)
+            # the enclosing apply span (opened by _process_upload on this
+            # thread) is the round's server leg: every exit path below names
+            # its verdict on it so the trace assembler can tell an applied
+            # round from a rejected one without the counters
+            apply_span = self.telemetry.tracer.current()
+            apply_span.set(staleness=staleness)
             if staleness > self.hyperparams.maximum_staleness:
                 self.rejected_updates += 1
                 self._c_rejected.inc()
+                apply_span.set(verdict="stale")
                 self.log(
                     f"rejected update from {msg.client_id}: staleness {staleness} > "
                     f"{self.hyperparams.maximum_staleness}"
@@ -303,11 +310,17 @@ class AsynchronousSGDServer(AbstractServer):
             # quarantine gate: a non-finite or norm-outlier gradient is
             # rejected BEFORE it can touch the canonical model, and its
             # payload is dumped for postmortem (docs/ROBUSTNESS.md §8)
+            t_gate = time.perf_counter()
             with self._prof.phase("quarantine"):
                 verdict = self.gate.check(grads)
+            # how long the gate held the apply: the assembler carves this
+            # head slice of the apply span into its own "quarantine" phase
+            apply_span.set(
+                quarantine_ms=(time.perf_counter() - t_gate) * 1e3)
             if not verdict.ok:
                 self.rejected_updates += 1
                 self._c_rejected.inc()
+                apply_span.set(verdict="quarantined")
                 self.fleet.note_quarantine(client_id)
                 self.log(f"quarantined update from {msg.client_id}: {verdict.reason}")
                 self.gate.quarantine(
@@ -337,6 +350,7 @@ class AsynchronousSGDServer(AbstractServer):
                     self.model.set_params(prev)
                     self.rejected_updates += 1
                     self._c_rejected.inc()
+                    apply_span.set(verdict="rollback")
                     self.gate.record_rollback()
                     self.fleet.note_quarantine(client_id)
                     self.log(f"rolled back update from {msg.client_id}: "
@@ -365,6 +379,7 @@ class AsynchronousSGDServer(AbstractServer):
                 self._g_version.set(self.version_counter)
                 self.download_msg = self.compute_download_msg()
                 self._note_version_token()
+                apply_span.set(verdict="applied")
         self.callbacks.fire("new_version", self.model.version)
         return True
 

@@ -13,6 +13,7 @@ staleness-0 drop is the default config, preserving reference behavior.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 
@@ -54,7 +55,12 @@ class FederatedServer(AbstractServer):
         what makes client reconnect-across-server-restart safe: the stale
         work is refused, the client gets a clean ``False`` ack, and its next
         round trains against the fresh weights."""
+        # the enclosing apply span (opened by _process_upload on this
+        # thread): every drop below names its verdict so the assembler can
+        # attribute rejected rounds without re-deriving the drop rules
+        apply_span = self.telemetry.tracer.current()
         if msg.gradients is None:
+            apply_span.set(verdict="malformed")
             return False
         with self._lock:
             try:
@@ -62,15 +68,19 @@ class FederatedServer(AbstractServer):
             except ValueError:
                 self.log(f"dropping upload with unknown version {msg.gradients.version!r}")
                 self.dropped_uploads += 1
+                apply_span.set(verdict="unknown_version")
                 # version-token mismatch (e.g. pre-restart gradient): the
                 # connection's delta base is equally untrustworthy — its
                 # next broadcast must be a full sync
                 with self._delta_lock:
                     self._client_bases.pop(client_id, None)
                 return False
+            apply_span.set(staleness=staleness)
             if staleness > self.hyperparams.maximum_staleness or self.updating:
                 # reference drop rule :73 (exact-version + !updating), generalized
                 self.dropped_uploads += 1
+                apply_span.set(
+                    verdict="updating" if self.updating else "stale")
                 return False
             decay = self.hyperparams.staleness_decay**staleness
             vars_ = msg.gradients.vars
@@ -81,17 +91,22 @@ class FederatedServer(AbstractServer):
             if not self._well_formed(vars_):
                 self.log(f"dropping malformed upload from {msg.client_id}")
                 self.dropped_uploads += 1
+                apply_span.set(verdict="malformed")
                 return False
             # quarantine gate at receipt: one NaN (or exploding) contribution
             # buffered now would poison the whole aggregated round later —
             # reject it alone, dump the payload for postmortem
             if self.gate.active:
+                t_gate = time.perf_counter()
                 with self._prof.phase("quarantine"):
                     verdict = self.gate.check(
                         {k: deserialize_array(s) for k, s in vars_.items()}
                     )
+                apply_span.set(
+                    quarantine_ms=(time.perf_counter() - t_gate) * 1e3)
                 if not verdict.ok:
                     self.dropped_uploads += 1
+                    apply_span.set(verdict="quarantined")
                     self.fleet.note_quarantine(client_id)
                     self.log(f"quarantined upload from {msg.client_id}: "
                              f"{verdict.reason}")
@@ -114,6 +129,7 @@ class FederatedServer(AbstractServer):
             self.updates.append(vars_)
             self._update_decays.append(decay)
             self.num_updates += 1
+            apply_span.set(verdict="buffered")
             should_aggregate = len(self.updates) >= self.hyperparams.min_updates_per_version
             if should_aggregate:
                 self.updating = True

@@ -37,6 +37,7 @@ import optax
 from distriflow_tpu.data.dataset import DistributedDataset
 from distriflow_tpu.models.base import ModelSpec, _optimizer, init_params
 from distriflow_tpu.obs.telemetry import get_telemetry
+from distriflow_tpu.obs.tracing import new_trace_id
 from distriflow_tpu.utils.config import ServerHyperparams, async_server_hyperparams
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 
@@ -98,6 +99,12 @@ class AsyncSGDTrainer:
         # each pull->fit->submit span with a step() so wall-vs-busy yields
         # the overlap/idle attribution bench.py reports
         self._prof = _t.profiler("trainer")
+        self._tracer = _t.tracer
+        # per-worker-thread round context: when a worker_loop round is open
+        # its (trace_id, root span_id, t0s) live here so _phase() can emit
+        # trace rows from the SAME dt it books into phase_ms — the assembler
+        # and the profiler can never disagree about a trainer round
+        self._round_tls = threading.local()
 
         # SSP-style admission control (round-4, verdict #3): bounded
         # staleness by CONSTRUCTION instead of by discard. Two pieces:
@@ -322,6 +329,14 @@ class AsyncSGDTrainer:
         with self._phase_lock:
             self.phase_ms[name] += dt
         self._prof.record(name, dt)
+        ctx = getattr(self._round_tls, "ctx", None)
+        if ctx is not None:
+            # child span of the open round, anchored at the phase's true
+            # begin (now - dt in both clock domains)
+            self._tracer.emit(
+                name, trace_id=ctx[0], parent_id=ctx[1], dur_ms=dt,
+                start=time.time() - dt / 1e3,
+                mono=time.monotonic() - dt / 1e3)
         return time.perf_counter()
 
     # -- lifecycle ---------------------------------------------------------
@@ -443,60 +458,82 @@ class AsyncSGDTrainer:
             # phase time, which is exactly the idle attribution we want
             with self._prof.step():
                 t0 = time.perf_counter()
+                t0_wall, t0_mono = time.time(), time.monotonic()
                 group = self._take_batches(budget, device)
                 if not group:
                     if self.dataset.exhausted:
                         break
                     continue  # starved; re-check
-                if self.stage_dataset:
-                    # device-resident: no transfer
-                    t0 = self._phase("stage", t0)
-                else:
-                    staged = [g[1] for g in group] + [g[2] for g in group]
-                    t0 = self._phase("stage", t0, *staged)
-                ticket = None
+                # one trace per round: while the context is open, _phase()
+                # emits each booked duration as a child span; the "round"
+                # root lands when the step closes, so spans.jsonl carries
+                # the same wall/phase decomposition the profiler digests
+                tid = new_trace_id() if self._tracer.enabled else None
+                if tid is not None:
+                    self._round_tls.ctx = (tid, None)
+                round_ok = False
                 try:
-                    if self.admission_control:
-                        # SSP span: window slot + submit-order ticket (ctor
-                        # comment) — the wait replaces what used to be
-                        # discarded compute
-                        ticket, params, version = self._admit()
-                        t0 = self._phase("admission_wait", t0)
-                    else:
-                        params, version = self.snapshot()
-                    local_params = jax.device_put(params, device)
-                    t0 = self._phase("snapshot", t0, local_params)
                     if self.stage_dataset:
-                        grads = self._staged_fit(local_params, group, device)
+                        # device-resident: no transfer
+                        t0 = self._phase("stage", t0)
                     else:
-                        grads = self._host_fit(local_params, group)
-                    t0 = self._phase("fit", t0, grads)
-                    if ticket is not None:
-                        # ordering wait books under admission_wait, NOT
-                        # submit: with heterogeneous workers the FIFO wait
-                        # can dominate and the phase breakdown must localize
-                        # it correctly
-                        self._await_turn(ticket)
-                        t0 = self._phase("admission_wait", t0)
-                    self.submit(grads, version,
-                                client_id=f"worker-{worker_index}")
-                    self._phase("submit", t0,
-                                self.params if self.profile_phases else ())
-                except BaseException:
-                    # failure recovery: return the batches to the queue so
-                    # another worker picks them up (the redelivery role of
-                    # reference dataset.ts:56-60, triggered by failure here)
+                        staged = [g[1] for g in group] + [g[2] for g in group]
+                        t0 = self._phase("stage", t0, *staged)
+                    ticket = None
+                    try:
+                        if self.admission_control:
+                            # SSP span: window slot + submit-order ticket (ctor
+                            # comment) — the wait replaces what used to be
+                            # discarded compute
+                            ticket, params, version = self._admit()
+                            t0 = self._phase("admission_wait", t0)
+                        else:
+                            params, version = self.snapshot()
+                        local_params = jax.device_put(params, device)
+                        t0 = self._phase("snapshot", t0, local_params)
+                        if self.stage_dataset:
+                            grads = self._staged_fit(local_params, group,
+                                                     device)
+                        else:
+                            grads = self._host_fit(local_params, group)
+                        t0 = self._phase("fit", t0, grads)
+                        if ticket is not None:
+                            # ordering wait books under admission_wait, NOT
+                            # submit: with heterogeneous workers the FIFO wait
+                            # can dominate and the phase breakdown must
+                            # localize it correctly
+                            self._await_turn(ticket)
+                            t0 = self._phase("admission_wait", t0)
+                        self.submit(grads, version,
+                                    client_id=f"worker-{worker_index}")
+                        self._phase("submit", t0,
+                                    self.params if self.profile_phases else ())
+                    except BaseException:
+                        # failure recovery: return the batches to the queue so
+                        # another worker picks them up (the redelivery role of
+                        # reference dataset.ts:56-60, triggered by failure
+                        # here)
+                        for b, _, _ in group:
+                            self.dataset.requeue(b.batch)
+                        raise
+                    finally:
+                        if ticket is not None:
+                            self._close_span(ticket)
+                    # ack regardless of staleness-acceptance: the batches were
+                    # consumed (reference acks before applying,
+                    # asynchronousSGD_server.ts:66-72)
                     for b, _, _ in group:
-                        self.dataset.requeue(b.batch)
-                    raise
+                        self.dataset.complete_batch(b.batch)
+                    round_ok = True
                 finally:
-                    if ticket is not None:
-                        self._close_span(ticket)
-                # ack regardless of staleness-acceptance: the batches were
-                # consumed (reference acks before applying,
-                # asynchronousSGD_server.ts:66-72)
-                for b, _, _ in group:
-                    self.dataset.complete_batch(b.batch)
+                    if tid is not None:
+                        self._round_tls.ctx = None
+                        self._tracer.emit(
+                            "round", trace_id=tid,
+                            dur_ms=(time.monotonic() - t0_mono) * 1e3,
+                            start=t0_wall, mono=t0_mono, role="trainer",
+                            worker=worker_index,
+                            status="ok" if round_ok else "error")
                 steps += len(group)
         return steps
 

@@ -21,6 +21,7 @@ thing.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -36,6 +37,7 @@ from distriflow_tpu.models.base import ModelSpec, _optimizer, init_params
 from distriflow_tpu.parallel.collectives import pvary
 from distriflow_tpu.parallel.mesh import data_parallel_mesh
 from distriflow_tpu.obs.telemetry import get_telemetry
+from distriflow_tpu.obs.tracing import new_trace_id
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 from distriflow_tpu.utils.profiling import device_timer
 
@@ -76,7 +78,14 @@ class FederatedAveragingTrainer:
         self.round_index = 0
         self.num_workers = self.mesh.shape["data"]
         self._round_fn = self._build_round()
-        self._h_round = get_telemetry().histogram("train_step_ms", mode="federated")
+        _t = get_telemetry()
+        self._h_round = _t.histogram("train_step_ms", mode="federated")
+        # phase profiler + per-round trace (docs/OBSERVABILITY.md §5/§9):
+        # a fedavg round decomposes into stage (host->device placement) and
+        # fit (the jitted K-local-steps + allreduce), so bench rows can name
+        # what bounds a round the same way the async trainer's do
+        self._prof = _t.profiler("fedavg")
+        self._tracer = _t.tracer
 
     def init(self, rng: Optional[jax.Array] = None) -> Params:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -135,12 +144,34 @@ class FederatedAveragingTrainer:
                 f"round data must be [workers={w}, local_steps={k}, batch={b}, ...]; "
                 f"got {tuple(x.shape[:3])}"
             )
-        x = jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P("data")))
-        y = jax.device_put(jnp.asarray(y), NamedSharding(self.mesh, P("data")))
-        with device_timer() as timing:
-            self.params, loss = self._round_fn(self.params, x, y)
-            loss = float(loss)  # blocks: the round (and its allreduce) finished
+        tid = new_trace_id() if self._tracer.enabled else None
+        t0_wall, t0_mono = time.time(), time.monotonic()
+        with self._prof.step():
+            t_stage = time.perf_counter()
+            with self._prof.phase("stage"):
+                x = jax.device_put(jnp.asarray(x),
+                                   NamedSharding(self.mesh, P("data")))
+                y = jax.device_put(jnp.asarray(y),
+                                   NamedSharding(self.mesh, P("data")))
+                jax.block_until_ready((x, y))
+            stage_ms = (time.perf_counter() - t_stage) * 1e3
+            with device_timer() as timing, self._prof.phase("fit"):
+                self.params, loss = self._round_fn(self.params, x, y)
+                loss = float(loss)  # blocks: the round (and its allreduce) finished
         self._h_round.observe(timing["ms"])
+        if tid is not None:
+            # same decomposition as the profiler step, as one trace: a
+            # "round" root plus stage/fit children (bench's bound_by column
+            # assembles these)
+            self._tracer.emit("stage", trace_id=tid, dur_ms=stage_ms,
+                              start=t0_wall, mono=t0_mono)
+            self._tracer.emit("fit", trace_id=tid, dur_ms=timing["ms"],
+                              start=t0_wall + stage_ms / 1e3,
+                              mono=t0_mono + stage_ms / 1e3)
+            self._tracer.emit(
+                "round", trace_id=tid,
+                dur_ms=(time.monotonic() - t0_mono) * 1e3,
+                start=t0_wall, mono=t0_mono, role="fedavg")
         self.round_index += 1
         if (self.store is not None and self.save_every
                 and self.round_index % self.save_every == 0):

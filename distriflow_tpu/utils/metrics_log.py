@@ -89,3 +89,23 @@ def read_metrics(path: str) -> Iterator[Dict[str, Any]]:
                 yield json.loads(line)
             except json.JSONDecodeError:
                 continue  # torn tail from a crash mid-append
+
+
+def read_metrics_counted(path: str) -> "tuple[list, int]":
+    """``(rows, skipped)`` — like :func:`read_metrics` but COUNTS the
+    malformed lines instead of silently dropping them, so offline tooling
+    (``obs.dump``) can tell "clean file" from "crashed run with a torn
+    tail" (or worse, a corrupted middle). Only non-empty undecodable
+    lines count as skipped."""
+    rows = []
+    skipped = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                skipped += 1
+    return rows, skipped

@@ -1,0 +1,335 @@
+"""Round-trip trace assembly (docs/OBSERVABILITY.md §9).
+
+Pins, in order: the on-disk span row schema (the golden row — every
+cross-process consumer parses this), the critical-path sweep semantics
+(carving, gaps, priorities, skew alignment, update-id merging), and the
+chaos contract: duplicates, retries, and reconnect redeliveries must
+assemble into exactly ONE critical path per applied update with zero
+orphan spans.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.client.abstract_client import DistributedClientConfig
+from distriflow_tpu.client.async_client import AsynchronousSGDClient
+from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.obs import Telemetry
+from distriflow_tpu.obs.trace_assembler import (
+    assemble,
+    assemble_dir,
+    render,
+)
+from distriflow_tpu.obs.tracing import SPANS_FILENAME
+from distriflow_tpu.server.abstract_server import DistributedServerConfig
+from distriflow_tpu.server.async_server import AsynchronousSGDServer
+from distriflow_tpu.server.models import DistributedServerInMemoryModel
+from distriflow_tpu.utils.config import RetryPolicy
+from tests.mock_model import MockModel
+
+pytestmark = pytest.mark.obs
+
+
+# -- golden row: the pinned spans.jsonl schema ------------------------------
+
+#: every consumer (assembler, dump CLI, offline tooling) parses exactly
+#: these keys; changing any of them is a cross-process format break.
+GOLDEN_KEYS = {"name", "trace_id", "span_id", "parent_id", "start", "mono",
+               "pid", "dur_ms", "status"}
+
+
+def test_span_row_golden_schema(tmp_path):
+    tel = Telemetry(save_dir=str(tmp_path))
+    with tel.tracer.span("dispatch") as root:
+        with tel.tracer.span("upload", trace_id=root.trace_id,
+                             parent_id=root.span_id, client_id="c1"):
+            time.sleep(0.001)
+    path = tmp_path / SPANS_FILENAME
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(rows) == 2
+    child, root_row = rows  # finish order: inner first
+
+    assert GOLDEN_KEYS <= set(child)
+    assert child["name"] == "upload"
+    assert len(child["trace_id"]) == 32
+    assert len(child["span_id"]) == 16
+    assert child["parent_id"] == root_row["span_id"]
+    assert child["trace_id"] == root_row["trace_id"]
+    # two clock anchors: epoch wall (cross-process) + monotonic (in-process)
+    assert abs(child["start"] - time.time()) < 60.0
+    assert isinstance(child["mono"], float)
+    assert child["pid"] == os.getpid()
+    assert child["dur_ms"] >= 1.0
+    assert child["status"] == "ok"
+    assert child["client_id"] == "c1"  # attrs ride flat on the row
+
+    # a root's parent_id is None, and the writer drops None values — its
+    # absence from the row IS the pinned encoding
+    assert GOLDEN_KEYS - {"parent_id"} <= set(root_row)
+    assert "parent_id" not in root_row
+
+
+def test_error_status_pinned(tmp_path):
+    tel = Telemetry(save_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        with tel.tracer.span("upload"):
+            raise ValueError("boom")
+    (row,) = [json.loads(line)
+              for line in (tmp_path / SPANS_FILENAME).read_text().splitlines()]
+    assert row["status"] == "error:ValueError"
+
+
+# -- sweep semantics over synthetic rounds ----------------------------------
+
+
+def _row(name, t0, dur_ms, trace_id="t" * 32, offset=500.0, **attrs):
+    """Synthetic span row: wall = mono + offset (one clock domain)."""
+    return {"name": name, "trace_id": trace_id, "span_id": f"s-{name}-{t0}",
+            "parent_id": None, "start": t0 + offset, "mono": t0, "pid": 1,
+            "dur_ms": dur_ms, "status": "ok", **attrs}
+
+
+def test_wire_round_carving():
+    """Server work carves its slice out of the client's submit window;
+    the quarantine gate carves out of apply; uncovered time is labelled
+    idle gaps; a dedup'd duplicate delivery adds no segments."""
+    upload = _row("upload", 0.16, 350.0, serialize_ms=10.0, attempts=2,
+                  ack_wait_ms=200.0, update_id="u1")
+    apply_owned = _row("apply", 0.25, 50.0, quarantine_ms=20.0,
+                       update_id="u1", accepted=True)
+    apply_owned["parent_id"] = upload["span_id"]
+    rows = [
+        _row("dispatch", 0.00, 20.0),
+        _row("install", 0.03, 10.0),
+        _row("fit", 0.05, 100.0),
+        upload,
+        _row("decode", 0.20, 10.0),
+        apply_owned,
+        _row("apply", 0.43, 5.0, dedup=True, accepted=False),
+    ]
+    asm = assemble(rows)
+    assert not asm.orphans
+    (r,) = asm.rounds
+    assert r.kind == "wire" and r.applied
+    assert r.update_id == "u1"
+    assert r.retries == 1  # attempts=2
+    assert r.dedup_deliveries == 1
+    assert r.apply_spans == 1
+    assert r.ack_wait_ms == 200.0
+
+    approx = lambda v: pytest.approx(v, abs=1e-6)  # noqa: E731
+    assert r.phases["broadcast"] == approx(20.0)
+    assert r.phases["install"] == approx(10.0)
+    assert r.phases["fit"] == approx(100.0)
+    assert r.phases["serialize"] == approx(10.0)
+    assert r.phases["decode"] == approx(10.0)
+    assert r.phases["quarantine"] == approx(20.0)
+    # apply 50ms minus the 20ms quarantine slice
+    assert r.phases["apply"] == approx(30.0)
+    # submit = upload after serialize (340) minus decode (10) + apply (50)
+    assert r.phases["submit"] == approx(280.0)
+    assert r.bound_by == "submit"
+    # three 10ms handoff gaps: dispatch->install, install->fit, fit->serialize
+    assert r.idle_ms == approx(30.0)
+    assert [(a, b) for a, b, _ in r.gaps] == [
+        ("broadcast", "install"), ("install", "fit"), ("fit", "serialize")]
+    # hull: 0.00 .. 0.51 (the dedup delivery at 0.43 adds NO segment, so
+    # it cannot stretch or distort the critical path)
+    assert r.wall_ms == approx(510.0)
+    busy = 20 + 10 + 100 + 10 + 340 + 10 + 20 + 50
+    assert r.overlap_ms == approx(busy - 510.0)
+
+
+def test_unapplied_and_rejected_rounds():
+    # dispatch whose client vanished: an unapplied round, never an orphan
+    asm = assemble([_row("dispatch", 0.0, 5.0, trace_id="a" * 32)])
+    (r,) = asm.rounds
+    assert r.kind == "wire" and not r.applied and not asm.orphans
+
+    # a quarantined apply (accepted falsy) must not count as applied
+    rows = [
+        _row("upload", 0.0, 50.0, trace_id="b" * 32, update_id="u2"),
+        _row("apply", 0.02, 10.0, trace_id="b" * 32, update_id="u2",
+             accepted=False, verdict="quarantined"),
+    ]
+    (r,) = assemble(rows).rounds
+    assert not r.applied
+    assert r.attrs.get("verdict") is None or r.attrs.get("verdict")
+
+
+def test_step_round_matches_profiler_semantics():
+    rows = [
+        _row("round", 0.0, 100.0, role="trainer", worker=0),
+        _row("fit", 0.01, 60.0),
+        _row("submit", 0.07, 30.0),
+    ]
+    (r,) = assemble(rows).rounds
+    assert r.kind == "step" and r.applied
+    assert r.phases == {"fit": 60.0, "submit": 30.0}
+    assert r.bound_by == "fit"
+    assert r.idle_ms == pytest.approx(10.0)
+    assert r.overlap_ms == 0.0
+    assert r.attrs == {"role": "trainer", "worker": 0}
+
+    # an errored root assembles as unapplied
+    bad = dict(rows[0], status="error:RuntimeError", trace_id="c" * 32)
+    (r,) = assemble([bad]).rounds
+    assert r.kind == "step" and not r.applied
+
+
+def test_traces_sharing_update_id_merge():
+    """Reconnect redelivery: the cached re-upload rides the ORIGINAL
+    trace while the fresh dispatch opened a new one — both describe the
+    one applied update and must assemble as one round."""
+    t_orig, t_redeliver = "d" * 32, "e" * 32
+    upload = _row("upload", 0.10, 80.0, trace_id=t_orig, update_id="u7")
+    apply_ = _row("apply", 0.15, 10.0, trace_id=t_orig, update_id="u7",
+                  accepted=True)
+    apply_["parent_id"] = upload["span_id"]
+    rows = [
+        _row("dispatch", 0.00, 5.0, trace_id=t_orig, update_id="u7"),
+        upload, apply_,
+        _row("dispatch", 0.30, 5.0, trace_id=t_redeliver, update_id="u7"),
+    ]
+    asm = assemble(rows)
+    assert len(asm.rounds) == 1
+    (r,) = asm.rounds
+    assert r.applied and r.update_id == "u7" and r.span_count == 4
+
+    # distinct update ids do NOT merge
+    rows[3] = _row("dispatch", 0.30, 5.0, trace_id=t_redeliver,
+                   update_id="u8")
+    asm = assemble(rows)
+    assert len(asm.rounds) == 2
+    assert sum(r.applied for r in asm.rounds) == 1
+
+
+def test_orphans_and_wall_clock_step_tolerance():
+    # a row with no trace_id is an emit-site bug: surfaced, not assembled
+    asm = assemble([{"name": "mystery", "dur_ms": 1.0}])
+    assert len(asm.orphans) == 1 and not asm.rounds
+
+    # wall-clock step mid-round: one row's epoch stamp jumps +1h but its
+    # monotonic anchor is coherent — the median per-pid offset keeps the
+    # timeline intact instead of inflating the round by an hour
+    upload = _row("upload", 0.10, 80.0, update_id="u9")
+    upload["start"] += 3600.0
+    apply_ = _row("apply", 0.15, 10.0, update_id="u9", accepted=True)
+    rows = [_row("dispatch", 0.00, 5.0), upload, apply_,
+            _row("fit", 0.02, 60.0)]
+    (r,) = assemble(rows).rounds
+    assert r.wall_ms < 1000.0, f"clock step shuffled the timeline: {r}"
+    assert r.applied
+
+
+def test_assemble_dir_counts_malformed_lines(tmp_path):
+    path = tmp_path / SPANS_FILENAME
+    good = [_row("upload", 0.0, 50.0, update_id="u1"),
+            _row("apply", 0.02, 10.0, update_id="u1", accepted=True)]
+    lines = [json.dumps(good[0]), "{torn-tail", json.dumps(good[1]),
+             '{"also": "not a full row"']
+    path.write_text("\n".join(lines) + "\n")
+    asm = assemble_dir(str(tmp_path))
+    assert asm.skipped == 2
+    assert len(asm.rounds) == 1 and asm.rounds[0].applied
+    # the render surfaces the skip count instead of hiding it
+    assert any("2 malformed jsonl line(s) skipped" in ln
+               for ln in render(asm))
+    # missing file: empty assembly, not an exception
+    empty = assemble_dir(str(tmp_path / "nope"))
+    assert empty.rounds == [] and empty.skipped == 0
+
+
+# -- chaos round trip: one critical path per applied update -----------------
+
+
+def test_chaos_assembles_one_round_per_applied_update(tmp_path):
+    """Loopback async-SGD under drops + duplicates + a scripted reset +
+    a dropped ack (forcing a deduped retry). The assembler must produce
+    exactly one applied round per server-applied update — each with
+    exactly one owned apply span — and zero orphan spans."""
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+    dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    tel = Telemetry(save_dir=str(tmp_path))
+    server_plan = FaultPlan(
+        seed=5, duplicate=0.1,
+        schedule=[ScriptedFault(event="__ack__", nth=1, action="drop")],
+    )
+    client_plan = FaultPlan(
+        seed=3, drop=0.1, duplicate=0.1,
+        schedule=[ScriptedFault(event="uploadVars", nth=2, action="reset")],
+    )
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        dataset,
+        DistributedServerConfig(
+            save_dir=str(tmp_path / "m"),
+            heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=2.0,
+            fault_plan=server_plan,
+            telemetry=tel,
+        ),
+    )
+    server.setup()
+    applied_ids = []
+    server.on_upload(lambda m: applied_ids.append(m.update_id))
+    client = AsynchronousSGDClient(
+        server.address,
+        MockModel(),
+        DistributedClientConfig(
+            heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=2.0,
+            upload_timeout_s=0.5,
+            upload_retry=RetryPolicy(max_retries=8, initial_backoff_s=0.05,
+                                     max_backoff_s=0.5, seed=3),
+            fault_plan=client_plan,
+            telemetry=tel,
+        ),
+    )
+    try:
+        client.setup(timeout=10.0)
+        done = client.train_until_complete(timeout=120.0)
+
+        def _quiesced():
+            if server.duplicate_uploads < 1:
+                return False
+            span_ids = {s["span_id"] for s in tel.tracer.finished("upload")}
+            owned = [s for s in tel.tracer.finished("apply")
+                     if not s.get("dedup")]
+            return len(owned) >= 4 and all(
+                a["parent_id"] in span_ids for a in owned)
+
+        deadline = time.monotonic() + 30.0
+        while not _quiesced() and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        client.dispose()
+        server.stop()
+    assert done == 4 and server.applied_updates == 4
+    assert server.duplicate_uploads >= 1, "dropped ack's retry never deduped"
+    assert client.reconnects >= 1, "scripted reset never forced a reconnect"
+
+    # assemble from DISK — the full emit -> spans.jsonl -> stitch path
+    asm = assemble_dir(str(tmp_path))
+    assert asm.skipped == 0
+    assert not asm.orphans, f"orphan spans: {asm.orphans}"
+    rounds = asm.applied()
+    assert len(rounds) == 4, (
+        f"expected one applied round per applied update, got "
+        f"{[(r.trace_id[:8], r.update_id) for r in rounds]}")
+    for r in rounds:
+        assert r.apply_spans == 1, (
+            f"round {r.update_id} owns {r.apply_spans} apply spans")
+        assert r.update_id in applied_ids
+    assert len({r.update_id for r in rounds}) == 4
+    # the dedup'd duplicate landed INSIDE its original's round
+    assert sum(r.dedup_deliveries for r in rounds) >= 1
+    agg = asm.attribution()
+    assert agg["applied"] == 4 and agg["orphans"] == 0
+    assert agg["bound_by"] is not None
