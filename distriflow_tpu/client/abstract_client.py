@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import queue
 import threading
 import time
 import uuid as uuid_lib
@@ -181,6 +182,20 @@ class AbstractClient:
         # version of the last *installed* weights — the base a delta
         # broadcast must name for us to be able to apply it
         self._installed_version: Optional[str] = None
+        # double-buffered upload pipeline (hyperparam ``inflight_window``):
+        # a single lazily-started comm thread carries EF-compress ->
+        # serialize -> submit -> ack while the handler thread fits the next
+        # batch. ONE thread, processing in enqueue order, is what keeps the
+        # error-feedback residual handoff sequentially consistent — the
+        # residual a gradient picks up is exactly the residual its
+        # predecessor left. Depth is bounded by a slot semaphore
+        # (window - 1 uploads in flight beyond the fit in progress).
+        self._comm_q: Optional["queue.Queue[Any]"] = None
+        self._comm_thread: Optional[threading.Thread] = None
+        self._comm_slots: Optional[threading.Semaphore] = None
+        self._comm_pending = 0
+        self._comm_cv = threading.Condition()
+        self._comm_error: Optional[BaseException] = None
 
     # -- observability -----------------------------------------------------
 
@@ -279,9 +294,88 @@ class AbstractClient:
 
     def dispose(self) -> None:
         self._disposed = True
+        self._stop_comm_thread()
         self._transport_ready.clear()
         if self.transport is not None:
             self.transport.close()
+
+    # -- upload pipeline (inflight_window > 1) -------------------------------
+
+    def inflight_window(self) -> int:
+        """Effective upload-pipeline depth (hyperparam ``inflight_window``,
+        three-level precedence like every other knob). 1 = serial."""
+        try:
+            return max(1, int(self.hyperparam("inflight_window")))
+        except (TypeError, ValueError):
+            return 1
+
+    def _comm_acquire_slot(self) -> None:
+        """Backpressure: block until the upload window has room. Starts the
+        comm thread on first use. MUST be called with no locks held — the
+        comm thread takes client locks to publish results."""
+        if self._comm_thread is None:
+            with self._comm_cv:
+                if self._comm_thread is None:
+                    window = self.inflight_window()
+                    self._comm_q = queue.Queue()
+                    self._comm_slots = threading.Semaphore(
+                        max(1, window - 1))
+                    self._comm_thread = threading.Thread(
+                        target=self._comm_loop,
+                        name=f"client-comm-{self.client_id[:8]}",
+                        daemon=True)
+                    self._comm_thread.start()
+        self._comm_slots.acquire()
+
+    def _comm_release_slot(self) -> None:
+        self._comm_slots.release()
+
+    def _comm_put(self, task: Callable[[], Any]) -> None:
+        """Enqueue one comm task (slot already held). Safe to call while
+        holding client locks: the put never blocks."""
+        with self._comm_cv:
+            self._comm_pending += 1
+        self._comm_q.put(task)
+
+    def _comm_loop(self) -> None:
+        while True:
+            task = self._comm_q.get()
+            if task is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                task()
+            except BaseException as e:  # noqa: BLE001 - park, don't kill the pipe
+                # a terminally failed upload is recoverable: the server's
+                # lease expires, the batch redelivers, and the cached
+                # message re-uploads under the same update_id
+                self._comm_error = e
+                self.log(f"pipelined upload failed: {e!r}")
+            finally:
+                # the comm thread runs concurrently with the handler
+                # thread's steps: its time is overlap, never step busy
+                self._prof.record_overlap(
+                    None, (time.perf_counter() - t0) * 1e3)
+                self._comm_slots.release()
+                with self._comm_cv:
+                    self._comm_pending -= 1
+                    self._comm_cv.notify_all()
+
+    def drain_uploads(self, timeout: float = 30.0) -> bool:
+        """Block until every in-flight pipelined upload has completed (or
+        failed); True when the window is empty. No-op when serial."""
+        with self._comm_cv:
+            return self._comm_cv.wait_for(
+                lambda: self._comm_pending == 0, timeout)
+
+    def _stop_comm_thread(self) -> None:
+        thread = self._comm_thread
+        if thread is None:
+            return
+        self.drain_uploads(timeout=5.0)
+        self._comm_q.put(None)
+        thread.join(timeout=5.0)
+        self._comm_thread = None
 
     # -- download handling --------------------------------------------------
 

@@ -34,6 +34,19 @@ _RECENT_UPLOADS = 16
 _NULL_CTX = contextlib.nullcontext()
 
 
+class _PendingUpload:
+    """Cache marker for a batch whose gradients are riding the upload
+    pipeline: computed, not yet serialized/uploaded. A redelivery that
+    finds this does NOTHING — the queued upload (same ``update_id``) is
+    already the answer, and recomputing would double-mutate the EF
+    residual."""
+
+    __slots__ = ("update_id",)
+
+    def __init__(self, update_id: str):
+        self.update_id = update_id
+
+
 class AsynchronousSGDClient(AbstractClient):
     def __init__(self, *args: Any, **kw: Any):
         super().__init__(*args, **kw)
@@ -57,6 +70,9 @@ class AsynchronousSGDClient(AbstractClient):
         self.distributed_update(msg)
 
     def handle_training_complete(self) -> None:
+        # drain-on-stop: anything still riding the upload window finishes
+        # (or fails onto the redelivery path) before we report completion
+        self.drain_uploads(timeout=10.0)
         self.log("training complete")
         self.training_complete.set()
 
@@ -66,8 +82,18 @@ class AsynchronousSGDClient(AbstractClient):
         A redelivered batch (reconnect reconciliation, see
         ``_recent_uploads``) is answered from the cache: same gradients,
         same ``update_id``, no recompute, no ``batches_processed`` bump.
+
+        With ``inflight_window > 1`` the round splits at the fit/comm
+        boundary: the handler thread installs + fits, then hands the raw
+        gradients to the client comm thread, which EF-compresses,
+        serializes, and uploads in strict enqueue order (sequentially
+        consistent residual handoff) while the handler fits the batch the
+        server dispatched ahead.
         """
         key = (msg.data.epoch, msg.data.batch, msg.model.version)
+        if self.inflight_window() > 1:
+            self._pipelined_update(msg, key)
+            return
         # one profiler step bounds the whole round (fit -> compress ->
         # serialize -> submit/ack): its wall-vs-busy digests are the
         # overlap/idle attribution docs/OBSERVABILITY.md §5 describes
@@ -122,6 +148,87 @@ class AsynchronousSGDClient(AbstractClient):
                     # racing the ack back to us
                     self.batches_processed += 1
             self.upload(upload)
+
+    def _pipelined_update(self, msg: DownloadMsg, key: Tuple[int, int, str]
+                          ) -> None:
+        """Pipelined round: fit on this thread, upload tail on the comm
+        thread. The window slot is acquired BEFORE the update lock (the
+        comm thread takes the lock to publish the built message — slot-wait
+        under the lock would deadlock the pipe), and slot-then-lock also
+        pins enqueue order to fit order."""
+        with self._prof.step():
+            self._comm_acquire_slot()
+            enqueued = False
+            try:
+                with self._update_lock:
+                    cached = self._recent_uploads.get(key)
+                    if isinstance(cached, _PendingUpload):
+                        # already in the window: its queued upload (same
+                        # update_id) answers this redelivery
+                        self.log(f"batch {key} already in upload window")
+                        return
+                    if cached is not None:
+                        self.log(f"re-upload of already-computed batch {key}")
+                        self._comm_put(lambda m=cached: self.upload(m))
+                        enqueued = True
+                        return
+                    x = jnp.asarray(deserialize_array(msg.data.x))
+                    y = jnp.asarray(deserialize_array(msg.data.y))
+                    metrics: Optional[List[float]] = None
+                    if self.config.send_metrics:
+                        metrics = self.model.evaluate(x, y)
+                    with self.time("fit"), self._prof.phase("fit"), \
+                            self.telemetry.span(
+                                "fit", trace_id=msg.trace_id,
+                                parent_id=msg.span_id,
+                                client_id=self.client_id,
+                                model_version=msg.model.version,
+                            ) if msg.trace_id else _NULL_CTX:
+                        grads = self.model.fit(x, y)
+                    # the update_id is fixed at handoff so a redelivery
+                    # arriving while this rides the pipe dedups against
+                    # the very same id the eventual upload will carry
+                    update_id = uuid_lib.uuid4().hex
+                    self._recent_uploads[key] = _PendingUpload(update_id)
+                    while len(self._recent_uploads) > _RECENT_UPLOADS:
+                        self._recent_uploads.popitem(last=False)
+                    # count before the upload ack (trainingComplete race,
+                    # same contract as the serial path)
+                    self.batches_processed += 1
+                    self._comm_put(
+                        lambda: self._comm_build_and_upload(
+                            msg, key, grads, metrics, update_id))
+                    enqueued = True
+            finally:
+                if not enqueued:
+                    self._comm_release_slot()
+
+    def _comm_build_and_upload(self, msg: DownloadMsg,
+                               key: Tuple[int, int, str], grads: Any,
+                               metrics: Optional[List[float]],
+                               update_id: str) -> None:
+        """Comm-thread tail of a pipelined round: EF-compress + serialize
+        (single thread, enqueue order — the residual handoff is
+        sequentially consistent by construction), publish the finished
+        message to the redelivery cache, then upload with ack/retry."""
+        upload = UploadMsg(
+            client_id=self.client_id,
+            batch=msg.data.batch,
+            gradients=GradientMsg(
+                version=msg.model.version,
+                vars=self.serialize_grads(grads),
+            ),
+            metrics=metrics,
+            update_id=update_id,
+            trace_id=msg.trace_id,
+        )
+        with self._update_lock:
+            # replace the pending marker: from here a redelivery re-sends
+            # this exact message (reconnect-mid-window resubmission rides
+            # the server's update_id dedup)
+            if key in self._recent_uploads:
+                self._recent_uploads[key] = upload
+        self.upload(upload)
 
     def train_until_complete(self, timeout: float = 300.0) -> int:
         """Block until the server signals completion; returns batches done.

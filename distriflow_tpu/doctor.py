@@ -34,10 +34,12 @@ silent socket.io hang). Checks, in order:
     ack-latency SLO band exactly once (edge-triggered) and dump exactly
     one flight bundle; a clean run must trip nothing;
 12. critical-path drill: assembled round traces must attribute a clean
-    run to its dominant compute phase, shift ``bound_by`` to ``submit``
-    under a scripted 0.3 s upload delay (and only then), and the bench
-    ledger must flag a synthetically slowed row as ``regress`` on
-    exactly one metric (see ``docs/OBSERVABILITY.md`` §9);
+    run to its dominant compute phase, attribute a PIPELINED clean run
+    (``inflight_window=2``) to ``fit`` with the upload tail hidden on
+    the comm thread, and shift ``bound_by`` to ``submit`` under a
+    scripted 0.3 s upload delay (and only then); the bench ledger must
+    flag a synthetically slowed row as ``regress`` on exactly one
+    metric (see ``docs/OBSERVABILITY.md`` §9);
 13. native C++ host library presence (optional — numpy fallback is fine);
 14. checkpoint write/read round trip in a temp dir.
 
@@ -759,14 +761,17 @@ def main() -> int:
     ok &= _check("health-sentinel drill (SLO breach + flight dump)", sentinel)
 
     def critical_path():
-        """Critical-path drill (docs/OBSERVABILITY.md §9), both ways: a
+        """Critical-path drill (docs/OBSERVABILITY.md §9), three ways: a
         clean loopback async run (fit padded to ~30 ms so the round has a
         real dominant phase) must NOT attribute its rounds to ``submit``;
-        the SAME run with every upload frame under a scripted 0.3 s delay
-        must shift every applied round's ``bound_by`` to ``submit``. Then
-        the ledger gate: three baseline rows plus one synthetically slowed
-        candidate must produce a ``regress`` verdict on exactly one
-        metric."""
+        the same run PIPELINED (``inflight_window=2``, round-6) must
+        attribute to ``fit`` — the upload tail rides the comm thread and
+        must not leak onto the critical path; and the run with every
+        upload frame under a scripted 0.3 s delay must shift every
+        applied round's ``bound_by`` to ``submit`` — and only that run.
+        Then the ledger gate: three baseline rows plus one synthetically
+        slowed candidate must produce a ``regress`` verdict on exactly
+        one metric."""
         import os
 
         import numpy as np
@@ -792,7 +797,7 @@ def main() -> int:
                 time.sleep(0.03)
                 return super().fit(x, y)
 
-        def run_once(fault_plan, save_dir):
+        def run_once(fault_plan, save_dir, window=1):
             x = np.arange(8, dtype=np.float32).reshape(8, 1)
             y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
             dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
@@ -802,6 +807,7 @@ def main() -> int:
                 dataset,
                 DistributedServerConfig(
                     heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+                    client_hyperparams={"inflight_window": window},
                     telemetry=tel,
                 ),
             )
@@ -843,6 +849,26 @@ def main() -> int:
             lines = summarize_critical_path(base_dir)
             assert any("bound_by" in ln for ln in lines), lines
 
+            # pipelined clean run (round-6 double-buffered client): the
+            # server dispatches ahead and the upload tail rides the client
+            # comm thread, so with fit padded to ~30 ms the rounds must
+            # attribute to FIT — a hidden submit that still showed up as
+            # bound_by would mean the overlap booking leaks into the
+            # critical path
+            piped, applied, _ = run_once(None, os.path.join(d, "piped"),
+                                         window=2)
+            agg_piped = piped.attribution()
+            assert agg_piped["applied"] == applied == 4, (
+                f"pipelined run lost exactly-once: assembled "
+                f"{agg_piped['applied']}, server applied {applied}"
+            )
+            assert not piped.orphans, (
+                f"{len(piped.orphans)} orphan span(s) in pipelined run"
+            )
+            assert agg_piped["bound_by"] == "fit", (
+                f"pipelined clean run not fit-bound: {agg_piped}"
+            )
+
             plan = FaultPlan(seed=11, schedule=[
                 ScriptedFault(event="uploadVars", nth=n, action="delay",
                               delay_s=0.3) for n in (1, 2, 3, 4)])
@@ -880,10 +906,11 @@ def main() -> int:
                 f"{slowed['metrics']}"
             )
         submit_mean = agg_slow["phase_mean_ms"].get("submit", 0.0)
-        return (f"clean run bound_by={baseline_bound} (4 rounds, 0 "
-                f"orphans); 0.3 s scripted upload delay shifted all 4 "
-                f"rounds to submit ({submit_mean:.0f} ms/round); ledger: "
-                "healthy row ok, slowed row regressed exactly 1 metric")
+        return (f"clean run bound_by={baseline_bound}, pipelined "
+                f"(window=2) bound_by=fit (4 rounds, 0 orphans each); "
+                f"0.3 s scripted upload delay shifted all 4 rounds to "
+                f"submit ({submit_mean:.0f} ms/round); ledger: healthy "
+                "row ok, slowed row regressed exactly 1 metric")
 
     ok &= _check("critical-path drill (submit-delay attribution + "
                  "ledger gate)", critical_path)

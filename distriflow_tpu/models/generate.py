@@ -108,6 +108,23 @@ def _check_fits(p: int, n_tokens: int, config: TransformerConfig) -> None:
         )
 
 
+def _gate_kv_dtype(config: TransformerConfig,
+                   context_len: int) -> TransformerConfig:
+    """Re-gate an int8 KV request on the context this call will actually
+    read. ``generate()``/``beam_search()`` know the true decode context
+    (prompt + n_tokens), so the int8-vs-bf16 crossover decides on READ
+    traffic, not the ``max_seq`` allocation bound — a 16k-``max_seq``
+    config serving a 1k request keeps the bf16 cache it measures faster
+    with (``kv_cache_dtype_for``). ``int8_force`` is never demoted, and
+    the replace is a no-op (same hashable config, same ``_build_fns``
+    cache entry) whenever the two gates agree."""
+    if (config.kv_cache_dtype == "int8"
+            and config.kv_cache_dtype_for(context_len) is None
+            and config.resolved_kv_cache_dtype == "int8"):
+        return dataclasses.replace(config, kv_cache_dtype=None)
+    return config
+
+
 @functools.lru_cache(maxsize=32)
 def _build_fns(
     config: TransformerConfig,
@@ -291,6 +308,7 @@ def beam_search(
     if n_tokens <= 0:
         return prompt, jnp.zeros((b,), jnp.float32)
     _check_fits(p, n_tokens, config)
+    config = _gate_kv_dtype(config, p + n_tokens)
     search = _build_beam_fns(
         config, n_tokens, beam_size, length_penalty, eos_id)
     return search(params, jnp.asarray(prompt, jnp.int32))
@@ -588,6 +606,7 @@ def generate(
         raise ValueError(f"eos_id {eos_id} outside vocab [0, {config.vocab_size})")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    config = _gate_kv_dtype(config, p + n_tokens)
     prefill, pick, decode_steps = _build_fns(
         config, n_tokens, temperature, top_k, top_p, eos_id
     )

@@ -138,19 +138,35 @@ class TransformerConfig:
                 f"got {self.kv_cache_dtype!r}"
             )
 
-    @property
-    def resolved_kv_cache_dtype(self) -> Optional[str]:
-        """The cache precision decode actually stores: "int8" only when
-        quantization pays — i.e. forced, or ``max_seq`` at/above the
-        measured crossover (docs/PERFORMANCE.md §7e). Below it, int8's
-        per-token quantize + scale reads cost more than the halved KV
-        traffic saves, so the cache silently stays ``cfg.dtype``."""
+    def kv_cache_dtype_for(self, context_len: int) -> Optional[str]:
+        """The cache precision a decode that will READ ``context_len``
+        positions should store: "int8" only when quantization pays —
+        i.e. forced, or the context at/above the measured crossover
+        (docs/PERFORMANCE.md §7e). Below it, int8's per-token quantize +
+        scale reads cost more than the halved KV traffic saves, so the
+        cache stays ``cfg.dtype``.
+
+        The crossover is about traffic actually read, not capacity
+        allocated: a ``max_seq=16384`` config decoding a 1k-context
+        request streams 1k positions per token, and int8 loses there just
+        as it does for a short ``max_seq`` (BENCH_r05 measured int8
+        SLOWER at 1k and 4k context). Callers that know the real request
+        shape (``generate()``: prompt + n_tokens) gate on it; callers
+        that only know the allocation bound (the serving engine's shared
+        slab) fall back to :attr:`resolved_kv_cache_dtype`."""
         if self.kv_cache_dtype == "int8_force":
             return "int8"
         if (self.kv_cache_dtype == "int8"
-                and self.max_seq >= INT8_KV_DECODE_CROSSOVER_SEQ):
+                and context_len >= INT8_KV_DECODE_CROSSOVER_SEQ):
             return "int8"
         return None
+
+    @property
+    def resolved_kv_cache_dtype(self) -> Optional[str]:
+        """The cache precision decode stores when only the allocation
+        bound is known: :meth:`kv_cache_dtype_for` at ``max_seq`` — the
+        conservative upper bound on how much KV a token could read."""
+        return self.kv_cache_dtype_for(self.max_seq)
 
     def resolved_loss_for(self, mesh: Optional[Mesh]) -> str:
         """The loss name the model spec actually trains with. An explicit

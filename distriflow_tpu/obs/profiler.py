@@ -74,6 +74,9 @@ class _NoopProfiler:
     def record(self, name: str, dur_ms: float) -> None:
         pass
 
+    def record_overlap(self, name: Optional[str], dur_ms: float) -> None:
+        pass
+
     def digests(self) -> Dict[str, Dict[str, float]]:
         return {}
 
@@ -189,6 +192,17 @@ class PhaseProfiler:
         step = getattr(self._local, "step", None)
         if step is not None and step.depth == 0:
             step.busy += dur_ms
+
+    def record_overlap(self, name: Optional[str], dur_ms: float) -> None:
+        """Record time spent on a comm/background thread that ran
+        CONCURRENTLY with this role's steps. The duration is observed into
+        the phase digest (when named) and credited straight to the overlap
+        digest; it never feeds any step's busy sum, so per-step
+        ``busy - overlap + idle == wall`` still holds on the step thread
+        and the comm time is not double-counted there."""
+        if name is not None:
+            self._hist(name).observe(dur_ms)
+        self._h_overlap.observe(dur_ms)
 
     # -- read side ---------------------------------------------------------
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -65,15 +65,22 @@ class AsynchronousSGDServer(AbstractServer):
         self._c_suppressed = self.telemetry.counter("server_first_wins_suppressed_total")
         self._c_requeued = self.telemetry.counter("server_recovery_requeued_total")
         self._client_versions: Dict[str, int] = {}
-        self._client_batches: Dict[str, int] = {}  # outstanding batch per client
+        # outstanding batches per client, in dispatch order. One entry in
+        # serial mode; up to the dispatch-ahead window when the pushed
+        # client hyperparams carry inflight_window > 1 (the next batch
+        # piggybacks on the ack/broadcast for the previous one, so a
+        # pipelined client never idles on dispatch).
+        self._client_batches: Dict[str, List[int]] = {}
         self._waiting: set = set()  # starved clients awaiting redispatch
         self._completion_sent = False
         self.applied_updates = 0
         self.rejected_updates = 0
-        # straggler mitigation: client_id -> (batch, monotonic deadline);
+        # straggler mitigation: (client_id, batch) -> monotonic deadline;
         # the monitor thread requeues expired leases for speculative
-        # re-dispatch (config.batch_lease_s > 0 enables)
-        self._lease_deadlines: Dict[str, Tuple[int, float]] = {}
+        # re-dispatch (config.batch_lease_s > 0 enables). Keyed per
+        # dispatch, not per client, so every batch in a client's
+        # dispatch-ahead window carries its own lease.
+        self._lease_deadlines: Dict[Tuple[str, int], float] = {}
         self._lease_stop = threading.Event()
         self._lease_thread: Optional[threading.Thread] = None
         self.lease_expirations = 0
@@ -115,6 +122,28 @@ class AsynchronousSGDServer(AbstractServer):
 
     # -- dispatch ----------------------------------------------------------
 
+    def _dispatch_window(self) -> int:
+        """How many batches a client may hold at once: the pushed client
+        ``inflight_window`` clamped at ``maximum_staleness + 1`` — the
+        server-side cap is what makes the pipeline's effective staleness
+        bounded BY CONSTRUCTION (a batch the server never dispatched can't
+        age in anyone's window)."""
+        return max(1, min(int(self.client_hyperparams.inflight_window),
+                          int(self.hyperparams.maximum_staleness) + 1))
+
+    def _fill_window(self, client_id: str) -> None:
+        """Dispatch-ahead: top the client's outstanding set up to the
+        window. Stops at the first failed dispatch (starved queue,
+        exhaustion, or the client vanishing)."""
+        window = self._dispatch_window()
+        while True:
+            with self._lock:
+                outstanding = len(self._client_batches.get(client_id, ()))
+            if outstanding >= window:
+                return
+            if not self._send_next_batch(client_id):
+                return
+
     def _send_next_batch(self, client_id: str) -> bool:
         """Pop the next batch and send weights+data to ONE client.
 
@@ -135,11 +164,11 @@ class AsynchronousSGDServer(AbstractServer):
                 self._waiting.add(client_id)
             return False
         with self._lock:
-            self._client_batches[client_id] = batch.batch
+            self._client_batches.setdefault(client_id, []).append(batch.batch)
             self._client_versions[client_id] = self.version_counter
             if self.config.batch_lease_s > 0:
-                self._lease_deadlines[client_id] = (
-                    batch.batch, time.monotonic() + self.config.batch_lease_s
+                self._lease_deadlines[(client_id, batch.batch)] = (
+                    time.monotonic() + self.config.batch_lease_s
                 )
             self._waiting.discard(client_id)
         # the dispatch opens the update's trace: its trace_id rides the
@@ -168,11 +197,14 @@ class AsynchronousSGDServer(AbstractServer):
                 # `owned` resolves the race with handle_disconnection: only
                 # whoever pops the dispatch record requeues.
                 with self._lock:
-                    owned = self._client_batches.get(client_id) == batch.batch
+                    held = self._client_batches.get(client_id, [])
+                    owned = batch.batch in held
                     if owned:
-                        self._client_batches.pop(client_id, None)
+                        held.remove(batch.batch)
+                        if not held:
+                            self._client_batches.pop(client_id, None)
                     self._client_versions.pop(client_id, None)
-                    self._lease_deadlines.pop(client_id, None)
+                    self._lease_deadlines.pop((client_id, batch.batch), None)
                     self._waiting.discard(client_id)
                 if owned:
                     self.dataset.requeue(batch.batch)
@@ -199,35 +231,42 @@ class AsynchronousSGDServer(AbstractServer):
             self._completion_sent = True
         self.transport.broadcast("trainingComplete", {})
 
+    def _reclaim_outstanding(self, client_id: str) -> List[int]:
+        """Pop (under the lock) everything the client holds — its whole
+        dispatch-ahead window — plus the matching leases; the caller
+        requeues outside the lock."""
+        with self._lock:
+            outstanding = self._client_batches.pop(client_id, [])
+            self._client_versions.pop(client_id, None)
+            for b in outstanding:
+                self._lease_deadlines.pop((client_id, b), None)
+            self._waiting.discard(client_id)
+        return outstanding
+
     def handle_connection(self, client_id: str) -> None:
-        # weights + first batch to the new client (reference :59-63)
-        self._send_next_batch(client_id)
+        # weights + first batch(es) to the new client (reference :59-63);
+        # a pipelined client gets its whole dispatch-ahead window up front
+        self._fill_window(client_id)
 
     def handle_resync(self, client_id: str) -> None:
         """Resync repair for the dispatching plane: the client discarded the
         broadcast (and the batch riding on it), so requeue its outstanding
-        batch and re-dispatch. The base was already cleared by the caller,
-        so the fresh dispatch carries FULL weights; the client's update-id
-        cache keeps the eventual re-train idempotent server-side."""
-        with self._lock:
-            outstanding = self._client_batches.pop(client_id, None)
-            self._client_versions.pop(client_id, None)
-            self._lease_deadlines.pop(client_id, None)
-        if outstanding is not None:
-            self.dataset.requeue(outstanding)
-        self._send_next_batch(client_id)
+        batches — the entire in-flight window; a delta any of them rode is
+        invalid now — and re-dispatch. The base was already cleared by the
+        caller, so the fresh dispatch carries FULL weights; the client's
+        update-id cache keeps the eventual re-train idempotent server-side."""
+        for b in self._reclaim_outstanding(client_id):
+            self.dataset.requeue(b)
+        self._fill_window(client_id)
         self._dispatch_waiting()
 
     def handle_disconnection(self, client_id: str) -> None:
-        # failure recovery: requeue the batch the client died holding
-        with self._lock:
-            outstanding = self._client_batches.pop(client_id, None)
-            self._client_versions.pop(client_id, None)
-            self._lease_deadlines.pop(client_id, None)
-            self._waiting.discard(client_id)
-        if outstanding is not None:
-            self.dataset.requeue(outstanding)
-            self.log(f"requeued batch {outstanding} from dead client")
+        # failure recovery: requeue every batch the client died holding
+        outstanding = self._reclaim_outstanding(client_id)
+        if outstanding:
+            for b in outstanding:
+                self.dataset.requeue(b)
+            self.log(f"requeued batch(es) {outstanding} from dead client")
             self._dispatch_waiting()
 
     # -- upload ------------------------------------------------------------
@@ -241,11 +280,12 @@ class AsynchronousSGDServer(AbstractServer):
             # land its gradient twice (first-wins arbitration)
             first = self.dataset.complete_batch(msg.batch)
             with self._lock:
-                if self._client_batches.get(client_id) == msg.batch:
-                    self._client_batches.pop(client_id, None)
-                lease = self._lease_deadlines.get(client_id)
-                if lease is not None and lease[0] == msg.batch:
-                    self._lease_deadlines.pop(client_id, None)
+                held = self._client_batches.get(client_id)
+                if held is not None and msg.batch in held:
+                    held.remove(msg.batch)
+                    if not held:
+                        self._client_batches.pop(client_id, None)
+                self._lease_deadlines.pop((client_id, msg.batch), None)
         accepted = False
         if msg.gradients is not None:
             if first:
@@ -257,9 +297,10 @@ class AsynchronousSGDServer(AbstractServer):
                     f"suppressed gradient for batch {msg.batch} from "
                     f"{msg.client_id}: already completed (first-wins)"
                 )
-        # hand the next batch to THIS client only (fixed dispatch), then give
-        # parked clients a chance at whatever the ack freed up
-        self._send_next_batch(client_id)
+        # refill THIS client's window (fixed dispatch — the next batch
+        # piggybacks right behind the ack/broadcast for this one), then
+        # give parked clients a chance at whatever the ack freed up
+        self._fill_window(client_id)
         self._dispatch_waiting()
         return accepted
 
@@ -395,12 +436,12 @@ class AsynchronousSGDServer(AbstractServer):
             now = time.monotonic()
             expired = []
             with self._lock:
-                for cid, (batch, deadline) in list(self._lease_deadlines.items()):
+                for (cid, batch), deadline in list(self._lease_deadlines.items()):
                     if now >= deadline:
                         # one expiry per dispatch: the straggler keeps its
                         # dispatch record (its eventual upload still names
                         # the batch), only the lease is retired
-                        self._lease_deadlines.pop(cid)
+                        self._lease_deadlines.pop((cid, batch))
                         expired.append((cid, batch))
             for cid, batch in expired:
                 self.lease_expirations += 1

@@ -22,10 +22,28 @@ device-to-device, computes grads with a jit-compiled step on its own device,
 and pushes grads back; the server thread serializes apply-side updates under
 a lock. Nothing crosses a wire — "upload" is an ICI/D2D transfer, and the
 per-step serialize+broadcast of the reference disappears.
+
+Double-buffered upload pipeline (``inflight_window`` > 1): round 4's phase
+breakdown showed ``fit`` and ``submit`` strictly back-to-back (133 / 134 ms
+per upload) even though they touch disjoint resources — the worker's device
+computes the next gradient while the previous one only needs the apply lock
+and the server device. With a window of W each worker hands its fitted
+gradient to a dedicated per-worker comm thread (FIFO: ticket order is
+preserved, so SSP admission semantics are unchanged) and immediately
+prefetches/stages/fits the next group; up to ``W - 1`` uploads ride the
+comm thread concurrently. The window is capped at
+``maximum_staleness + 1`` so the pipeline can never push effective
+staleness past the bound the admission window already enforces. Comm-thread
+time books into the same ``phase_ms``/profiler digests via
+``record_overlap`` — it lands in the overlap digest, not any step's busy
+sum, so ``busy - overlap + idle == wall`` still holds per worker step and
+nothing is double-counted. ``inflight_window=1`` (default) is byte-for-byte
+the legacy serial path.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -42,6 +60,99 @@ from distriflow_tpu.utils.config import ServerHyperparams, async_server_hyperpar
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
 
 Params = Any
+
+
+class _UploadPipe:
+    """Per-worker comm pipeline: the double-buffered upload window.
+
+    The worker hands each fitted gradient group off and immediately starts
+    the next round's take/stage/fit; this dedicated comm thread carries the
+    FIFO wait -> submit -> batch-ack tail. Depth is bounded by a slot
+    semaphore (``window - 1`` handoffs in flight beyond the round being
+    fitted), so per-worker memory stays within ~window gradient trees and
+    the SSP admission semaphore remains the staleness authority.
+
+    One comm thread PER worker (not one shared) is load-bearing: submit
+    order is a global FIFO over tickets, and a shared thread could dequeue
+    ticket N+1 before ticket N was even enqueued and park forever in
+    ``_await_turn`` — per-worker threads each block only on tickets that
+    are already owned downstream, so the smallest open ticket always makes
+    progress.
+
+    A failed submit requeues its batches (another worker redoes them),
+    retires its ticket so later submits don't stall, and parks the error
+    for the worker to re-raise at the next handoff or at drain.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, trainer: "AsyncSGDTrainer", worker_index: int,
+                 window: int):
+        self._tr = trainer
+        self._worker = worker_index
+        self._slots = threading.Semaphore(max(1, window - 1))
+        self._q: "queue.Queue[Any]" = queue.Queue()
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"async-sgd-comm-{worker_index}",
+            daemon=True)
+        self._thread.start()
+
+    def acquire_slot(self) -> None:
+        """Block until the window has room for one more in-flight upload."""
+        self._slots.acquire()
+
+    def put(self, ticket: Optional[int], grads: Params, version: int,
+            group: List[Tuple[Any, ...]], tid: Optional[str]) -> None:
+        """Hand one fitted group to the comm thread (slot already held)."""
+        self._q.put((ticket, grads, version, group, tid))
+
+    def check(self) -> None:
+        """Re-raise (once) any error the comm thread parked."""
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise err
+
+    def close(self) -> None:
+        """Drain the window: process everything queued, join, re-raise."""
+        self._q.put(self._SENTINEL)
+        self._thread.join()
+        self.check()
+
+    def _run(self) -> None:
+        tr = self._tr
+        while True:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                return
+            ticket, grads, version, group, tid = item
+            try:
+                t0 = time.perf_counter()
+                try:
+                    if ticket is not None:
+                        tr._await_turn(ticket)
+                        t0 = tr._phase_overlap("admission_wait", t0, tid)
+                    tr.submit(grads, version,
+                              client_id=f"worker-{self._worker}")
+                    if tr.profile_phases:
+                        jax.block_until_ready(tr.params)
+                    tr._phase_overlap("submit", t0, tid)
+                except BaseException:
+                    for b, *_rest in group:
+                        tr.dataset.requeue(b.batch)
+                    raise
+                finally:
+                    if ticket is not None:
+                        tr._close_span(ticket)
+                # ack regardless of staleness-acceptance: the batches were
+                # consumed (same contract as the serial path)
+                for b, *_rest in group:
+                    tr.dataset.complete_batch(b.batch)
+            except BaseException as e:
+                if self.error is None:
+                    self.error = e
+            finally:
+                self._slots.release()
 
 
 class AsyncSGDTrainer:
@@ -63,6 +174,7 @@ class AsyncSGDTrainer:
         admission_control: bool = True,
         profile_phases: bool = False,
         stage_dataset: bool = False,
+        inflight_window: int = 1,
     ):
         self.spec = spec
         self.dataset = dataset
@@ -140,8 +252,19 @@ class AsyncSGDTrainer:
         # waits for the queue at the end. Without the drain phase the
         # breakdown summed to ~10% of wall (round-4 verdict weak #3).
         self.phase_ms = {"stage": 0.0, "snapshot": 0.0, "fit": 0.0,
-                         "submit": 0.0, "admission_wait": 0.0, "drain": 0.0}
+                         "submit": 0.0, "admission_wait": 0.0,
+                         "pipeline_wait": 0.0, "drain": 0.0}
         self._phase_lock = threading.Lock()
+
+        # double-buffered upload window (module docstring): 1 = legacy
+        # serial fit->submit; W>1 = per-worker comm thread carrying up to
+        # W-1 in-flight uploads while the worker fits the next group. The
+        # effective window is clamped at the SSP admission window so the
+        # pipeline can never manufacture staleness past the bound.
+        self.inflight_window = int(inflight_window)
+        if self.inflight_window < 1:
+            raise ValueError(
+                f"inflight_window must be >= 1, got {inflight_window}")
 
         # device-resident dataset (round-4, verdict #3): with
         # ``stage_dataset=True`` the full x/y arrays transfer to each
@@ -339,6 +462,36 @@ class AsyncSGDTrainer:
                 mono=time.monotonic() - dt / 1e3)
         return time.perf_counter()
 
+    def _effective_window(self) -> int:
+        """The pipeline depth actually run: ``inflight_window`` clamped at
+        the SSP admission window (``maximum_staleness + 1``) so an
+        over-eager window can never push effective staleness past the
+        bound — the semaphore would stall the extra depth anyway, this
+        just refuses to allocate it."""
+        w = self.inflight_window
+        if self.admission_control:
+            w = min(w, int(self.hyperparams.maximum_staleness) + 1)
+        return max(1, w)
+
+    def _phase_overlap(self, name: str, t0: float,
+                       tid: Optional[str]) -> float:
+        """Comm-thread sibling of :meth:`_phase`: books the duration into
+        ``phase_ms`` and the phase digest but credits it to the OVERLAP
+        digest (``record_overlap``) instead of any step's busy sum, and
+        stamps the trace child ``overlap=True`` so the assembler routes it
+        into ``overlap_ms`` rather than the bound_by candidates. Returns a
+        fresh t0."""
+        dt = (time.perf_counter() - t0) * 1e3
+        with self._phase_lock:
+            self.phase_ms[name] += dt
+        self._prof.record_overlap(name, dt)
+        if tid is not None:
+            self._tracer.emit(
+                name, trace_id=tid, parent_id=None, dur_ms=dt,
+                start=time.time() - dt / 1e3,
+                mono=time.monotonic() - dt / 1e3, overlap=True)
+        return time.perf_counter()
+
     # -- lifecycle ---------------------------------------------------------
 
     def init(self, rng: Optional[jax.Array] = None) -> Params:
@@ -446,8 +599,43 @@ class AsyncSGDTrainer:
         This is the DistriWorker role (reference ``asynchronousSGD_client.ts``
         ping-pong loop) without the wire: ``snapshot`` is the Download,
         ``submit`` is the Upload.
+
+        With ``inflight_window > 1`` the submit tail rides a per-worker
+        comm thread (:class:`_UploadPipe`): the worker hands the fitted
+        gradient off and immediately prefetches + stages + fits the next
+        group, blocking only when the window is full (booked as
+        ``pipeline_wait``). The pipe is drained before this returns —
+        every handed-off upload has been applied-or-requeued and its
+        batches acked, and any comm-thread error re-raises here.
         """
         device = self.devices[worker_index % len(self.devices)]
+        window = self._effective_window()
+        pipe = (_UploadPipe(self, worker_index, window)
+                if window > 1 else None)
+        try:
+            steps = self._worker_rounds(worker_index, device, pipe,
+                                        max_steps)
+        except BaseException:
+            if pipe is not None:
+                try:
+                    pipe.close()
+                except BaseException:
+                    pass  # the original error is the one to surface
+            raise
+        if pipe is not None:
+            # drain-on-stop: the last window of uploads finishes before
+            # the worker reports done; the wait is window serialization,
+            # so it books as pipeline_wait (drain stays device-drain)
+            t0 = time.perf_counter()
+            pipe.close()
+            with self._phase_lock:
+                self.phase_ms["pipeline_wait"] += (
+                    time.perf_counter() - t0) * 1e3
+        return steps
+
+    def _worker_rounds(self, worker_index: int, device,
+                       pipe: Optional[_UploadPipe],
+                       max_steps: Optional[int]) -> int:
         steps = 0
         while max_steps is None or steps < max_steps:
             budget = self.steps_per_upload
@@ -480,6 +668,7 @@ class AsyncSGDTrainer:
                         staged = [g[1] for g in group] + [g[2] for g in group]
                         t0 = self._phase("stage", t0, *staged)
                     ticket = None
+                    handed = False
                     try:
                         if self.admission_control:
                             # SSP span: window slot + submit-order ticket (ctor
@@ -497,33 +686,48 @@ class AsyncSGDTrainer:
                         else:
                             grads = self._host_fit(local_params, group)
                         t0 = self._phase("fit", t0, grads)
-                        if ticket is not None:
-                            # ordering wait books under admission_wait, NOT
-                            # submit: with heterogeneous workers the FIFO wait
-                            # can dominate and the phase breakdown must
-                            # localize it correctly
-                            self._await_turn(ticket)
-                            t0 = self._phase("admission_wait", t0)
-                        self.submit(grads, version,
-                                    client_id=f"worker-{worker_index}")
-                        self._phase("submit", t0,
-                                    self.params if self.profile_phases else ())
+                        if pipe is not None:
+                            # double-buffer: hand the submit tail to the
+                            # comm thread and start the next round; the
+                            # slot wait is the pipeline's backpressure
+                            pipe.check()
+                            pipe.acquire_slot()
+                            t0 = self._phase("pipeline_wait", t0)
+                            pipe.put(ticket, grads, version, group, tid)
+                            # ticket retirement, batch ack/requeue are the
+                            # pipe's now — this round must not touch them
+                            handed = True
+                        else:
+                            if ticket is not None:
+                                # ordering wait books under admission_wait,
+                                # NOT submit: with heterogeneous workers the
+                                # FIFO wait can dominate and the phase
+                                # breakdown must localize it correctly
+                                self._await_turn(ticket)
+                                t0 = self._phase("admission_wait", t0)
+                            self.submit(grads, version,
+                                        client_id=f"worker-{worker_index}")
+                            self._phase(
+                                "submit", t0,
+                                self.params if self.profile_phases else ())
                     except BaseException:
                         # failure recovery: return the batches to the queue so
                         # another worker picks them up (the redelivery role of
                         # reference dataset.ts:56-60, triggered by failure
                         # here)
-                        for b, _, _ in group:
-                            self.dataset.requeue(b.batch)
+                        if not handed:
+                            for b, _, _ in group:
+                                self.dataset.requeue(b.batch)
                         raise
                     finally:
-                        if ticket is not None:
+                        if ticket is not None and not handed:
                             self._close_span(ticket)
                     # ack regardless of staleness-acceptance: the batches were
                     # consumed (reference acks before applying,
                     # asynchronousSGD_server.ts:66-72)
-                    for b, _, _ in group:
-                        self.dataset.complete_batch(b.batch)
+                    if not handed:
+                        for b, _, _ in group:
+                            self.dataset.complete_batch(b.batch)
                     round_ok = True
                 finally:
                     if tid is not None:

@@ -138,6 +138,14 @@ class ClientHyperparams:
     # trade convergence speed for wire bytes, not correctness (DGC, Lin et
     # al. 2018). Ignored by the dense modes.
     topk_fraction: float = 0.01
+    # double-buffered upload window (docs/PERFORMANCE.md pipelining §):
+    # how many unacked uploads a client may have in flight while it fits
+    # the next batch. 1 = serial fit->compress->serialize->submit->ack;
+    # 2 = classic double buffer (compress/serialize/submit ride a comm
+    # thread). The async server clamps its dispatch-ahead at
+    # min(inflight_window, maximum_staleness + 1) so the pipeline can
+    # never push effective staleness past the bound.
+    inflight_window: int = 1
 
     def validate(self) -> "ClientHyperparams":
         if self.batch_size <= 0:
@@ -158,6 +166,10 @@ class ClientHyperparams:
         if not 0.0 < self.topk_fraction <= 1.0:
             raise ValueError(
                 f"topk_fraction must be in (0, 1], got {self.topk_fraction}"
+            )
+        if self.inflight_window < 1:
+            raise ValueError(
+                f"inflight_window must be >= 1, got {self.inflight_window}"
             )
         return self
 

@@ -73,7 +73,7 @@ def test_phase_accounting_accumulates(devices):
     t, _ = _trainer(n=128, bs=32, profile_phases=True)
     t.train(num_workers=2)
     assert set(t.phase_ms) == {"stage", "snapshot", "fit", "submit",
-                               "admission_wait", "drain"}
+                               "admission_wait", "pipeline_wait", "drain"}
     assert t.phase_ms["fit"] > 0
     assert t.phase_ms["stage"] > 0
     assert t.phase_ms["drain"] >= 0

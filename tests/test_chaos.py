@@ -514,3 +514,191 @@ def test_chaos_acceptance_run(tmp_path):
     assert client.reconnects >= 1, "the scripted reset must trigger a reconnect"
     assert server.duplicate_uploads >= 1, "the reset's retry must be deduped"
     assert dataset.exhausted
+
+
+# -- round-6: pipelined upload window under chaos ---------------------------
+
+
+def test_pipelined_window_chaos_exactly_once(tmp_path):
+    """Double-buffered client (``inflight_window=2``) under a seeded
+    FaultPlan throwing a connection reset, duplicate deliveries, AND a
+    scripted delay while the window is open. Three invariants:
+
+    1. exactly-once apply — every update_id applies once on the server
+       despite retries of in-window uploads (reconnect-mid-window
+       resubmission rides the server's update_id dedup);
+    2. EF-residual sequential consistency — the comm thread compresses in
+       enqueue order, so replaying the RAW per-fit gradients through a
+       fresh serial compressor reproduces both the uploaded sparse bytes
+       and the final carried residual, bit for bit;
+    3. zero orphan rounds — the trace assembler still stitches one
+       applied round per update from spans.jsonl.
+    """
+    import numpy as np
+
+    from distriflow_tpu.obs import Telemetry
+    from distriflow_tpu.obs.trace_assembler import assemble_dir
+    from distriflow_tpu.utils.serialization import deserialize_array
+
+    class RecordingModel(MockModel):
+        """MockModel that keeps a copy of every gradient it returns, in
+        fit order — the ground-truth input stream of the EF compressor.
+        (Pipelined fits run under the client's update lock, so this order
+        IS the comm thread's enqueue order.)"""
+
+        def __init__(self):
+            super().__init__()
+            self.raw_grads = []
+
+        def fit(self, x, y):
+            g = super().fit(x, y)
+            self.raw_grads.append({k: np.asarray(v).copy()
+                                   for k, v in g.items()})
+            return g
+
+    class RecordingClient(AsynchronousSGDClient):
+        """Records each distinct upload's serialized gradients in
+        first-send order — one entry per serialize_grads() call (cached
+        re-uploads reuse their update_id and are collapsed)."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.sent = {}
+            self.sent_order = []
+
+        def upload(self, msg):
+            if msg.update_id not in self.sent:
+                self.sent[msg.update_id] = msg.gradients.vars
+                self.sent_order.append(msg.update_id)
+            return super().upload(msg)
+
+    x, y = _xy(24)  # 12 batches of 2
+    dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    tel = Telemetry(save_dir=str(tmp_path))
+    server = _server(
+        tmp_path, dataset,
+        heartbeat_timeout_s=1.0,
+        telemetry=tel,
+        client_hyperparams={
+            "inflight_window": 2,
+            "gradient_compression": "topk_int8",
+            "topk_fraction": 0.5,
+        },
+    )
+    server.setup()
+    applied = []  # (update_id, serialized vars) in first-arrival order
+    server.on_upload(lambda m: applied.append((m.update_id, m.gradients.vars)))
+    client_plan = FaultPlan(
+        seed=7,
+        duplicate=0.1,
+        schedule=[
+            # reset while the window is open (uploads 1..12 keep it open
+            # almost continuously at depth 2)
+            ScriptedFault(event="uploadVars", nth=3, action="reset"),
+            # and a long delay mid-window: the fit thread keeps going
+            ScriptedFault(event="uploadVars", nth=6, action="delay",
+                          delay_s=0.3),
+        ],
+    )
+    model = RecordingModel()
+    client = RecordingClient(
+        server.address, model,
+        _client_config(
+            heartbeat_timeout_s=1.0, upload_timeout_s=1.0,
+            fault_plan=client_plan, telemetry=tel,
+        ),
+    )
+    try:
+        client.setup(timeout=10.0)
+        done = client.train_until_complete(timeout=120.0)
+    finally:
+        client.dispose()
+        server.stop()
+
+    # (1) exactly-once APPLY, from the server's obs counters. The client
+    # may legitimately fit a batch twice (a reset-requeued batch can be
+    # redelivered under a NEWER model version, missing the update cache);
+    # first-wins arbitration suppresses the extra gradient, so the apply
+    # count — the invariant that moves the model — stays exact.
+    assert done >= 12, f"expected >= 12 batches processed, got {done}"
+    # the upload callback fires once per DISTINCT update_id (a refit's
+    # fresh id included), never for a dedup-acked retry
+    assert len({uid for uid, _ in applied}) == len(applied), (
+        "an update_id was processed more than once"
+    )
+    # ...but only 12 gradients ever land: one version bump per batch
+    assert server.applied_updates == 12 and server.version_counter == 12
+    assert server.suppressed_uploads == len(applied) - 12, (
+        "every extra processed update must be a first-wins suppression"
+    )
+    assert client_plan.injected["reset"] == 1
+    assert client.reconnects >= 1
+
+    # (2) EF residual: replay the recorded raw gradients through a fresh,
+    # never-connected client configured with the same compression — the
+    # serial reference. Chaos (dupes, the reset's re-upload) must not have
+    # perturbed the residual chain: redeliveries answer from the cache and
+    # never re-enter the compressor, so sent uploads = one per fit, in fit
+    # order, each bit-identical to the serial compressor's output.
+    assert len(model.raw_grads) == len(client.sent_order) >= 12
+    ref = AsynchronousSGDClient(
+        server.address, MockModel(),
+        _client_config(hyperparams={
+            "gradient_compression": "topk_int8", "topk_fraction": 0.5,
+        }),
+    )
+    for raw, uid in zip(model.raw_grads, client.sent_order):
+        vars_ref = ref.serialize_grads(raw)
+        vars_live = client.sent[uid]
+        assert set(vars_ref) == set(vars_live)
+        for k in vars_ref:
+            np.testing.assert_array_equal(
+                deserialize_array(vars_ref[k]),
+                deserialize_array(vars_live[k]),
+                err_msg=f"pipelined upload diverged from serial EF at {k}",
+            )
+    assert set(ref._quant_error) == set(client._quant_error)
+    for k in ref._quant_error:
+        np.testing.assert_array_equal(
+            ref._quant_error[k], client._quant_error[k],
+            err_msg=f"final EF residual diverged at {k}",
+        )
+
+    # (3) assembler: one applied round per update, nothing orphaned
+    asm = assemble_dir(str(tmp_path))
+    agg = asm.attribution()
+    assert agg["applied"] == 12, agg
+    assert not asm.orphans, f"{len(asm.orphans)} orphan span(s)"
+
+
+def test_pipelined_window_one_is_the_legacy_path(tmp_path):
+    """``inflight_window=1`` (the default) must BE the serial client: the
+    comm thread never starts, and the run's final server params match a
+    default-config run bitwise."""
+    import numpy as np
+
+    def run(sub, push_window):
+        x, y = _xy(16)
+        dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+        hp = {"inflight_window": 1} if push_window else None
+        server = _server(tmp_path / sub, dataset,
+                         heartbeat_timeout_s=1.0, client_hyperparams=hp)
+        server.setup()
+        client = AsynchronousSGDClient(
+            server.address, MockModel(), _client_config(
+                heartbeat_timeout_s=1.0, upload_timeout_s=1.0))
+        try:
+            client.setup(timeout=10.0)
+            client.train_until_complete(timeout=60.0)
+        finally:
+            client.dispose()
+            server.stop()
+        assert client._comm_thread is None, (
+            "window=1 must never start the comm thread"
+        )
+        return server.model.get_params()
+
+    a = run("explicit", True)
+    b = run("default", False)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))

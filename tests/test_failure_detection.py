@@ -96,7 +96,7 @@ def test_reaped_client_batch_requeued(tmp_path):
         # a worker connects (gets batch 0 pushed), then goes silent
         sock = socket.create_connection(("127.0.0.1", server.transport.port))
         assert _wait_for(lambda: len(server._client_batches) == 1)
-        held = next(iter(server._client_batches.values()))
+        held = next(iter(server._client_batches.values()))[0]
         assert held in dataset.incomplete_batches
         assert _wait_for(lambda: server.transport.num_clients == 0), "not reaped"
         assert _wait_for(lambda: len(server._client_batches) == 0)

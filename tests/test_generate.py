@@ -485,6 +485,33 @@ def test_int8_kv_cache_decode_close_to_full_precision():
     assert np.mean(out_f == out_q) > 0.8, (out_f, out_q)
 
 
+def test_int8_kv_crossover_gates_on_decode_context():
+    """The int8-vs-bf16 crossover decides on the context a decode will
+    actually READ, not the max_seq allocation: a long-max_seq config
+    serving a short request keeps the bf16 cache (BENCH_r05 measured int8
+    slower at 1k/4k context), and generate() applies the same re-gate."""
+    from distriflow_tpu.models.generate import _gate_kv_dtype
+    from distriflow_tpu.models.transformer import INT8_KV_DECODE_CROSSOVER_SEQ
+
+    big = dataclasses.replace(CFG, max_seq=INT8_KV_DECODE_CROSSOVER_SEQ,
+                              kv_cache_dtype="int8")
+    # allocation bound says int8; a short request's read traffic says bf16
+    assert big.resolved_kv_cache_dtype == "int8"
+    assert big.kv_cache_dtype_for(1024) is None
+    assert big.kv_cache_dtype_for(INT8_KV_DECODE_CROSSOVER_SEQ) == "int8"
+    gated = _gate_kv_dtype(big, 1024)
+    assert gated.kv_cache_dtype is None
+    assert _gate_kv_dtype(big, INT8_KV_DECODE_CROSSOVER_SEQ) is big
+    # int8_force is a capacity decision — never demoted
+    forced = dataclasses.replace(big, kv_cache_dtype="int8_force")
+    assert forced.kv_cache_dtype_for(1) == "int8"
+    assert _gate_kv_dtype(forced, 1) is forced
+    # short-max_seq config: already bf16 by the allocation gate; the
+    # re-gate must not mint a new (cache-key) config for a no-op
+    short = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    assert _gate_kv_dtype(short, 8) is short
+
+
 def test_int8_kv_cache_shapes_and_validation():
     qcfg = dataclasses.replace(CFG, kv_cache_dtype="int8_force")
     params = _params(qcfg)

@@ -1,0 +1,97 @@
+"""Tier-1 CPU smoke for the round-6 double-buffered upload pipeline.
+
+One tiny pipelined async-SGD loop end to end on CPU, asserting the three
+things a broken pipeline would silently lose: overlap actually booked in
+the continuous profiler's snapshot (the comm thread ran concurrently with
+fit), exactly-once apply, and a working ``obs.dump --critical-path`` CLI
+over the run's spans (the same artifact CI operators reach for first).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.models import mnist_mlp
+from distriflow_tpu.obs import Telemetry, set_telemetry
+from distriflow_tpu.train.async_sgd import AsyncSGDTrainer
+
+
+@pytest.fixture
+def run_telemetry(tmp_path):
+    tel = Telemetry(save_dir=str(tmp_path))
+    prev = set_telemetry(tel)
+    try:
+        yield tel, str(tmp_path)
+    finally:
+        set_telemetry(prev)
+
+
+def test_pipelined_async_loop_books_overlap(devices, run_telemetry):
+    tel, run_dir = run_telemetry
+    rng = np.random.RandomState(0)
+    n = 128
+    x = rng.randn(n, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    ds = DistributedDataset(x, y, {"batch_size": 32, "epochs": 2})
+    t = AsyncSGDTrainer(
+        mnist_mlp(hidden=16), ds, learning_rate=0.05,
+        steps_per_upload=2,
+        hyperparams={"maximum_staleness": 2},
+        inflight_window=2,
+    )
+    t.init()
+    counters = t.train(num_workers=2)
+    # exactly-once: every upload the window admitted was applied exactly
+    # once (version advances once per apply), none rejected, none lost.
+    # The exact count depends on how 8 steps split across 2 workers (an
+    # odd per-worker tail flushes early), so assert the invariants.
+    assert counters["rejected"] == 0
+    assert counters["applied"] == counters["version"]
+    assert counters["applied"] >= 4
+
+    # the comm threads must have booked their submit time as OVERLAP in
+    # the profiler snapshot — zero here means the pipeline ran serial
+    snap = tel.snapshot()
+    overlap = snap["histograms"].get(
+        "phase_step_overlap_ms{role=trainer}", {})
+    assert overlap.get("sum", 0.0) > 0.0, (
+        f"no overlap booked by the pipelined trainer: {overlap}"
+    )
+    # submit time lives in the phase digest (not lost with the thread)
+    submit = snap["histograms"].get(
+        "phase_ms{phase=submit,role=trainer}", {})
+    assert submit.get("count", 0) >= 4, submit
+
+    # the critical-path CLI over this run's spans must work and attribute
+    # the pipelined rounds (exit 0 iff spans.jsonl exists and assembles)
+    proc = subprocess.run(
+        [sys.executable, "-m", "distriflow_tpu.obs.dump",
+         "--critical-path", run_dir],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "bound_by" in proc.stdout, proc.stdout
+
+
+def test_pipelined_window_clamped_by_staleness(devices):
+    """The effective window never exceeds maximum_staleness + 1 — the
+    pipeline must not manufacture staleness the bound would reject."""
+    x = np.random.RandomState(0).randn(64, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[np.arange(64) % 10]
+    ds = DistributedDataset(x, y, {"batch_size": 32, "epochs": 1})
+    t = AsyncSGDTrainer(mnist_mlp(hidden=16), ds,
+                        hyperparams={"maximum_staleness": 0},
+                        inflight_window=4)
+    assert t._effective_window() == 1
+    t2 = AsyncSGDTrainer(mnist_mlp(hidden=16), ds,
+                         hyperparams={"maximum_staleness": 8},
+                         inflight_window=2)
+    assert t2._effective_window() == 2
+    with pytest.raises(ValueError, match="inflight_window"):
+        AsyncSGDTrainer(mnist_mlp(hidden=16), ds, inflight_window=0)
