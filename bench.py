@@ -1029,6 +1029,225 @@ def bench_serving_continuous():
     }
 
 
+# -- serving: paged KV pool + prefix sharing under mixed-length traffic ----
+
+
+def bench_serving_paged_mixed(short_len=1024, long_len=8192, max_seq=16384,
+                              n_short=10, n_long=2, n_tokens=64):
+    """Mixed short/long-context clients against the SAME KV HBM budget
+    twice: the legacy slab layout (concurrency capped at ``max_slots``
+    worst-case ``max_seq`` slabs) vs the round-9 paged pool, which admits
+    on free PAGES — short requests stop reserving context they never
+    touch. Headline: peak concurrent in-flight requests, paged/slab, at
+    byte-identical KV budgets (the >= 2x acceptance bar). The second
+    wave replays the same prompts, so the prefix map's hit rate, tokens
+    saved, and peak page occupancy land in the row too."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distriflow_tpu.models.generate import pages_per_slot
+    from distriflow_tpu.models.transformer import (
+        TransformerConfig,
+        transformer_lm,
+    )
+    from distriflow_tpu.obs import get_telemetry
+    from distriflow_tpu.server import InferenceServer
+    from distriflow_tpu.utils.config import ServingConfig
+
+    if SLOW or FAST or time_left() < 150:
+        short_len, long_len, max_seq = short_len // 4, long_len // 4, max_seq // 4
+
+    rng = np.random.RandomState(0)
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=256, n_heads=4, n_layers=4, d_ff=1024,
+        max_seq=max_seq, dtype=jnp.bfloat16)
+    params = transformer_lm(cfg, example_seq=128).init(jax.random.PRNGKey(0))
+
+    SLAB_SLOTS = 3  # the equal-HBM budget: 3 worst-case max_seq slabs
+    PAGE_SIZE = 128
+    pool_pages = SLAB_SLOTS * pages_per_slot(max_seq, PAGE_SIZE)
+    n_clients = n_short + n_long
+    prompts = ([rng.randint(0, 32000, (1, short_len)).astype(np.int32)
+                for _ in range(n_short)]
+               + [rng.randint(0, 32000, (1, long_len)).astype(np.int32)
+                  for _ in range(n_long)])
+
+    def run_layout(serving):
+        server = InferenceServer(cfg, params, port=0, serving=serving)
+        server.transport.heartbeat_timeout = 0  # see bench_serving
+        server.setup()
+        peak = {"slots": 0, "occ": 0.0}
+        stop_sampler = threading.Event()
+
+        def sample():
+            while not stop_sampler.wait(0.004):
+                live = sum(1 for r in server._slot_req if r is not None)
+                peak["slots"] = max(peak["slots"], live)
+                if server._pool is not None:
+                    peak["occ"] = max(
+                        peak["occ"],
+                        server._pool.used_pages / server._pool.n_pages)
+
+        try:
+            clients = [_serving_client(server.address)
+                       for _ in range(n_clients)]
+            try:
+                def one_round():
+                    results = [None] * n_clients
+                    barrier = threading.Barrier(n_clients)
+
+                    def call(i):
+                        barrier.wait()
+                        results[i] = clients[i].generate(
+                            prompts[i], n_tokens=n_tokens)
+
+                    threads = [threading.Thread(target=call, args=(i,))
+                               for i in range(n_clients)]
+                    start = time.perf_counter()
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    assert all(r is not None for r in results)
+                    return time.perf_counter() - start
+
+                one_round()  # cold: prefill/decode compiles serialize it
+                sampler = threading.Thread(target=sample, daemon=True)
+                sampler.start()
+                wall = one_round()  # warm + prefix map primed by round 1
+                stop_sampler.set()
+                sampler.join(timeout=2.0)
+            finally:
+                for c in clients:
+                    c.close()
+        finally:
+            server.stop()
+        return wall, peak["slots"], peak["occ"]
+
+    tel = get_telemetry()
+    wall_slab, peak_slab, _ = run_layout(ServingConfig(
+        kv_layout="slab", max_slots=SLAB_SLOTS, batch_window_s=0.05))
+    hits0 = tel.counter_value("serving_prefix_hits_total")
+    saved0 = tel.counter_value("serving_prefix_tokens_saved_total")
+    wall_paged, peak_paged, occ = run_layout(ServingConfig(
+        kv_layout="paged", max_slots=n_clients + 4, page_size=PAGE_SIZE,
+        page_pool_pages=pool_pages, batch_window_s=0.05))
+    hits = tel.counter_value("serving_prefix_hits_total") - hits0
+    saved = tel.counter_value("serving_prefix_tokens_saved_total") - saved0
+
+    ratio = peak_paged / max(peak_slab, 1)
+    log(f"serving_paged_mixed: peak concurrency slab={peak_slab} "
+        f"paged={peak_paged} ({ratio:.1f}x @ {pool_pages} pages), "
+        f"wall slab={wall_slab:.1f}s paged={wall_paged:.1f}s, "
+        f"prefix hits={hits:.0f} saved={saved:.0f} tok, "
+        f"peak occupancy={occ:.2f}")
+    return {
+        "config": "serving_paged_mixed",
+        "metric": "peak concurrent requests, paged vs slab @ equal KV HBM",
+        "value": round(ratio, 2),
+        "peak_slab": peak_slab,
+        "peak_paged": peak_paged,
+        "tok_s_user_slab": round(n_tokens / wall_slab, 2),
+        "tok_s_user_paged": round(n_tokens / wall_paged, 2),
+        "page_occupancy": round(occ, 3),
+        "prefix_hit_rate": round(hits / (2.0 * n_clients), 3),
+        "prefix_tokens_saved": int(saved),
+        "traffic": f"{n_short}x{short_len}+{n_long}x{long_len}"
+                   f" (+{n_tokens} tok, max_seq {max_seq})",
+    }
+
+
+# -- long context: 16k/32k chunked prefill + decode latency ----------------
+
+
+def bench_long_context(ctxs=(16384, 32768)):
+    """Driver-record row for long-context decoding: chunked prefill
+    seconds and per-token decode latency at 16k and 32k context (B=1,
+    bf16 KV), with the implied HBM-read fraction at the largest context.
+    Prefill runs through the same _build_prefill chunk loop the serving
+    engine uses, so the number tracks what admission actually pays."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distriflow_tpu.models.generate import _build_fns, _build_prefill
+    from distriflow_tpu.models.transformer import (
+        TransformerConfig,
+        transformer_lm,
+    )
+
+    GEN = 64
+    CHUNK = 1024
+    reps = 1 if (SLOW or time_left() < 120) else 2
+    rng = np.random.RandomState(0)
+    mk_cfg = lambda s: TransformerConfig(
+        vocab_size=32000, d_model=256, n_heads=4, n_layers=4, d_ff=1024,
+        max_seq=s, dtype=jnp.bfloat16)
+    params = transformer_lm(mk_cfg(max(ctxs)), example_seq=128).init(
+        jax.random.PRNGKey(0))
+
+    HBM_PEAK_GBPS = 819.0  # v5e; the implied column is device-agnostic
+    n_layers, n_heads, d_model = 4, 4, 256
+
+    def kv_gb_per_token(s_ctx):
+        return (n_layers * n_heads * s_ctx * (d_model // n_heads)
+                * 2 * 2) / 1e9  # K+V, bf16, B=1
+
+    out = {}
+    for s_ctx in ctxs:
+        cfg = mk_cfg(s_ctx)
+        plen = s_ctx - GEN
+        prompt = jnp.asarray(rng.randint(0, 32000, (1, plen)), jnp.int32)
+        prefill, extend = _build_prefill(cfg)
+        chunk = min(CHUNK, plen)
+
+        def chunked_prefill():
+            logits, cache = prefill(params, prompt[:, :chunk])
+            for i in range(chunk, plen, chunk):
+                logits, cache = extend(params, cache, prompt[:, i:i + chunk])
+            _fetch(logits)
+            return logits, cache
+
+        logits, cache = chunked_prefill()  # compile
+        t0 = time.perf_counter()
+        logits, cache = chunked_prefill()
+        prefill_secs = time.perf_counter() - t0
+
+        _, pick, decode_steps = _build_fns(cfg, GEN, 0.0, None, None, None)
+        first = pick(logits, jax.random.PRNGKey(0)).astype(jnp.int32)
+        key = jax.random.PRNGKey(1)
+        _fetch(jax.tree.leaves(decode_steps(params, cache, first, key))[0])
+
+        def timed():
+            t0 = time.perf_counter()
+            o = decode_steps(params, cache, first, key)
+            _fetch(jax.tree.leaves(o)[0])
+            return time.perf_counter() - t0
+
+        per_tok_ms = min(timed() for _ in range(reps)) * 1e3 / (GEN - 1)
+        out[s_ctx] = (prefill_secs, per_tok_ms)
+        log(f"long_context ctx={s_ctx}: prefill {prefill_secs:.2f} s "
+            f"({plen} tok, chunk {chunk}), decode {per_tok_ms:.3f} ms/tok, "
+            f"{kv_gb_per_token(s_ctx) / (per_tok_ms / 1e3):.0f} GB/s implied")
+
+    top = max(ctxs)
+    row = {
+        "config": "long_context",
+        "metric": f"tokens/sec (decode, B=1, ctx {top // 1024}k bf16)",
+        "value": round(1e3 / out[top][1], 1),
+        "hbm_frac": round(
+            kv_gb_per_token(top) / (out[top][1] / 1e3) / HBM_PEAK_GBPS, 2),
+    }
+    for s_ctx in ctxs:
+        k = f"{s_ctx // 1024}k"
+        row[f"prefill_secs_{k}"] = round(out[s_ctx][0], 2)
+        row[f"ms_per_token_{k}"] = round(out[s_ctx][1], 3)
+    return row
+
+
 # -- decode: prefill + per-token latency at 1k/4k, bf16 + int8 -------------
 
 
@@ -1452,7 +1671,9 @@ def main() -> None:
         run(bench_moe, n_chips, matrix)  # reads the flagship row above
         run(bench_serving)
         run(bench_serving_continuous)
+        run(bench_serving_paged_mixed)
         run(bench_decode, n_chips)
+        run(bench_long_context)
     run(bench_mnist_sync, n_chips)
     run(bench_cifar_async, matrix)  # reads the cifar sync row for pct
     run(bench_fedavg)

@@ -457,6 +457,167 @@ def slot_cache(config: TransformerConfig, params, max_slots: int):
     return build(_as_dict(shapes))
 
 
+#: the per-layer cache leaves that move from [max_slots, max_seq, F]
+#: slabs to [n_pages, page_size, F] pools under the paged layout
+_POOL_LEAVES = ("cached_k", "cached_v", "k_scale", "v_scale")
+
+
+def pages_per_slot(max_seq: int, page_size: int) -> int:
+    """Logical pages a full-depth row spans: ``ceil(max_seq / page_size)``
+    — the page-table width (plus one pinned sentinel column)."""
+    return -(-max_seq // page_size)
+
+
+def paged_cache(config: TransformerConfig, params, max_slots: int,
+                page_size: int, n_pages: int):
+    """Allocate the engine's PAGED cache: like :func:`slot_cache` but
+    every K/V (and int8 scale) slab is replaced by one shared pool of
+    ``n_pages`` pages of ``page_size`` tokens, and each layer gains a
+    ``page_table`` leaf ``[max_slots, pages_per_slot + 1]`` int32 whose
+    entries start at the sentinel ``n_pages`` (no pages allocated; the
+    last column is PINNED at the sentinel so out-of-range logical
+    positions clamp onto it and their writes drop — see
+    ``models/transformer.py::_decode_attend``). The table is duplicated
+    per layer with identical values; the host updates all copies via
+    :func:`set_page_tables`."""
+    if page_size <= 0:
+        raise ValueError(f"page_size must be positive, got {page_size}")
+    if n_pages <= 0:
+        raise ValueError(f"n_pages must be positive, got {n_pages}")
+    pp = pages_per_slot(config.max_seq, page_size)
+    module = _decode_module(config)
+    dummy = jnp.zeros((max_slots, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda p: module.apply(p, dummy, mutable=["cache"])[1]["cache"],
+        params)
+
+    def build(node):
+        if hasattr(node, "items"):
+            out = {}
+            for name, sub in node.items():
+                if name == "cache_index":
+                    out[name] = jnp.zeros((max_slots,), jnp.int32)
+                    out["page_table"] = jnp.full(
+                        (max_slots, pp + 1), n_pages, jnp.int32)
+                elif name in _POOL_LEAVES:
+                    out[name] = jnp.zeros(
+                        (n_pages, page_size) + sub.shape[2:], sub.dtype)
+                else:
+                    out[name] = build(sub)
+            return out
+        return jnp.zeros(node.shape, node.dtype)
+
+    return build(_as_dict(shapes))
+
+
+def set_page_tables(cache, table):
+    """Replace every layer's ``page_table`` leaf with ``table``
+    (``[max_slots, pages_per_slot + 1]`` int32, host-authoritative) —
+    one upload covers all layers since the copies are identical."""
+    t = jnp.asarray(table, jnp.int32)
+
+    def walk(node):
+        if hasattr(node, "items"):
+            return {name: (t if name == "page_table" else walk(sub))
+                    for name, sub in node.items()}
+        return node
+
+    return walk(cache)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_paged_fns(config: TransformerConfig, page_size: int):
+    """Jit programs for the paged layout's host<->pool boundary:
+
+    - ``insert(cache, row_cache, slots, length, start, table)`` scatters
+      freshly prefilled DENSE rows (the [R, max_seq, ...] caches
+      ``prefill``/``extend`` return) into the page pool through
+      ``table`` ([max_slots, pages_per_slot+1], the host's authoritative
+      copy, written to every layer's ``page_table`` leaf in the same
+      dispatch). Only positions in ``[start, length)`` are written:
+      positions below ``start`` are prefix pages SHARED with other
+      requests (already populated, must not be re-written) and positions
+      at/above ``length`` carry no data — both are routed to a flattened
+      index past the pool so the scatter drops them.
+    - ``gather_rows(cache, tables, start)`` materializes a dense
+      solo-structured row cache ([R, max_seq, ...], scalar
+      ``cache_index = start``, NO page_table leaf) from shared prefix
+      pages, so ``extend`` can run the prompt SUFFIX through the exact
+      chunked-prefill continuation path — prefix reuse inherits the
+      solo path's numerics instead of re-proving them.
+
+    ``decode``/``pick_rows`` need no paged variants: the cache pytree's
+    own structure flips ``_decode_attend`` into paged mode, so the
+    :func:`_build_slot_fns` programs serve both layouts."""
+    max_seq = config.max_seq
+    pp = pages_per_slot(max_seq, page_size)
+
+    @jax.jit
+    def insert(cache, row_cache, slots, length, start, table):
+        row_cache = _as_dict(row_cache)
+        r = slots.shape[0]
+
+        def scatter_pool(pool, src):
+            n_pg, ps = pool.shape[0], pool.shape[1]
+            cols = jnp.broadcast_to(
+                jnp.arange(max_seq)[None, :], (r, max_seq))
+            pg = jnp.minimum(cols // ps, pp)
+            phys = table[slots][jnp.arange(r)[:, None], pg]  # [R, S]
+            live = (cols >= start) & (cols < length)
+            flat = jnp.where(live, phys * ps + cols % ps, n_pg * ps)
+            out = pool.reshape(n_pg * ps, pool.shape[-1]).at[flat].set(
+                src[:, :max_seq])
+            return out.reshape(pool.shape)
+
+        def walk(dst, src):
+            out = {}
+            for name, d in dst.items():
+                if name == "page_table":
+                    out[name] = table.astype(d.dtype)
+                elif name == "cache_index":
+                    out[name] = d.at[slots].set(
+                        jnp.broadcast_to(length, slots.shape).astype(d.dtype))
+                elif name in _POOL_LEAVES:
+                    out[name] = scatter_pool(d, src[name].astype(d.dtype))
+                elif hasattr(d, "items"):
+                    out[name] = walk(d, src[name])
+                else:
+                    out[name] = d
+            return out
+
+        return walk(cache, row_cache)
+
+    @jax.jit
+    def gather_rows(cache, tables, start):
+        def walk(node):
+            out = {}
+            for name, sub in node.items():
+                if name == "page_table":
+                    continue
+                if name == "cache_index":
+                    out[name] = jnp.asarray(start, jnp.int32)
+                elif name in _POOL_LEAVES:
+                    n_pg, ps = sub.shape[0], sub.shape[1]
+                    tab = jnp.minimum(tables[:, :pp], n_pg - 1)
+                    g = sub[tab].reshape(
+                        tables.shape[0], pp * ps, sub.shape[-1])[:, :max_seq]
+                    # zero the tail beyond the shared prefix: extend's
+                    # visibility mask never reads it, but a zeroed tail
+                    # keeps the row cache byte-identical to a fresh
+                    # prefill stopped at ``start``
+                    pos = jnp.arange(max_seq)[None, :, None]
+                    out[name] = jnp.where(pos < start, g, jnp.zeros_like(g))
+                elif hasattr(sub, "items"):
+                    out[name] = walk(sub)
+                else:
+                    out[name] = sub
+            return out
+
+        return walk(cache)
+
+    return insert, gather_rows
+
+
 @functools.lru_cache(maxsize=16)
 def _build_prefill(config: TransformerConfig):
     """Admission prefill, cached per config ALONE (unlike

@@ -391,6 +391,25 @@ class Attention(nn.Module):
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((), jnp.int32))
         idx = ci.value
+        # Paged mode (round 9): the engine swaps the per-row slabs for ONE
+        # shared pool of fixed-size pages ([n_pages, page_size, H*D]) plus
+        # a per-slot page table ([max_slots, pages_per_slot + 1] int32,
+        # last column pinned at the sentinel n_pages). The module never
+        # creates the table itself — models/generate.py::paged_cache
+        # injects it, so has_variable is a STATIC signal exactly like
+        # slot_mode below. Writes indirect through the table; a logical
+        # position past the table range, or a sentinel entry (retired or
+        # unallocated page), maps to a flattened index >= n_pages *
+        # page_size, which the scatter DROPS under jit — the same
+        # out-of-bounds contract the slab's retired-slot parking relies
+        # on. Reads gather the row's pages back into the exact
+        # [B, max_seq, ...] slab view before any score math, so every
+        # downstream shape, mask, and reduction order — and therefore
+        # every decoded bit on this path — matches the slab cache.
+        paged = self.has_variable("cache", "page_table")
+        pt = (self.variable("cache", "page_table",
+                            lambda: jnp.zeros((0, 0), jnp.int32))
+              if paged else None)
         # Slot mode (continuous batching): the engine swaps the scalar
         # cache_index for a [B] vector — each batch row is an independent
         # request at its own depth. Detected statically from the cache
@@ -407,11 +426,34 @@ class Attention(nn.Module):
 
         def _store(buf, upd):
             """Append ``upd`` [B, s, ...] at each row's own position."""
+            if paged:
+                n_pg, ps = buf.shape[0], buf.shape[1]
+                pp = pt.value.shape[1] - 1  # last column is the sentinel
+                cols = idx[:, None] + jnp.arange(s)[None, :]  # [B, s]
+                pg = jnp.minimum(cols // ps, pp)  # OOB logical -> sentinel
+                phys = pt.value[jnp.arange(b)[:, None], pg]  # [B, s]
+                flat = phys * ps + cols % ps  # sentinel -> OOB -> dropped
+                out = buf.reshape(n_pg * ps, buf.shape[-1]).at[flat].set(upd)
+                return out.reshape(buf.shape)
             if slot_mode:
                 rows = jnp.arange(b)[:, None]
                 cols = idx[:, None] + jnp.arange(s)[None, :]
                 return buf.at[rows, cols].set(upd)
             return jax.lax.dynamic_update_slice(buf, upd, (0, idx, 0))
+
+        def _view(buf):
+            """Slab-shaped [B, max_seq, F] view of every row's cache: the
+            slab IS that view; paged gathers each row's pages (sentinel
+            entries clamp to a real page — garbage the per-row visibility
+            mask turns into exact 0.0 softmax mass) and statically slices
+            to max_seq so reduction shapes match the slab bit-for-bit."""
+            if not paged:
+                return buf
+            n_pg, ps = buf.shape[0], buf.shape[1]
+            pp = pt.value.shape[1] - 1
+            tab = jnp.minimum(pt.value[:, :pp], n_pg - 1)
+            g = buf[tab]  # [B, PP, ps, F]
+            return g.reshape(b, pp * ps, buf.shape[-1])[:, :cfg.max_seq]
 
         def _quantize(t):  # t: [B, s, H*D] -> int8 + [B, s, H] scales
             tf = t.astype(jnp.float32).reshape(b, s, cfg.n_heads, head_dim)
@@ -430,17 +472,19 @@ class Attention(nn.Module):
             # dequantize in f32 and cast the PRODUCT, matching the flash
             # kernel's in-VMEM dequant — casting the scales to bf16 first
             # would diverge the two decode paths' numerics
-            keys = (ck.value.astype(jnp.float32).reshape(
+            keys = (_view(ck.value).astype(jnp.float32).reshape(
                 b, cfg.max_seq, cfg.n_heads, head_dim)
-                * sk.value[..., None]).astype(cfg.dtype)
-            vals = (cv.value.astype(jnp.float32).reshape(
+                * _view(sk.value)[..., None]).astype(cfg.dtype)
+            vals = (_view(cv.value).astype(jnp.float32).reshape(
                 b, cfg.max_seq, cfg.n_heads, head_dim)
-                * sv.value[..., None]).astype(cfg.dtype)
+                * _view(sv.value)[..., None]).astype(cfg.dtype)
         else:
             ck.value = _store(ck.value, k_tok.astype(cfg.dtype))
             cv.value = _store(cv.value, v_tok.astype(cfg.dtype))
-            keys = ck.value.reshape(b, cfg.max_seq, cfg.n_heads, head_dim)
-            vals = cv.value.reshape(b, cfg.max_seq, cfg.n_heads, head_dim)
+            keys = _view(ck.value).reshape(
+                b, cfg.max_seq, cfg.n_heads, head_dim)
+            vals = _view(cv.value).reshape(
+                b, cfg.max_seq, cfg.n_heads, head_dim)
         ci.value = idx + s
 
         if s > 1 and fresh_cache:
@@ -475,11 +519,35 @@ class Attention(nn.Module):
             # auto-enable only when the kernel can actually tile this
             # cache shape (no sublane-aligned divisor fitting VMEM ->
             # XLA fallback instead of raising mid-trace)
-            from distriflow_tpu.ops.flash_decode import supports_seq
+            from distriflow_tpu.ops.flash_decode import (
+                supports_paged,
+                supports_seq,
+            )
 
-            use_fd = _default_use_flash() and supports_seq(
-                cfg.max_seq, hd=hd,
-                kv_item=jnp.dtype(store_dtype).itemsize)
+            if paged:
+                use_fd = _default_use_flash() and supports_paged(
+                    ck.value.shape[1], hd=hd,
+                    kv_item=jnp.dtype(store_dtype).itemsize)
+            else:
+                use_fd = _default_use_flash() and supports_seq(
+                    cfg.max_seq, hd=hd,
+                    kv_item=jnp.dtype(store_dtype).itemsize)
+        if use_fd and s == 1 and paged:
+            # paged flash-decode: same recurrence, K/V tile index maps
+            # dereference the page table (second scalar-prefetch operand)
+            from distriflow_tpu.ops.flash_decode import flash_decode_paged
+
+            qf = q[:, :, 0, :]  # [B, H, D]
+            tab = pt.value[:, :-1]  # drop the pinned sentinel column
+            if quant:
+                ctx = flash_decode_paged(
+                    qf, ck.value, cv.value, tab, idx + s,
+                    k_scale=sk.value, v_scale=sv.value,
+                )
+            else:
+                ctx = flash_decode_paged(qf, ck.value, cv.value, tab, idx + s)
+            out = ctx[:, None, :, :].astype(cfg.dtype)  # [B, 1, H, D]
+            return self._o_proj()(out)
         if use_fd and s == 1:
             # flash-decode kernel: one fused full-lane pass over the
             # packed cache (online softmax in VMEM scratch); int8 scales

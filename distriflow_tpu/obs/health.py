@@ -48,8 +48,9 @@ class SLOBand:
 def default_bands(*, mfu_floor: Optional[float] = None,
                   ack_p99_ms: Optional[float] = None,
                   apply_queue_max: Optional[float] = None,
-                  slots_max: Optional[float] = None) -> List[SLOBand]:
-    """The four stock bands from docs/OBSERVABILITY.md §6; pass only the
+                  slots_max: Optional[float] = None,
+                  page_occupancy_max: Optional[float] = None) -> List[SLOBand]:
+    """The stock bands from docs/OBSERVABILITY.md §6; pass only the
     thresholds you want enforced."""
     bands: List[SLOBand] = []
     if mfu_floor is not None:
@@ -66,6 +67,12 @@ def default_bands(*, mfu_floor: Optional[float] = None,
     if slots_max is not None:
         bands.append(SLOBand("slot_occupancy", "serving_slots_active",
                              "value", {}, upper=slots_max))
+    if page_occupancy_max is not None:
+        # paged-KV pool pressure: sustained occupancy near 1.0 means
+        # admission is page-bound and the backlog is about to grow —
+        # breach dumps a flight bundle like every other band
+        bands.append(SLOBand("page_pool_pressure", "serving_page_occupancy",
+                             "value", {}, upper=page_occupancy_max))
     return bands
 
 
@@ -152,6 +159,7 @@ class FleetTable:
                 "uploads": 0, "round_ms": None, "staleness": None,
                 "quarantine_hits": 0, "resyncs": 0,
                 "up_bytes": 0, "down_bytes": 0, "_last_down_t": None,
+                "pages": 0,
             }
         return row
 
@@ -200,6 +208,13 @@ class FleetTable:
     def note_resync(self, client_id: str) -> None:
         with self._lock:
             self._row(client_id)["resyncs"] += 1
+
+    def note_pages(self, client_id: str, pages: int) -> None:
+        """Absolute KV pages a serving client currently holds across its
+        in-flight requests (0 once everything retired) — lets a soak
+        operator spot the one connection pinning the pool."""
+        with self._lock:
+            self._row(client_id)["pages"] = int(pages)
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         """JSON-able ``{client_id: row}`` (internal fields stripped)."""

@@ -49,6 +49,21 @@ BANDS: Dict[str, Dict[str, Dict[str, float]]] = {
         "down_bytes": {"warn_pct": 0.5, "regress_pct": 2.0},
         "mfu": {"warn_pct": 8.0, "regress_pct": 20.0},
     },
+    "serving_paged_mixed": {
+        # "value" is the capacity headline (concurrent requests sustained
+        # at equal KV HBM, paged / slab) and must not quietly erode;
+        # occupancy and hit-rate are diagnostics with wider slack —
+        # scheduler timing jitters them
+        "value": {"warn_pct": 5.0, "regress_pct": 15.0},
+        "prefix_hit_rate": {"warn_pct": 20.0, "regress_pct": 50.0},
+        "page_occupancy": {"warn_pct": 20.0, "regress_pct": 50.0},
+    },
+    "long_context": {
+        # prefill seconds / ms-per-token on 16k-32k prompts: chunked
+        # prefill makes these steady, but CI hosts jitter ~15%
+        "prefill_secs": {"warn_pct": 15.0, "regress_pct": 40.0},
+        "ms_per_token": {"warn_pct": 15.0, "regress_pct": 40.0},
+    },
     "cifar10_convnet_async_bounded_staleness": {
         # round-6 semantic change: floor_ms/ceiling_sps are now derived
         # from the continuous profiler's phase digests (per-upload
@@ -61,7 +76,7 @@ BANDS: Dict[str, Dict[str, Dict[str, float]]] = {
     },
 }
 
-_LOWER_BETTER_TOKENS = ("ms", "bytes", "secs", "seconds")
+_LOWER_BETTER_TOKENS = ("ms", "bytes", "secs", "seconds", "occupancy")
 
 VERDICTS = ("ok", "warn", "regress")
 

@@ -410,6 +410,198 @@ def flash_decode(
     return out.reshape(b, h, d)
 
 
+# -- Paged KV cache (round 9) ----------------------------------------------
+#
+# The continuous-batching server's paged cache replaces the [B, S, H*D]
+# per-row slabs with ONE pool of fixed-size pages [n_pages, page_size,
+# H*D] plus a per-row page table: row bi's logical KV positions
+# [j*page_size, (j+1)*page_size) live in physical page table[bi, j]. The
+# kernel below is the same online-softmax recurrence as
+# :func:`flash_decode` with block_k == page_size — the ONLY change is
+# that the K/V tile index maps dereference the page table (a second
+# scalar-prefetch operand) instead of striding contiguously. Sentinel
+# table entries (>= n_pages, unallocated tail pages) are pre-clamped to
+# the last real page on the host side; whatever garbage that tile holds
+# is masked by the row's ``valid_len`` exactly like the slab kernel
+# masks its own tail.
+#
+# Accumulation order note: the paged kernel tiles at page_size, the slab
+# kernel at pick_block_k(S) — when those differ the online-softmax adds
+# run in a different order, so paged-vs-slab flash outputs agree to
+# rounding (like slab flash vs the XLA path), not bitwise. The
+# bit-identity contract (tests/test_paged_kv.py) is carried by the XLA
+# fallback path, which gathers pages back into the exact slab view.
+# Pick page_size == pick_block_k(max_seq) to make the kernels tile
+# identically. No custom_partitioning rule yet: under TP the paged
+# kernel's operands replicate (the auto-gate only enables it unsharded);
+# TP serving keeps the slab layout for now — see docs/PERFORMANCE.md.
+
+_warned_paged: set = set()
+
+
+def supports_paged(page_size: int, hd: int = 512, kv_item: int = 2) -> bool:
+    """True when :func:`flash_decode_paged` can run pages of
+    ``page_size`` tokens at packed width ``hd``: sublane-aligned, at or
+    above the sliver-DMA floor, and one double-buffered page pair fits
+    scoped VMEM. Gated shapes bump ``ops_flash_decode_gated_total`` and
+    warn once, mirroring :func:`supports_seq`."""
+    if (page_size % 8 == 0 and page_size >= MIN_BLOCK_K
+            and _vmem_estimate_bytes(page_size, hd, kv_item)
+            <= VMEM_LIMIT_BYTES):
+        return True
+    from distriflow_tpu.obs import get_telemetry
+
+    get_telemetry().counter("ops_flash_decode_gated_total").inc()
+    key = (page_size, hd, kv_item)
+    if key not in _warned_paged:
+        _warned_paged.add(key)
+        warnings.warn(
+            f"flash_decode_paged gated off for page_size {page_size} "
+            f"(packed width {hd}, itemsize {kv_item}): pages must be a "
+            f"multiple of 8, >= {MIN_BLOCK_K}, and fit scoped VMEM — "
+            "decoding on the XLA fallback path. Use page_size 128 (the "
+            "flash-decode block floor) or larger.",
+            stacklevel=3)
+    return False
+
+
+def _paged_kernel(tab_ref, len_ref, qbd_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, page_size, n_kv, h):
+    j = pl.program_id(1)
+    _init_scratch(j, m_ref, l_ref, acc_ref)
+    d = k_ref.shape[-1] // h
+    s2 = _qk_scores(qbd_ref, k_ref[0].astype(jnp.bfloat16), d)
+    _attend_tile(len_ref[pl.program_id(0)], v_ref[0].astype(jnp.bfloat16),
+                 o_ref, m_ref, l_ref, acc_ref, j, n_kv, page_size, h, s2)
+
+
+def _paged_kernel_quant(tab_ref, len_ref, qbd_ref, qs_ref, k_ref, ks_ref,
+                        v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                        page_size, n_kv, h):
+    j = pl.program_id(1)
+    _init_scratch(j, m_ref, l_ref, acc_ref)
+    d = k_ref.shape[-1] // h
+    s_i32 = jax.lax.dot_general(
+        k_ref[0], qbd_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)  # s8 MXU, like _decode_kernel_quant
+    scale = 1.0 / (d ** 0.5)
+    s2 = s_i32.astype(jnp.float32) * ks_ref[0] * (qs_ref[0] * scale)
+    _attend_tile(len_ref[pl.program_id(0)], v_ref[0].astype(jnp.bfloat16),
+                 o_ref, m_ref, l_ref, acc_ref, j, n_kv, page_size, h, s2,
+                 p_scale=vs_ref[0])
+
+
+def flash_decode_paged(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    page_table: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Decode attention against a PAGED cache, one query token per row.
+
+    ``q``: [B, H, D]; ``k``/``v``: page pools ``[n_pages, page_size,
+    H*D]`` (bf16/f32, or int8 with ``k_scale``/``v_scale``
+    ``[n_pages, page_size, H]`` f32 pools); ``page_table``: [B, PP]
+    int32 — row bi reads physical page ``page_table[bi, j]`` for its
+    j-th logical page (entries >= n_pages are sentinels: clamped to a
+    real page whose contents the length mask discards); ``valid_len``:
+    scalar or [B] per-row window, same contract as :func:`flash_decode`.
+    Returns [B, H, D] in ``q``'s dtype."""
+    interpret = _resolve_interpret(interpret)
+    b, h, d = q.shape
+    n_pages, ps, hd = k.shape
+    if hd != h * d:
+        raise ValueError(
+            f"packed pool feature dim {hd} != n_heads*head_dim {h * d}")
+    if ps % 8 and not interpret:
+        raise ValueError(
+            f"page_size {ps} must be a multiple of 8 (TPU sublane)")
+    quant = k_scale is not None
+    kv_item = jnp.dtype(k.dtype).itemsize
+    est = _vmem_estimate_bytes(ps, hd, kv_item)
+    if not interpret and est > VMEM_LIMIT_BYTES:
+        raise ValueError(
+            f"flash_decode_paged: estimated scoped-VMEM {est / 1e6:.1f} MB "
+            f"for page_size={ps}, packed dim {hd} exceeds the "
+            f"{VMEM_LIMIT_BYTES / 1e6:.0f} MB TPU limit — shrink page_size")
+    n_kv = page_table.shape[1]
+    # pre-clamp sentinels so the index map is a plain table read
+    tab = jnp.minimum(page_table.astype(jnp.int32), n_pages - 1)
+    lens = jnp.broadcast_to(
+        jnp.reshape(valid_len.astype(jnp.int32), (-1,)), (b,))
+
+    eye = jnp.eye(h, dtype=jnp.float32)
+    qf32 = q.astype(jnp.float32)
+    if quant:
+        qs = jnp.max(jnp.abs(qf32), axis=-1, keepdims=True) / 127.0
+        qs = jnp.maximum(qs, 1e-20)  # [B, H, 1]
+        q8 = jnp.clip(jnp.round(qf32 / qs), -127, 127)
+        qbd = jnp.einsum("bhd,hg->bhdg", q8, eye).reshape(
+            b, hd, h).astype(jnp.int8)
+        qs_row = qs[:, :, 0][:, None, :]  # [B, 1, H]
+    else:
+        qbd = jnp.einsum("bhd,hg->bhdg", qf32, eye).reshape(
+            b, hd, h).astype(jnp.bfloat16)
+
+    # index maps receive (grid indices..., tab_ref, len_ref): K/V tiles
+    # dereference the page table — THE paged indirection
+    in_specs = [
+        pl.BlockSpec((1, hd, h), lambda bi, j, tab, lens: (bi, 0, 0)),
+    ]
+    arrays = [qbd]
+    if quant:
+        in_specs.append(
+            pl.BlockSpec((1, 1, h), lambda bi, j, tab, lens: (bi, 0, 0)))
+        arrays.append(qs_row)
+    in_specs.append(
+        pl.BlockSpec((1, ps, hd), lambda bi, j, tab, lens: (tab[bi, j], 0, 0)))
+    arrays.append(k)
+    if quant:
+        in_specs.append(
+            pl.BlockSpec((1, ps, h),
+                         lambda bi, j, tab, lens: (tab[bi, j], 0, 0)))
+        arrays.append(k_scale)
+    in_specs.append(
+        pl.BlockSpec((1, ps, hd), lambda bi, j, tab, lens: (tab[bi, j], 0, 0)))
+    arrays.append(v)
+    if quant:
+        in_specs.append(
+            pl.BlockSpec((1, ps, h),
+                         lambda bi, j, tab, lens: (tab[bi, j], 0, 0)))
+        arrays.append(v_scale)
+
+    kernel = (
+        functools.partial(_paged_kernel_quant, page_size=ps, n_kv=n_kv, h=h)
+        if quant else
+        functools.partial(_paged_kernel, page_size=ps, n_kv=n_kv, h=h)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_kv),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, hd),
+                                   lambda bi, j, tab, lens: (bi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((1, h), jnp.float32),
+                pltpu.VMEM((1, h), jnp.float32),
+                pltpu.VMEM((1, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, 1, hd), q.dtype),
+        compiler_params=pallas_tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(tab, lens, *arrays)
+    return out.reshape(b, h, d)
+
+
 # -- GSPMD partitioning ----------------------------------------------------
 #
 # Decode attention is HEAD-independent: each head attends to its own slice

@@ -58,10 +58,11 @@ shardings (heads-sharded KV cache, psum'd o_proj; see
 
 from __future__ import annotations
 
+import hashlib
 import queue as queue_mod
 import threading
 import time as time_mod
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import jax
@@ -69,16 +70,20 @@ import numpy as np
 
 from distriflow_tpu.comm.transport import ServerTransport
 from distriflow_tpu.models.generate import (
+    _build_paged_fns,
     _build_prefill,
     _build_slot_fns,
     _check_fits,
     beam_search,
     generate,
+    paged_cache,
+    pages_per_slot,
     sequence_logprob,
+    set_page_tables,
     slot_cache,
 )
 from distriflow_tpu.models.transformer import TransformerConfig
-from distriflow_tpu.obs import get_telemetry
+from distriflow_tpu.obs import FleetTable, get_telemetry
 from distriflow_tpu.utils.config import ServingConfig
 from distriflow_tpu.utils.logging import VerboseLogger
 from distriflow_tpu.utils.serialization import (
@@ -101,7 +106,7 @@ class _Request:
     __slots__ = (
         "prompt", "n_tokens", "temperature", "top_k", "top_p", "eos",
         "seed", "client_id", "enq_t", "admit_t", "rows_out", "rows_left",
-        "cancelled", "done", "result", "error",
+        "cancelled", "done", "result", "error", "page_plan",
     )
 
     def __init__(self, prompt: np.ndarray, n_tokens: int, temperature: float,
@@ -123,6 +128,66 @@ class _Request:
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[Exception] = None
+        # paged layout: per-row page reservation ({"shared", "owned",
+        # "hashes", "committed"}), made when the admission gate accepts
+        # the request and released either at slot retirement (committed)
+        # or by _release_plan (admission failure)
+        self.page_plan: Optional[List[Dict[str, Any]]] = None
+
+
+class _PagePool:
+    """Host-side allocator for the paged KV cache's physical pages.
+
+    Pure bookkeeping — the device never sees this object, only the page
+    tables it produces. ``alloc`` hands out free pages at refcount 1;
+    ``ref``/``unref`` move shared prefix pages between owners (the
+    prefix map holds its own reference, so a page stays warm after its
+    original request retires until pool pressure evicts it). All methods
+    run on the single scheduler thread; no locking needed."""
+
+    def __init__(self, n_pages: int):
+        self.n_pages = n_pages
+        self._free: List[int] = list(range(n_pages - 1, -1, -1))
+        self._refs = np.zeros((n_pages,), np.int32)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return int(self._refs[page])
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: need {n}, have {len(self._free)}")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def ref(self, pages: List[int]) -> None:
+        for p in pages:
+            if self._refs[p] <= 0:
+                raise RuntimeError(f"ref of free page {p}")
+            self._refs[p] += 1
+
+    def unref(self, pages: List[int]) -> int:
+        """Drop one reference per page; returns how many hit zero and
+        went back on the free list."""
+        freed = 0
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+                freed += 1
+            elif self._refs[p] < 0:
+                raise RuntimeError(f"unref of free page {p}")
+        return freed
 
 
 def _prompt_from(payload: Dict[str, Any], limit: Optional[int] = None) -> np.ndarray:
@@ -191,6 +256,23 @@ class InferenceServer:
         self._slot_req: List[Optional[_Request]] = [None] * s
         self._slot_row = np.zeros((s,), np.int32)
         self._slot_emitted = np.zeros((s,), np.int64)
+        # paged KV layout (round 9; kv_layout="slab" keeps the legacy
+        # worst-case slabs as the bit-identity oracle). The host owns the
+        # authoritative page table; every mutation marks it dirty and the
+        # next insert/decode dispatch re-uploads it, so a retired slot's
+        # frozen writes can never land in a page the pool has re-issued.
+        self._paged = self.serving.kv_layout == "paged"
+        self._pp = pages_per_slot(config.max_seq, self.serving.page_size)
+        self._n_pages = self.serving.pool_pages(config.max_seq)
+        self._pool = _PagePool(self._n_pages) if self._paged else None
+        self._tables = np.full((s, self._pp + 1), self._n_pages, np.int32)
+        self._tables_dirty = False
+        self._slot_pages: List[List[int]] = [[] for _ in range(s)]
+        # prefix-reuse map: chain hash of a prompt's j-th full page ->
+        # physical page id. The map holds one reference per entry;
+        # insertion order doubles as LRU (move_to_end on hit), and pool
+        # pressure evicts from the cold end.
+        self._prefix_map: "OrderedDict[bytes, int]" = OrderedDict()
         # serving metrics (contract table in docs/OBSERVABILITY.md §1)
         tel = telemetry if telemetry is not None else get_telemetry()
         self._m_batches = tel.counter("serving_decode_batches_total")
@@ -199,10 +281,22 @@ class InferenceServer:
         self._m_slots = tel.gauge("serving_slots_active")
         self._m_qwait = tel.histogram("serving_queue_wait_ms")
         self._m_tpot = tel.histogram("serving_time_per_output_token_ms")
+        self._m_pages = tel.gauge("serving_page_occupancy")
+        self._m_prefix_hits = tel.counter("serving_prefix_hits_total")
+        self._m_prefix_tokens = tel.counter(
+            "serving_prefix_tokens_saved_total")
+        self._m_pages_alloc = tel.counter("serving_pages_allocated_total")
+        self._m_pages_freed = tel.counter("serving_pages_released_total")
         # continuous phase profiler (docs/OBSERVABILITY.md §5): serving
         # records phases only — the engine loop mostly idles in _gather, so
         # a per-iteration step() would drown the digests in idle wall time
         self._prof = tel.profiler("serving")
+        # fleet rows for the serving side: under the paged layout each
+        # client's row carries the KV pages it currently holds, so a soak
+        # operator can spot the connection pinning the pool
+        self.fleet = FleetTable()
+        self._tel = tel
+        tel.register_fleet(id(self), self.fleet.snapshot)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -231,6 +325,7 @@ class InferenceServer:
         # and _stopped landing in its view; sweep once more so no waiter is
         # left to the 600 s backstop
         self._drain_and_error()
+        self._tel.unregister_fleet(id(self))
 
     @property
     def address(self) -> str:
@@ -275,6 +370,7 @@ class InferenceServer:
         with self._inflight_lock:
             for req in self._inflight.get(client_id, ()):
                 req.cancelled = True
+        self.fleet.disconnect(client_id)
 
     def _on_generate(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         prompt = _prompt_from(payload, self._prompt_cap())
@@ -340,6 +436,10 @@ class InferenceServer:
             if item.admit_t is not None:
                 meta["queue_ms"] = round(
                     (item.admit_t - item.enq_t) * 1000.0, 3)
+            if item.page_plan is not None:
+                saved = sum(len(p["shared"]) for p in item.page_plan)
+                if saved:
+                    meta["prefix_tokens"] = saved * self.serving.page_size
         else:
             with self._device_lock, self.logger.time(
                 f"generate[{prompt.shape[0]}x{prompt.shape[1]}+{n_tokens}]"
@@ -406,11 +506,134 @@ class InferenceServer:
                 return True
             self._backlog.append(nxt)
 
+    # -- paged-layout bookkeeping (scheduler thread only) ------------------
+
+    def _pages_needed(self, plen: int, n_tokens: int) -> int:
+        """Logical pages one row holds over its FULL horizon, reserved up
+        front so a live row can never hit mid-decode pool exhaustion:
+        prompt plus generated tokens, rounded up to the chunk boundary
+        (a row frozen at eos keeps appending until retirement)."""
+        chunk = self.serving.decode_chunk
+        written = plen
+        if n_tokens > 1:
+            written += -(-(n_tokens - 1) // chunk) * chunk
+        ps = self.serving.page_size
+        return min(-(-written // ps), self._pp)
+
+    def _row_plan(self, tokens: np.ndarray) -> Tuple[List[int], List[bytes]]:
+        """(shared leading pages, per-page chain hashes) for one prompt
+        row. Hash j covers pages 0..j, so a hit guarantees the whole
+        prefix matches, not just page j. Shareable pages cap at
+        ``(plen - 1) // page_size``: at least one suffix token must run
+        through prefill/extend to produce the first-token logits."""
+        ps = self.serving.page_size
+        hashes: List[bytes] = []
+        shared: List[int] = []
+        if not self.serving.prefix_sharing:
+            return shared, hashes
+        h = b""
+        for j in range((len(tokens) - 1) // ps):
+            h = hashlib.sha1(
+                h + tokens[j * ps:(j + 1) * ps].tobytes()).digest()
+            hashes.append(h)
+        for hj in hashes:
+            pg = self._prefix_map.get(hj)
+            if pg is None:
+                break
+            shared.append(pg)
+            self._prefix_map.move_to_end(hj)
+        return shared, hashes
+
+    def _evict_prefix(self, shortfall: int) -> None:
+        """Drop cold prefix-map entries until ``shortfall`` pages came
+        free or the map is empty. An entry whose page other requests
+        still reference is dropped from the map without freeing the
+        page — it stops being discoverable, nothing more."""
+        while shortfall > 0 and self._prefix_map:
+            _h, pg = self._prefix_map.popitem(last=False)
+            shortfall -= self._pool.unref([pg])
+
+    def _reserve(self, req: _Request) -> bool:
+        """THE paged admission gate: plan every row's pages (prefix hits
+        first, owned pages for the rest of the full horizon) and commit
+        the reservation. False = not enough free pages even after
+        evicting cold prefix entries — the caller keeps FIFO order by
+        blocking on this head rather than skipping it."""
+        plen = req.prompt.shape[1]
+        need = self._pages_needed(plen, req.n_tokens)
+        plans: List[Dict[str, Any]] = []
+        for row in range(req.prompt.shape[0]):
+            shared, hashes = self._row_plan(req.prompt[row])
+            plans.append({"shared": shared, "hashes": hashes,
+                          "owned": None, "committed": False})
+        # ref shared pages FIRST so eviction below can never free them
+        for plan in plans:
+            self._pool.ref(plan["shared"])
+        total_owned = sum(need - len(p["shared"]) for p in plans)
+        if total_owned > self._pool.free_pages:
+            self._evict_prefix(total_owned - self._pool.free_pages)
+        if total_owned > self._pool.free_pages:
+            for plan in plans:
+                self._pool.unref(plan["shared"])
+            return False
+        for plan in plans:
+            plan["owned"] = self._pool.alloc(need - len(plan["shared"]))
+            if plan["shared"]:
+                self._m_prefix_hits.inc()
+                self._m_prefix_tokens.inc(
+                    len(plan["shared"]) * self.serving.page_size)
+            self._m_pages_alloc.inc(len(plan["shared"]) + len(plan["owned"]))
+        req.page_plan = plans
+        return True
+
+    def _release_plan(self, plan: Optional[Dict[str, Any]]) -> None:
+        """Return an UNCOMMITTED row reservation to the pool (admission
+        failed before the row reached a slot). Committed plans are owned
+        by their slot and released by :meth:`_retire_slot`."""
+        if plan is None or plan["committed"]:
+            return
+        pages = plan["shared"] + plan["owned"]
+        self._pool.unref(pages)
+        self._m_pages_freed.inc(len(pages))
+        plan["committed"] = True  # never release twice
+
+    def _register_prefix(self, plan: Dict[str, Any]) -> None:
+        """Publish a freshly admitted row's full prompt pages into the
+        prefix map (each new entry takes its own pool reference)."""
+        pages = plan["shared"] + plan["owned"]
+        for j, hj in enumerate(plan["hashes"]):
+            if hj not in self._prefix_map:
+                self._pool.ref([pages[j]])
+                self._prefix_map[hj] = pages[j]
+            else:
+                self._prefix_map.move_to_end(hj)
+
+    def _note_occupancy(self) -> None:
+        if self._pool is not None:
+            self._m_pages.set(self._pool.used_pages / self._n_pages)
+
+    def _note_client_pages(self, client_id: str) -> None:
+        """Refresh one connection's fleet row with the KV pages its live
+        slots currently hold (0 once everything retired)."""
+        held = sum(
+            len(self._slot_pages[s])
+            for s, r in enumerate(self._slot_req)
+            if r is not None and r.client_id == client_id)
+        self.fleet.note_pages(client_id, held)
+
     def _admit(self) -> None:
         """Move backlog requests into free slots (strict FIFO — a wide
         request blocks later ones rather than being starved), prefill
-        grouped by prompt length, scatter into the cache, emit first
-        tokens, retire rows already finished (n_tokens=1 or instant eos)."""
+        grouped by prompt length (and shared-prefix depth under the
+        paged layout), scatter into the cache, emit first tokens, retire
+        rows already finished (n_tokens=1 or instant eos).
+
+        Under the paged layout admission is gated on FREE PAGES, not on
+        worst-case slots: a request enters when its rows fit the slot
+        batch axis AND its full-horizon page reservation fits the pool —
+        short requests no longer reserve ``max_seq`` positions they will
+        never touch, which is where the mixed 1k/16k capacity win comes
+        from (docs/PERFORMANCE.md)."""
         admit: List[_Request] = []
         free = sum(1 for r in self._slot_req if r is None)
         while self._backlog:
@@ -420,6 +643,8 @@ class InferenceServer:
                 self._finish_error(head, RuntimeError("client disconnected"))
                 continue
             if head.prompt.shape[0] > free:
+                break
+            if self._paged and not self._reserve(head):
                 break
             free -= head.prompt.shape[0]
             admit.append(self._backlog.popleft())
@@ -431,40 +656,80 @@ class InferenceServer:
         with self._prof.phase("admission"):
             if self._slot_cache is None:
                 with self._device_lock:
-                    self._slot_cache = slot_cache(
-                        self.config, self.params, self.serving.max_slots)
+                    if self._paged:
+                        self._slot_cache = paged_cache(
+                            self.config, self.params,
+                            self.serving.max_slots,
+                            self.serving.page_size, self._n_pages)
+                    else:
+                        self._slot_cache = slot_cache(
+                            self.config, self.params, self.serving.max_slots)
             now = time_mod.monotonic()
-            groups: Dict[int, List[Tuple[_Request, int]]] = {}
+            # group key: (prompt length, shared-prefix tokens) — rows with
+            # the same plen but different prefix depths run different
+            # suffix lengths through prefill/extend, so they cannot share
+            # a dispatch. The slab layout always groups at depth 0.
+            groups: Dict[Tuple[int, int], List[Tuple[_Request, int]]] = {}
+            ps = self.serving.page_size
             for req in admit:
                 req.admit_t = now
                 self._m_qwait.observe((now - req.enq_t) * 1000.0)
                 for row in range(req.prompt.shape[0]):
+                    shared_len = 0
+                    if self._paged and req.page_plan is not None:
+                        shared_len = len(req.page_plan[row]["shared"]) * ps
                     groups.setdefault(
-                        req.prompt.shape[1], []).append((req, row))
-            for plen, members in sorted(groups.items()):
+                        (req.prompt.shape[1], shared_len), []).append(
+                            (req, row))
+            for (plen, shared_len), members in sorted(groups.items()):
                 try:
-                    self._admit_group(plen, members)
+                    self._admit_group(plen, shared_len, members)
                 except Exception as e:
                     # contain a failed prefill to its own group: any slots
                     # the group already claimed stay unrecorded (free), so
-                    # the next insert simply overwrites those cache rows
+                    # the next insert simply overwrites those cache rows;
+                    # under the paged layout uncommitted reservations go
+                    # back to the pool and claimed table rows re-sentinel
+                    if self._paged:
+                        for req, row in members:
+                            if req.page_plan is not None:
+                                self._release_plan(req.page_plan[row])
+                        for s, r in enumerate(self._slot_req):
+                            if r is None:
+                                self._tables[s, :] = self._n_pages
+                        self._tables_dirty = True
                     for req in {id(r): r for r, _ in members}.values():
                         self._finish_error(req, e)
             self.batched_requests += len(admit)
             self._m_admitted.inc(len(admit))
             self._m_slots.set(
                 sum(1 for r in self._slot_req if r is not None))
+            self._note_occupancy()
 
-    def _admit_group(self, plen: int, members: List[Tuple[_Request, int]]) -> None:
+    def _admit_group(self, plen: int, shared_len: int,
+                     members: List[Tuple[_Request, int]]) -> None:
         """Prefill + insert + first-token for all rows of one prompt
-        length. The batch axis is padded to a power-of-two bucket (repeat
-        row 0) so arbitrary admission sizes don't each compile a fresh XLA
-        program — same rationale as the round-3 batcher; padded scatter
-        indices point one past the last slot, which JAX's FILL_OR_DROP
-        scatter mode silently drops."""
+        length (and, under the paged layout, one shared-prefix depth).
+
+        Slab layout: the batch axis is padded to a power-of-two bucket
+        (repeat row 0) so arbitrary admission sizes don't each compile a
+        fresh XLA program — same rationale as the round-3 batcher; padded
+        scatter indices point one past the last slot, which JAX's
+        FILL_OR_DROP scatter mode silently drops.
+
+        Paged layout: groups run at EXACT size — admission is already
+        gated on free pages rather than worst-case slot reservations, so
+        the bucketing that existed to bound recompiles of huge slab
+        scatters is retired here (retrace cost is one prefill trace per
+        distinct group shape, and the page scatter is length-indexed, not
+        slot-count-indexed). Rows with ``shared_len > 0`` skip prefill of
+        the shared prefix entirely: their page tables already point at
+        the shared pages, so we gather those rows into dense row caches
+        and run ``extend`` over just the suffix — same chunked-prefill
+        continuation the slab path uses past ``prefill_chunk``."""
         srv = self.serving
         n = len(members)
-        bucket = 1 << (n - 1).bit_length()
+        bucket = n if self._paged else 1 << (n - 1).bit_length()
         stacked = np.stack([req.prompt[row] for req, row in members])
         free_ids = [i for i, r in enumerate(self._slot_req) if r is None]
         slots = np.array(free_ids[:n], np.int32)
@@ -488,19 +753,45 @@ class InferenceServer:
         prefill, extend = _build_prefill(self.config)
         insert, pick_rows, _ = _build_slot_fns(
             self.config, srv.decode_chunk, sampling)
+        if self._paged:
+            insert_paged, gather_rows = _build_paged_fns(
+                self.config, srv.page_size)
+            for j, (req, row) in enumerate(members):
+                plan = req.page_plan[row]
+                pages = plan["shared"] + plan["owned"]
+                s = int(slots[j])
+                self._tables[s, :] = self._n_pages
+                self._tables[s, :len(pages)] = pages
         with self._prof.phase("prefill"), self._device_lock, self.logger.time(
             f"admit[{n}->{bucket}x{plen}]"
         ):
             pc = srv.prefill_chunk
-            if pc is None or pc >= plen:
+            if shared_len > 0:
+                row_cache = gather_rows(
+                    self._slot_cache, self._tables[slots],
+                    np.int32(shared_len))
+                logits = None
+                for i in range(shared_len, plen, pc or plen):
+                    logits, row_cache = extend(
+                        self.params, row_cache,
+                        stacked[:, i:i + (pc or plen)])
+            elif pc is None or pc >= plen:
                 logits, row_cache = prefill(self.params, stacked)
             else:
                 logits, row_cache = prefill(self.params, stacked[:, :pc])
                 for i in range(pc, plen, pc):
                     logits, row_cache = extend(
                         self.params, row_cache, stacked[:, i:i + pc])
-            self._slot_cache = insert(
-                self._slot_cache, row_cache, slots, np.int32(plen))
+            if self._paged:
+                self._slot_cache = insert_paged(
+                    self._slot_cache, row_cache, slots, np.int32(plen),
+                    np.int32(shared_len), self._tables.copy())
+                # insert carries the FULL host table to the device, so any
+                # pending sentinel edits from retired slots ride along
+                self._tables_dirty = False
+            else:
+                self._slot_cache = insert(
+                    self._slot_cache, row_cache, slots, np.int32(plen))
             first = np.asarray(pick_rows(
                 logits, temps, top_ks, top_ps, seeds,
                 np.full((bucket,), plen, np.int32)))[:n]
@@ -509,6 +800,12 @@ class InferenceServer:
             self._slot_req[s] = req
             self._slot_row[s] = row
             self._slot_emitted[s] = 1
+            if self._paged:
+                plan = req.page_plan[row]
+                plan["committed"] = True
+                self._slot_pages[s] = plan["shared"] + plan["owned"]
+                self._register_prefix(plan)
+                self._note_client_pages(req.client_id)
             self._tok[s] = first[j]
             self._temps[s] = temps[j]
             self._top_ks[s] = top_ks[j]
@@ -549,6 +846,15 @@ class InferenceServer:
                 self.config, srv.decode_chunk, sampling)
             t0 = time_mod.monotonic()
             with self._device_lock:
+                if (self._paged and self._tables_dirty
+                        and self._slot_cache is not None):
+                    # retired slots re-sentineled their table rows on the
+                    # host; push the table before dispatch so a frozen
+                    # row's continued appends drop instead of landing in
+                    # pages the pool may already have re-issued
+                    self._slot_cache = set_page_tables(
+                        self._slot_cache, self._tables.copy())
+                    self._tables_dirty = False
                 cache, tok, done, toks = decode(
                     self.params, self._slot_cache, self._tok, self._done,
                     self._temps, self._top_ks, self._top_ps, self._seeds,
@@ -609,12 +915,27 @@ class InferenceServer:
         """Park a slot: frozen (done=True, eos filler 0) so the decode
         scan leaves it inert; its cache row is fully overwritten by the
         next insert, and any writes past max_seq are dropped by the
-        scatter's FILL_OR_DROP mode."""
+        scatter's FILL_OR_DROP mode. Under the paged layout the slot's
+        pages go back to the pool immediately (shared pages just drop a
+        reference) and the slot's table row re-sentinels so the frozen
+        row's writes land nowhere — the device table catches up at the
+        next insert or decode dispatch (``_tables_dirty``)."""
         with self._prof.phase("retire"):
+            req = self._slot_req[s]
             self._slot_req[s] = None
             self._done[s] = True
             self._temps[s] = 0.0
             self._eos[s] = -1
+            if self._paged and self._slot_pages[s]:
+                pages = self._slot_pages[s]
+                self._slot_pages[s] = []
+                self._pool.unref(pages)
+                self._m_pages_freed.inc(len(pages))
+                self._tables[s, :] = self._n_pages
+                self._tables_dirty = True
+                self._note_occupancy()
+                if req is not None:
+                    self._note_client_pages(req.client_id)
 
     def _finish_error(self, req: _Request, err: Exception) -> None:
         if not req.done.is_set():
@@ -632,6 +953,22 @@ class InferenceServer:
                     pass
                 if not lst:
                     self._inflight.pop(req.client_id, None)
+
+    def release_prefix_cache(self) -> int:
+        """Drop every prefix-map reference and return how many pool pages
+        that actually freed. Map references are bookkeeping the server
+        holds on its own behalf — they are excluded from the request
+        allocate/release counters, so after a full drain plus this flush
+        ``serving_pages_allocated_total == serving_pages_released_total``
+        and the pool is back to all-free (the chaos reclamation test and
+        the paged bench reconcile on exactly that identity)."""
+        freed = 0
+        if self._paged:
+            while self._prefix_map:
+                _h, pg = self._prefix_map.popitem(last=False)
+                freed += self._pool.unref([pg])
+            self._note_occupancy()
+        return freed
 
     def _abort_all(self, err: Exception) -> None:
         """Device failure mid-engine: error every waiter (active slots and

@@ -332,6 +332,18 @@ class ServingConfig:
     running batch for its full length. ``batch_window_s`` /
     ``max_prompt_batch`` default to ``None`` = "use the module-level
     constants at call time" (which existing tests monkeypatch).
+
+    ``kv_layout`` selects the KV cache organisation: ``"paged"`` (default)
+    allocates a single pool of ``page_pool_pages`` pages of ``page_size``
+    tokens each, indirected through per-slot page tables, so a request
+    holds only the pages its context fills; ``"slab"`` keeps the legacy
+    ``[max_slots, max_seq, ...]`` worst-case slab (retained for one
+    release as the bit-identity oracle). ``page_pool_pages=None`` sizes
+    the pool to the slab's HBM budget (``max_slots * ceil(max_seq /
+    page_size)`` pages) so paged-vs-slab comparisons are equal-memory by
+    construction. ``prefix_sharing`` lets requests whose prompts share
+    full leading pages pin the same read-only pages (refcounted,
+    copy-on-write on divergence).
     """
 
     max_slots: int = 8
@@ -339,6 +351,17 @@ class ServingConfig:
     prefill_chunk: Optional[int] = None
     batch_window_s: Optional[float] = None
     max_prompt_batch: Optional[int] = None
+    kv_layout: str = "paged"
+    page_size: int = 128
+    page_pool_pages: Optional[int] = None
+    prefix_sharing: bool = True
+
+    def pool_pages(self, max_seq: int) -> int:
+        """Resolved pool size in pages: explicit override or the
+        slab-equivalent HBM budget."""
+        if self.page_pool_pages is not None:
+            return self.page_pool_pages
+        return self.max_slots * (-(-max_seq // self.page_size))
 
     def validate(self) -> "ServingConfig":
         if self.max_slots <= 0:
@@ -355,6 +378,14 @@ class ServingConfig:
         if self.max_prompt_batch is not None and self.max_prompt_batch <= 0:
             raise ValueError(
                 f"max_prompt_batch must be positive when set, got {self.max_prompt_batch}")
+        if self.kv_layout not in ("paged", "slab"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'slab', got {self.kv_layout!r}")
+        if self.page_size <= 0:
+            raise ValueError(f"page_size must be positive, got {self.page_size}")
+        if self.page_pool_pages is not None and self.page_pool_pages <= 0:
+            raise ValueError(
+                f"page_pool_pages must be positive when set, got {self.page_pool_pages}")
         return self
 
 
