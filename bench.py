@@ -768,6 +768,86 @@ def bench_fedavg():
     }
 
 
+def bench_obs_overhead():
+    """Fleet-plane overhead row: the SAME loopback async-CIFAR smoke run
+    twice — telemetry + report shipping fully on (tiny report interval,
+    so ~every upload carries one) vs fully off — and the per-round delta
+    pinned in the ledger (docs/OBSERVABILITY.md §10). The report path is
+    snapshot-diff + JSON on the upload metadata, so the honest budget is
+    ~a millisecond; the band is wide because loopback rounds on a shared
+    CPU host jitter far more than that."""
+    import jax
+    import numpy as np
+
+    from distriflow_tpu.client.abstract_client import DistributedClientConfig
+    from distriflow_tpu.client.async_client import AsynchronousSGDClient
+    from distriflow_tpu.data.dataset import DistributedDataset
+    from distriflow_tpu.models import cifar_convnet
+    from distriflow_tpu.models.base import SpecModel
+    from distriflow_tpu.obs import Telemetry
+    from distriflow_tpu.server.abstract_server import DistributedServerConfig
+    from distriflow_tpu.server.async_server import AsynchronousSGDServer
+    from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+    B = 32
+    n_batches = 6 if (FAST or SLOW) else 12
+    rng = np.random.RandomState(0)
+    x = rng.randn(n_batches * B, 32, 32, 3).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n_batches * B)]
+
+    def one_run(obs_on):
+        tel_s = Telemetry(enabled=obs_on)
+        tel_c = Telemetry(enabled=obs_on)
+        dataset = DistributedDataset(x, y, {"batch_size": B, "epochs": 1})
+        client_model = SpecModel(cifar_convnet(), rng=jax.random.PRNGKey(0))
+        server_model = SpecModel(cifar_convnet(), rng=jax.random.PRNGKey(0))
+        # warm the jit caches OUTSIDE the timed window (both modes pay
+        # compilation identically, but pulling it out kills the noise)
+        for m in (client_model, server_model):
+            m.setup()
+            m.update(m.fit(x[:B], y[:B]))
+        server = AsynchronousSGDServer(
+            DistributedServerInMemoryModel(server_model), dataset,
+            DistributedServerConfig(
+                heartbeat_interval_s=0.5, heartbeat_timeout_s=20.0,
+                telemetry=tel_s),
+        )
+        server.setup()
+        client = AsynchronousSGDClient(
+            server.address, client_model,
+            DistributedClientConfig(
+                hyperparams={
+                    "telemetry_report_interval_s": 0.001 if obs_on else 0},
+                heartbeat_interval_s=0.5, heartbeat_timeout_s=20.0,
+                upload_timeout_s=60.0, telemetry=tel_c),
+        )
+        try:
+            client.setup(timeout=20.0)
+            start = time.perf_counter()
+            client.train_until_complete(timeout=600.0)
+            elapsed = time.perf_counter() - start
+        finally:
+            client.dispose()
+            server.stop()
+        applied = max(server.applied_updates, 1)
+        return elapsed * 1e3 / applied, server.collector.reports_ingested
+
+    off_ms, _ = one_run(False)
+    on_ms, reports = one_run(True)
+    overhead_ms = on_ms - off_ms
+    log(f"#obs obs_overhead: {on_ms:.1f} ms/round on vs {off_ms:.1f} off "
+        f"({overhead_ms:+.2f} ms, {reports} reports over {n_batches} rounds)")
+    return {
+        "config": "obs_overhead",
+        "metric": "telemetry+report overhead per async round",
+        "value": round(overhead_ms, 2),
+        "obs_on_round_ms": round(on_ms, 2),
+        "obs_off_round_ms": round(off_ms, 2),
+        "overhead_ms": round(overhead_ms, 2),
+        "reports": reports,
+    }
+
+
 # -- config #5: MobileNetV2 (synthetic ImageNet-subset) --------------------
 
 
@@ -1677,6 +1757,7 @@ def main() -> None:
     run(bench_mnist_sync, n_chips)
     run(bench_cifar_async, matrix)  # reads the cifar sync row for pct
     run(bench_fedavg)
+    run(bench_obs_overhead)
     if not FAST:
         run(bench_mobilenet, n_chips)
 

@@ -35,6 +35,7 @@ from distriflow_tpu.comm.transport import (
     FaultPlan,
 )
 from distriflow_tpu.models.base import DistributedModel, ModelSource, fetch_model
+from distriflow_tpu.obs.collector import ReportBuilder
 from distriflow_tpu.obs.profiler import NOOP_PROFILER
 from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
 from distriflow_tpu.utils.config import (
@@ -176,6 +177,13 @@ class AbstractClient:
         # client step decomposes into fit / ef_compress / serialize /
         # submit / ack_wait; shared no-op handles when telemetry is off
         self._prof = self.telemetry.profiler("client")
+        # fleet telemetry plane (docs/OBSERVABILITY.md §10): a report of
+        # this process's metrics piggybacks on upload metadata every
+        # telemetry_report_interval_s; the process sampler adds host
+        # RSS/CPU gauges to what ships (idempotent on shared Telemetry)
+        self._report_builder = ReportBuilder(self.telemetry, self.client_id)
+        self._last_report_t = 0.0
+        self.telemetry.register_process_sampler()
         # int8/topk gradient compression: per-leaf compression residual
         # carried into the next upload (error feedback); keyed by tree path
         self._quant_error: Optional[Dict[str, Any]] = None
@@ -283,6 +291,10 @@ class AbstractClient:
                     continue
                 self.reconnects += 1
                 self._c_reconnects.inc()
+                # the server may be fresh (restart) or missed in-flight
+                # deltas: next telemetry report is a full snapshot, now
+                self._report_builder.reset()
+                self._last_report_t = 0.0
                 self.log(f"reconnected to {self.server_address} "
                          f"(attempt {attempt}, total reconnects {self.reconnects})")
                 self.callbacks.fire("reconnect", self.reconnects)
@@ -504,6 +516,10 @@ class AbstractClient:
             msg.span_id = span.span_id or msg.span_id
             if msg.gradients is not None:
                 span.set(model_version=msg.gradients.version)
+            if msg.report is None:
+                # attach BEFORE serialization so retries resend the same
+                # report bytes (the collector's seq gating dedups them)
+                msg.report = self._maybe_build_report()
             t_ser = time.perf_counter()
             with self._prof.phase("serialize"):
                 wire = msg.to_wire()
@@ -578,6 +594,24 @@ class AbstractClient:
             )
         self.callbacks.fire("upload", msg, result)
         return result
+
+    def _maybe_build_report(self) -> Optional[Dict[str, Any]]:
+        """A telemetry report when the interval has elapsed, else None.
+        Interval 0 (or disabled telemetry) turns shipping off entirely."""
+        builder = getattr(self, "_report_builder", None)
+        if builder is None or not self.telemetry.enabled:
+            return None  # protocol probes that skip __init__
+        try:
+            interval = float(self.hyperparam("telemetry_report_interval_s"))
+        except (TypeError, ValueError):
+            return None
+        if interval <= 0:
+            return None
+        now = time.monotonic()
+        if now - self._last_report_t < interval:
+            return None
+        self._last_report_t = now
+        return builder.build()
 
     # -- hyperparameters -----------------------------------------------------
 

@@ -8,11 +8,15 @@ decode calls whose ack carries the result.
 
 from __future__ import annotations
 
+import time
+import uuid
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 from distriflow_tpu.comm.transport import ClientTransport
+from distriflow_tpu.obs.collector import ReportBuilder
+from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
 from distriflow_tpu.utils.serialization import (
     deserialize_array,
     pack_bytes,
@@ -26,7 +30,13 @@ DECODE_TIMEOUT_S = 120.0  # first request pays XLA compilation on the server
 class InferenceClient:
     """Remote decoding against an :class:`InferenceServer`."""
 
-    def __init__(self, address: str, timeout: float = DECODE_TIMEOUT_S):
+    def __init__(
+        self,
+        address: str,
+        timeout: float = DECODE_TIMEOUT_S,
+        telemetry: Optional[Telemetry] = None,
+        report_interval_s: float = 5.0,
+    ):
         self.address = address
         self.timeout = timeout
         self.transport = ClientTransport(address)
@@ -35,6 +45,14 @@ class InferenceClient:
         # "slots"|"direct", "queue_ms": ...}); None against servers that
         # predate continuous batching — the key is optional on the wire
         self.last_serving_meta: Optional[Dict[str, Any]] = None
+        # fleet telemetry plane: inference clients have no Upload path, so
+        # reports ride the heartbeat (docs/OBSERVABILITY.md §10).  0 disables.
+        self.telemetry = telemetry if telemetry is not None else get_telemetry()
+        self.report_interval_s = float(report_interval_s)
+        self.client_id = f"infer-{uuid.uuid4().hex[:12]}"
+        self._report_builder = ReportBuilder(self.telemetry, self.client_id)
+        self._last_report_t = 0.0
+        self.transport.heartbeat_payload = self._heartbeat_report
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -113,6 +131,16 @@ class InferenceClient:
         return deserialize_array(result["scores"])
 
     # -- internals ---------------------------------------------------------
+
+    def _heartbeat_report(self) -> Optional[Dict[str, Any]]:
+        """Interval-gated telemetry report riding the heartbeat payload."""
+        if self.report_interval_s <= 0 or not self.telemetry.enabled:
+            return None
+        now = time.monotonic()
+        if now - self._last_report_t < self.report_interval_s:
+            return None
+        self._last_report_t = now
+        return self._report_builder.build()
 
     def _request(self, event: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         result = self.transport.request(event, payload, timeout=self.timeout)

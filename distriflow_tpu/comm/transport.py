@@ -390,6 +390,9 @@ class ServerTransport:
         self._handlers: Dict[str, Callable[[str, Any], Any]] = {}
         self.on_connect: Optional[Callable[[str], Any]] = None
         self.on_disconnect: Optional[Callable[[str], Any]] = None
+        # fleet telemetry plane: non-None heartbeat payloads (inference
+        # clients piggyback reports on their beats) are handed here
+        self.on_heartbeat: Optional[Callable[[str, Any], None]] = None
         self._started = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
@@ -516,6 +519,19 @@ class ServerTransport:
                     continue
                 if msg.get("event") == _HB_EVENT:
                     await endpoint._send({"event": _HB_EVENT})  # echo: server liveness
+                    hb_payload = msg.get("payload")
+                    if hb_payload is not None and self.on_heartbeat is not None:
+                        # executor, like every handler: the hook ingests a
+                        # telemetry report (locks, file I/O) and must not
+                        # stall the read loop
+                        def _safe_hb(cid=client_id, p=hb_payload):
+                            try:
+                                self.on_heartbeat(cid, p)
+                            except Exception as e:
+                                print(f"[transport] on_heartbeat error: {e!r}",
+                                      file=sys.stderr, flush=True)
+
+                        self._loop.run_in_executor(None, _safe_hb)
                     continue
                 # fire-and-track: the read loop must stay responsive — a
                 # handler that blocks waiting for a peer ack would otherwise
@@ -598,6 +614,10 @@ class ClientTransport:
         self._c_corrupt_rx = self.telemetry.counter(
             "transport_frames_corrupt_rx_total", role="client")
         self.on_server_lost: Optional[Callable[[], None]] = None
+        # fleet telemetry plane: zero-arg callable polled each beat; a
+        # non-None return rides the heartbeat as its payload (how
+        # inference clients — no upload path — ship telemetry reports)
+        self.heartbeat_payload: Optional[Callable[[], Any]] = None
         self._last_server_frame = time.monotonic()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -657,8 +677,19 @@ class ClientTransport:
             async def heartbeat():
                 while True:
                     await asyncio.sleep(self.heartbeat_interval)
+                    hb_payload = None
+                    if self.heartbeat_payload is not None:
+                        # executor: the provider builds a report off-loop
+                        # (registry locks); a failing provider degrades to
+                        # a plain beat instead of killing liveness
+                        try:
+                            hb_payload = await loop.run_in_executor(
+                                None, self.heartbeat_payload)
+                        except Exception as e:
+                            print(f"[transport] heartbeat payload error: "
+                                  f"{e!r}", file=sys.stderr, flush=True)
                     try:
-                        await endpoint.emit_async(_HB_EVENT, None)
+                        await endpoint.emit_async(_HB_EVENT, hb_payload)
                     except (ConnectionError, RuntimeError):
                         return
                     if (

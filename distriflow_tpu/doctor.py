@@ -19,29 +19,37 @@ silent socket.io hang). Checks, in order:
    ``frames_seen`` totals, at least one upload trace must span the
    reconnect, and every apply span must link to a client upload trace
    (see ``docs/OBSERVABILITY.md``);
-8. kill-and-resume recovery drill: an async training run hard-stopped at
+8. fleet telemetry drill: two wire clients — one scripted-slow, one
+   under a scripted mid-upload connection reset — ship interval-gated
+   telemetry reports on their uploads; the server-side collector's
+   fleet totals must reconcile EXACTLY with the sum of the clients'
+   local counters (the reconnect forcing exactly one full-snapshot
+   fallback beyond the two handshakes), and the fleet straggler band
+   must trip exactly once, naming the slow client
+   (see ``docs/OBSERVABILITY.md`` §10);
+9. kill-and-resume recovery drill: an async training run hard-stopped at
    a (seeded-)random mid-run point, restarted as a fresh server on the
    same ``save_dir``; the manifest restores the dataset cursor/version
    clock/dedup keys and the drill asserts exactly-once batch accounting
    end-to-end (see ``docs/ROBUSTNESS.md`` §8);
-9. straggler drill: one artificially slow client, a short batch lease —
-   the run must complete via speculative re-dispatch and the straggler's
-   late gradient must be suppressed by first-wins arbitration;
-10. sparse-wire drill: top-k + int8 uploads with error feedback and
+10. straggler drill: one artificially slow client, a short batch lease —
+    the run must complete via speculative re-dispatch and the straggler's
+    late gradient must be suppressed by first-wins arbitration;
+11. sparse-wire drill: top-k + int8 uploads with error feedback and
     delta broadcasts reconstruct the dense mean within tolerance, and a
     forced reconnect is repaired with a full sync;
-11. health-sentinel drill: a scripted 0.4 s ack delay must trip the
+12. health-sentinel drill: a scripted 0.4 s ack delay must trip the
     ack-latency SLO band exactly once (edge-triggered) and dump exactly
     one flight bundle; a clean run must trip nothing;
-12. critical-path drill: assembled round traces must attribute a clean
+13. critical-path drill: assembled round traces must attribute a clean
     run to its dominant compute phase, attribute a PIPELINED clean run
     (``inflight_window=2``) to ``fit`` with the upload tail hidden on
     the comm thread, and shift ``bound_by`` to ``submit`` under a
     scripted 0.3 s upload delay (and only then); the bench ledger must
     flag a synthetically slowed row as ``regress`` on exactly one
     metric (see ``docs/OBSERVABILITY.md`` §9);
-13. native C++ host library presence (optional — numpy fallback is fine);
-14. checkpoint write/read round trip in a temp dir.
+14. native C++ host library presence (optional — numpy fallback is fine);
+15. checkpoint write/read round trip in a temp dir.
 
 Exit code 0 when every mandatory check passes; each check prints
 ``ok``/``FAIL`` with a one-line detail, so CI and humans read the same
@@ -360,6 +368,170 @@ def main() -> int:
 
     ok &= _check("telemetry reconciliation (snapshot vs FaultPlan)",
                  telemetry_reconciliation)
+
+    def fleet_telemetry():
+        """Fleet telemetry plane drill (docs/OBSERVABILITY.md §10): two
+        wire clients with SEPARATE Telemetry instances (the in-process
+        stand-in for separate processes) ship interval-gated reports on
+        their uploads. One client straggles (slow fit), the other eats a
+        scripted mid-upload connection reset. Asserts: the collector's
+        fleet totals reconcile EXACTLY with the sum of the clients' local
+        cumulative counters; exactly one full-snapshot fallback beyond
+        the two handshake fulls (the reconnect); the fleet straggler band
+        trips exactly once, naming the slow client."""
+        import numpy as np
+
+        from distriflow_tpu.client.abstract_client import DistributedClientConfig
+        from distriflow_tpu.client.async_client import AsynchronousSGDClient
+        from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+        from distriflow_tpu.data.dataset import DistributedDataset
+        from distriflow_tpu.obs import HealthSentinel, Telemetry
+        from distriflow_tpu.server.abstract_server import DistributedServerConfig
+        from distriflow_tpu.server.async_server import AsynchronousSGDServer
+        from distriflow_tpu.server.models import DistributedServerInMemoryModel
+        from distriflow_tpu.utils.config import RetryPolicy
+
+        TinyModel = _tiny_model_cls()
+
+        class SlowFit(TinyModel):
+            def fit(self, x, y):
+                time.sleep(0.3)
+                return super().fit(x, y)
+
+        class FastFit(TinyModel):
+            """Paced so the slow client still lands >= 2 uploads (a row
+            needs two for a round time) before the dataset drains."""
+
+            def fit(self, x, y):
+                time.sleep(0.03)
+                return super().fit(x, y)
+
+        n_batches = 32
+        x = np.arange(2 * n_batches, dtype=np.float32).reshape(-1, 1)
+        y = np.eye(2, dtype=np.float32)[np.arange(len(x)) % 2]
+        dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+        # separate telemetry per endpoint: the fleet view must be built
+        # from wire-shipped reports, not a shared in-process registry
+        tel_s, tel_fast, tel_slow = Telemetry(), Telemetry(), Telemetry()
+        with tempfile.TemporaryDirectory() as d:
+            server = AsynchronousSGDServer(
+                DistributedServerInMemoryModel(TinyModel()),
+                dataset,
+                DistributedServerConfig(
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=10.0,
+                    # the reset's retried upload lands a few versions late;
+                    # this drill is about telemetry, not staleness policy
+                    server_hyperparams={"maximum_staleness": 1000},
+                    telemetry=tel_s,
+                ),
+            )
+            server.setup()
+            sentinel = HealthSentinel(
+                tel_s, collector=server.collector,
+                fleet_straggler_factor=1.5, dump_dir=d)
+
+            def mk(cid, model, tel, fault_plan=None):
+                return AsynchronousSGDClient(
+                    server.address, model,
+                    DistributedClientConfig(
+                        client_id=cid,
+                        hyperparams={"telemetry_report_interval_s": 0.01},
+                        heartbeat_interval_s=0.1, heartbeat_timeout_s=10.0,
+                        upload_timeout_s=5.0,
+                        upload_retry=RetryPolicy(
+                            max_retries=6, initial_backoff_s=0.05,
+                            max_backoff_s=0.5, seed=7),
+                        fault_plan=fault_plan, telemetry=tel,
+                    ),
+                )
+
+            fast = slow = None
+            try:
+                slow = mk("slow-client", SlowFit(), tel_slow)
+                slow.setup(timeout=10.0)
+                fast = mk("fast-client", FastFit(), tel_fast,
+                          FaultPlan(seed=11, schedule=[ScriptedFault(
+                              event="uploadVars", nth=2, action="reset")]))
+                fast.setup(timeout=10.0)
+                fast.train_until_complete(timeout=60.0)
+                deadline = time.monotonic() + 20.0
+                # quiesce: every batch applied, and the slow client's row
+                # has a round time + client-authoritative report columns
+                while time.monotonic() < deadline:
+                    rows = server.fleet.snapshot()
+                    slow_rows = [r for r in rows.values()
+                                 if r.get("client") == "slow-client"]
+                    if (server.applied_updates == n_batches and slow_rows
+                            and slow_rows[0].get("round_ms")
+                            and slow_rows[0].get("fit_ms") is not None):
+                        break
+                    time.sleep(0.02)
+                assert server.applied_updates == n_batches, (
+                    f"{server.applied_updates}/{n_batches} applied")
+                # straggler band: trips once, names the slow client
+                hits = [h for h in sentinel.check()
+                        if h["band"] == "fleet_straggler"]
+                assert len(hits) == 1, f"straggler hits: {hits}"
+                assert hits[0]["client"] == "slow-client", hits[0]
+                again = [h for h in sentinel.check()
+                         if h["band"] == "fleet_straggler"]
+                assert not again, "straggler band re-triggered (not edge)"
+                n_breach = tel_s.counter_value(
+                    "obs_slo_breach_total", band="fleet_straggler")
+                assert n_breach == 1, f"breach counter {n_breach}"
+                # reconcile at quiescence: a live connection never stops
+                # moving its own comm counters (every report's carrier
+                # frame is itself counted), so freeze the clients first,
+                # then ship each builder's FINAL delta report and demand
+                # exact equality across every counter ident
+                for c in (fast, slow):
+                    c.dispose()
+                for c in (fast, slow):
+                    server.collector.ingest(
+                        c.client_id, c._report_builder.build())
+
+                def local_sums():
+                    out = {}
+                    for t in (tel_fast, tel_slow):
+                        for ident, v in t.registry.snapshot()["counters"].items():
+                            out[ident] = out.get(ident, 0.0) + v
+                    return out
+
+                totals = server.collector.totals()
+                local = local_sums()
+                assert totals == local, (
+                    "fleet totals do not reconcile: "
+                    f"{ {k: (totals.get(k), local.get(k)) for k in set(totals) | set(local) if totals.get(k) != local.get(k)} }"
+                )
+                # merged fleet histogram == sum of local fit digests
+                merged = server.collector.fleet_histogram(
+                    "phase_ms", phase="fit", role="client")
+                want_fits = sum(
+                    t.registry.find("phase_ms", phase="fit",
+                                    role="client").summary()["count"]
+                    for t in (tel_fast, tel_slow))
+                assert merged.summary()["count"] == want_fits, (
+                    f"merged fit digest {merged.summary()['count']} != "
+                    f"local {want_fits}")
+                # exactly one full beyond the two handshakes (the reset)
+                assert server.collector.full_reports == 3, (
+                    f"full reports: {server.collector.full_reports}")
+                n_reports = server.collector.reports_ingested
+                n_clients = len(server.collector.client_ids())
+            finally:
+                for c in (fast, slow):
+                    if c is not None:
+                        c.dispose()
+                server.stop()
+        assert n_clients == 2, f"collector saw {n_clients} clients"
+        return (f"{n_reports} reports from {n_clients} clients reconcile "
+                f"exactly ({len(totals)} counter idents, "
+                f"{server.collector.full_reports} full snapshots incl. 1 "
+                "post-reset fallback); straggler band tripped once for "
+                "slow-client")
+
+    ok &= _check("fleet telemetry drill (wire reports + straggler band)",
+                 fleet_telemetry)
 
     def kill_and_resume():
         """Hard-stop an async training run at a seeded-random mid-run point,

@@ -17,6 +17,11 @@ Offline, ``python -m distriflow_tpu.obs.dump <dir>`` summarizes a run's
 the metric-name and span-schema reference.
 """
 
+from distriflow_tpu.obs.collector import (
+    REPORT_VERSION,
+    ReportBuilder,
+    TelemetryCollector,
+)
 from distriflow_tpu.obs.flight_recorder import (
     FlightRecorder,
     NOOP_FLIGHT,
@@ -35,11 +40,14 @@ from distriflow_tpu.obs.profiler import (
     PhaseProfiler,
 )
 from distriflow_tpu.obs.registry import (
+    BUCKET_BOUNDS,
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
     NOOP_HANDLE,
+    metric_ident,
+    parse_ident,
     render_prometheus,
 )
 from distriflow_tpu.obs.telemetry import (
@@ -63,6 +71,7 @@ from distriflow_tpu.obs.tracing import (
 
 __all__ = [
     "Assembly",
+    "BUCKET_BOUNDS",
     "BenchLedger",
     "Counter",
     "FleetTable",
@@ -77,10 +86,13 @@ __all__ = [
     "NOOP_PROFILER",
     "NOOP_SPAN",
     "PhaseProfiler",
+    "REPORT_VERSION",
+    "ReportBuilder",
     "Round",
     "SLOBand",
     "Span",
     "Telemetry",
+    "TelemetryCollector",
     "Tracer",
     "assemble",
     "assemble_dir",
@@ -89,8 +101,10 @@ __all__ = [
     "get_telemetry",
     "install_jax_hooks",
     "lower_is_better",
+    "metric_ident",
     "new_span_id",
     "new_trace_id",
+    "parse_ident",
     "render_prometheus",
     "set_telemetry",
 ]

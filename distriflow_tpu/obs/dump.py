@@ -25,6 +25,13 @@ every ``--interval`` seconds it re-reads the latest snapshot row and
 prints which counters/gauges moved (``--iterations`` bounds the loop;
 0 = forever).
 
+``--fleet`` renders the fleet telemetry plane (docs/OBSERVABILITY.md
+§10) from a SERVER's run dir: the per-client table (connection state,
+server-observed round latency, and the client-authoritative columns the
+collector folded in — fit_ms/submit_ms phase digests, host, RSS/CPU)
+plus the ``fleet/*`` aggregate gauges. ``--fleet --watch`` re-renders
+the live table every ``--interval`` seconds.
+
 Exit code is 0 when at least one summarized source existed, 2 otherwise.
 """
 
@@ -69,6 +76,84 @@ def summarize_metrics(path: str) -> List[str]:
             if key.startswith(("counter:", "gauge:")):
                 lines.append(f"    {key.split(':', 1)[1]} = {last[key]:g}")
     return lines
+
+
+#: per-client columns rendered first (when present), in this order; any
+#: other non-underscore column the row carries follows alphabetically
+_FLEET_COLS = ("client", "host", "connected", "uploads", "round_ms",
+               "fit_ms", "submit_ms", "rss_bytes", "cpu_s", "staleness",
+               "report_seq")
+
+
+def _fleet_lines(row: Dict[str, Any]) -> List[str]:
+    """Render one snapshot row's fleet table + fleet/* aggregates."""
+    lines: List[str] = []
+    fleet = row.get("fleet")
+    if isinstance(fleet, dict) and fleet:
+        lines.append(f"  clients ({len(fleet)}):")
+        for cid in sorted(fleet):
+            r = fleet[cid]
+            if not isinstance(r, dict):
+                continue
+            parts = [f"conn={cid[:8]}"]
+            shown = set()
+            for col in _FLEET_COLS:
+                if col in r and r[col] is not None:
+                    v = r[col]
+                    parts.append(f"{col}={str(v)[:12]}")
+                    shown.add(col)
+            for col in sorted(r):
+                if col not in shown and r[col] is not None:
+                    parts.append(f"{col}={str(r[col])[:12]}")
+            lines.append("    " + " ".join(parts))
+    else:
+        lines.append("  clients: (no fleet rows in the latest snapshot)")
+    aggregates = sorted(k for k in row
+                        if k.startswith("gauge:fleet/"))
+    if aggregates:
+        lines.append("  aggregates:")
+        for k in aggregates:
+            lines.append(f"    {k.split(':', 1)[1]} = {row[k]:g}")
+    return lines
+
+
+def summarize_fleet(run_dir: str) -> List[str]:
+    """The live fleet view from a server run dir's latest snapshot row."""
+    path = os.path.join(run_dir, METRICS_FILENAME)
+    if not os.path.exists(path):
+        return [f"(no {METRICS_FILENAME} in {run_dir} — is this the "
+                f"server's run dir?)"]
+    rows, skipped = read_metrics_counted(path)
+    snaps = [r for r in rows if r.get("kind") == "telemetry_snapshot"]
+    lines = [_rows_line("fleet", path, snaps, skipped)]
+    if not snaps:
+        lines.append("  (no telemetry_snapshot rows yet)")
+        return lines
+    return lines + _fleet_lines(snaps[-1])
+
+
+def watch_fleet(run_dir: str, interval: float, iterations: int) -> int:
+    """Live fleet mode: re-render the per-client table every poll."""
+    metrics_path = os.path.join(run_dir, METRICS_FILENAME)
+    seen = False
+    i = 0
+    while iterations <= 0 or i < iterations:
+        if i:  # no sleep before the first poll (mirrors watch())
+            time.sleep(interval)
+        i += 1
+        if not os.path.exists(metrics_path):
+            print(f"fleet[{i}] (waiting for {METRICS_FILENAME} in "
+                  f"{run_dir})", flush=True)
+            continue
+        seen = True
+        rows = [r for r in read_metrics(metrics_path)
+                if r.get("kind") == "telemetry_snapshot"]
+        if not rows:
+            print(f"fleet[{i}] (no telemetry_snapshot rows yet)", flush=True)
+            continue
+        print(f"fleet[{i}] {len(rows)} snapshot(s):", flush=True)
+        print("\n".join(_fleet_lines(rows[-1])), flush=True)
+    return 0 if seen else 2
 
 
 def summarize_spans(path: str) -> List[str]:
@@ -199,13 +284,26 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--max-rounds", type=int, default=20,
                         help="cap per-round lines in --critical-path "
                              "output (default 20)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="render the fleet telemetry plane (per-client "
+                             "table + fleet/* aggregates) from a server "
+                             "run dir")
     parser.add_argument("--watch", action="store_true",
-                        help="poll the latest snapshot and print deltas")
+                        help="poll the latest snapshot and print deltas "
+                             "(with --fleet: re-render the live table)")
     parser.add_argument("--interval", type=float, default=2.0,
                         help="seconds between --watch polls (default 2)")
     parser.add_argument("--iterations", type=int, default=0,
                         help="stop --watch after N polls (0 = forever)")
     args = parser.parse_args(argv)
+
+    if args.fleet and args.watch:
+        return watch_fleet(args.run_dir, args.interval, args.iterations)
+
+    if args.fleet:
+        print("\n".join(summarize_fleet(args.run_dir)))
+        return 0 if os.path.exists(
+            os.path.join(args.run_dir, METRICS_FILENAME)) else 2
 
     if args.watch:
         return watch(args.run_dir, args.interval, args.iterations)

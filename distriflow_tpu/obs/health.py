@@ -16,11 +16,18 @@ is not an incident.
 ROADMAP router/soak items consume: round latency, staleness, quarantine
 hits, wire bytes, last-seen per client, exposed through
 ``Telemetry.snapshot()["fleet"]`` (absent when no table is registered,
-so the disabled-telemetry snapshot contract is untouched).
+so the disabled-telemetry snapshot contract is untouched). With the
+fleet telemetry plane (``obs/collector.py``) the rows also carry
+*client-authoritative* columns shipped by the clients themselves
+(fit_ms/submit_ms phase digests, RSS/CPU), and the sentinel can band
+over the MERGED cross-process view: per-client straggler detection
+(round_ms > k x fleet median) and a fleet-wide ack p99 ceiling — see
+docs/OBSERVABILITY.md §10.
 """
 
 from __future__ import annotations
 
+import statistics
 import threading
 import time
 from dataclasses import dataclass, field
@@ -81,13 +88,27 @@ class HealthSentinel:
 
     def __init__(self, telemetry: Any = None,
                  bands: Optional[List[SLOBand]] = None,
-                 dump_dir: Optional[str] = None):
+                 dump_dir: Optional[str] = None,
+                 collector: Any = None,
+                 fleet_straggler_factor: Optional[float] = None,
+                 fleet_ack_p99_ms: Optional[float] = None,
+                 fleet_min_count: int = 8):
         if telemetry is None:
             from distriflow_tpu.obs.telemetry import get_telemetry
             telemetry = get_telemetry()
         self.telemetry = telemetry
         self.bands = list(bands or [])
         self.dump_dir = dump_dir
+        # fleet-level checks (docs/OBSERVABILITY.md §10): computed over a
+        # TelemetryCollector's merged cross-process view, not this
+        # process's registry. straggler: a client whose round_ms exceeds
+        # fleet_straggler_factor x the fleet median (needs >= 2 clients
+        # with a round time). ack p99: the MERGED client-side ack
+        # histogram across every reporting client.
+        self.collector = collector
+        self.fleet_straggler_factor = fleet_straggler_factor
+        self.fleet_ack_p99_ms = fleet_ack_p99_ms
+        self.fleet_min_count = int(fleet_min_count)
         self._in_breach: Dict[str, bool] = {}
 
     def observe(self, band: SLOBand) -> Optional[float]:
@@ -126,6 +147,64 @@ class HealthSentinel:
                                      save_dir=self.dump_dir, **detail)
                 detail["bundle"] = bundle
                 entered.append(detail)
+        entered.extend(self._check_fleet())
+        return entered
+
+    def _enter_breach(self, key: str, band: str, breached: bool,
+                      detail: Dict[str, Any],
+                      dump_name: str) -> Optional[Dict[str, Any]]:
+        """Shared edge-trigger: count + flight-dump only on entry. ``key``
+        is the edge identity (per-client for stragglers); ``band`` labels
+        the breach counter."""
+        was = self._in_breach.get(key, False)
+        self._in_breach[key] = breached
+        if not breached or was:
+            return None
+        self.telemetry.counter(BREACH_COUNTER, band=band).inc()
+        flight = self.telemetry.flight
+        flight.record("slo_breach", **detail)
+        detail["bundle"] = flight.dump(dump_name, save_dir=self.dump_dir,
+                                       **detail)
+        return detail
+
+    def _check_fleet(self) -> List[Dict[str, Any]]:
+        """The fleet-level bands (no-ops without a collector)."""
+        entered: List[Dict[str, Any]] = []
+        if self.collector is None:
+            return entered
+        fleet = getattr(self.collector, "fleet", None)
+        if self.fleet_straggler_factor and fleet is not None:
+            rows = fleet.snapshot()
+            rounds = {cid: float(r["round_ms"]) for cid, r in rows.items()
+                      if r.get("round_ms")}
+            if len(rounds) >= 2:
+                med = statistics.median(rounds.values())
+                if med > 0:
+                    for cid, rm in sorted(rounds.items()):
+                        hit = self._enter_breach(
+                            f"fleet_straggler:{cid}", "fleet_straggler",
+                            rm > self.fleet_straggler_factor * med,
+                            {"band": "fleet_straggler", "client_id": cid,
+                             "client": rows[cid].get("client"),
+                             "observed": rm, "fleet_median_ms": med,
+                             "factor": self.fleet_straggler_factor},
+                            f"slo_fleet_straggler_{cid[:8]}")
+                        if hit is not None:
+                            entered.append(hit)
+        if self.fleet_ack_p99_ms:
+            merged = self.collector.fleet_histogram(
+                "transport_ack_latency_ms", role="client")
+            s = merged.summary()
+            if s.get("count", 0) >= self.fleet_min_count:
+                hit = self._enter_breach(
+                    "fleet_ack_p99", "fleet_ack_p99",
+                    s["p99"] > self.fleet_ack_p99_ms,
+                    {"band": "fleet_ack_p99", "observed": s["p99"],
+                     "upper": self.fleet_ack_p99_ms,
+                     "count": s["count"]},
+                    "slo_fleet_ack_p99")
+                if hit is not None:
+                    entered.append(hit)
         return entered
 
     def breached(self) -> List[str]:
@@ -208,6 +287,18 @@ class FleetTable:
     def note_resync(self, client_id: str) -> None:
         with self._lock:
             self._row(client_id)["resyncs"] += 1
+
+    def note_report(self, client_id: str, **cols: Any) -> None:
+        """Fold client-authoritative columns from a shipped telemetry
+        report (``obs/collector.py``) into this connection's row —
+        fit_ms/submit_ms phase digests, host resource gauges, the
+        client's stable identity, report seq. Arbitrary columns merge;
+        ``snapshot()`` only strips ``_``-prefixed keys, so new report
+        columns flow to the fleet view without a schema change here."""
+        with self._lock:
+            row = self._row(client_id)
+            row["last_seen"] = time.time()
+            row.update(cols)
 
     def note_pages(self, client_id: str, pages: int) -> None:
         """Absolute KV pages a serving client currently holds across its

@@ -64,6 +64,19 @@ BANDS: Dict[str, Dict[str, Dict[str, float]]] = {
         "prefill_secs": {"warn_pct": 15.0, "regress_pct": 40.0},
         "ms_per_token": {"warn_pct": 15.0, "regress_pct": 40.0},
     },
+    "obs_overhead": {
+        # fleet telemetry plane cost (docs/OBSERVABILITY.md §10): the
+        # guarded numbers are the absolute on/off round times; the
+        # headline delta ("value"/"overhead_ms") is a difference of two
+        # jittery loopback means — often sub-ms, sometimes negative —
+        # so its pct-of-reference gate is advisory-only. "reports" is a
+        # count, not a performance number.
+        "obs_on_round_ms": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "obs_off_round_ms": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "overhead_ms": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "value": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "reports": {"warn_pct": 1e9, "regress_pct": 1e9},
+    },
     "cifar10_convnet_async_bounded_staleness": {
         # round-6 semantic change: floor_ms/ceiling_sps are now derived
         # from the continuous profiler's phase digests (per-upload

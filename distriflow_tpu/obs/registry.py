@@ -26,17 +26,52 @@ the handle.
 
 from __future__ import annotations
 
+import bisect
 import re
 import threading
 from typing import Any, Dict, Optional, Tuple
 
 _DEFAULT_HISTOGRAM_WINDOW = 1024
 
+#: log2-spaced bucket bounds for the mergeable wire export
+#: (``obs/collector.py``): bucket ``i`` counts observations ``<=
+#: BUCKET_BOUNDS[i]``, with one overflow bucket beyond the last bound.
+#: Spanning 2^-10 .. 2^30 covers sub-ms phase times through multi-hour
+#: totals in one fixed table, so two processes' bucket counts always
+#: add element-wise.
+BUCKET_BOUNDS = tuple(float(2.0 ** e) for e in range(-10, 31))
+
 LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]
 
 
 def _key(name: str, labels: Dict[str, Any]) -> LabelKey:
     return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+def metric_ident(name: str, labels: Any) -> str:
+    """Canonical snapshot spelling: ``name`` or ``name{k=v,...}`` (sorted
+    labels) — the same form ``snapshot()`` and the Prometheus renderer
+    use, and the key the fleet collector aggregates under."""
+    items = labels.items() if isinstance(labels, dict) else labels
+    label_s = ",".join(f"{k}={v}" for k, v in sorted(
+        (str(k), str(v)) for k, v in items))
+    return f"{name}{{{label_s}}}" if label_s else name
+
+
+def parse_ident(ident: str) -> Tuple[str, Dict[str, str]]:
+    """Invert :func:`metric_ident`: ``name{k=v,...}`` -> (name, labels).
+    Tolerant of label values containing ``=`` never being produced by
+    ``metric_ident`` (values are str()'d scalars in practice)."""
+    if "{" not in ident:
+        return ident, {}
+    name, _, rest = ident.partition("{")
+    rest = rest.rstrip("}")
+    labels: Dict[str, str] = {}
+    for part in rest.split(","):
+        if part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return name, labels
 
 
 class Counter:
@@ -91,7 +126,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "labels", "window", "_ring", "_n", "_i",
-                 "count", "sum", "min", "max", "_lock")
+                 "count", "sum", "min", "max", "_buckets", "_lock")
 
     def __init__(self, name: str, labels: Dict[str, str],
                  window: int = _DEFAULT_HISTOGRAM_WINDOW):
@@ -105,6 +140,9 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        # cumulative bucket counts over the FULL life of the handle (the
+        # mergeable fleet export; see BUCKET_BOUNDS) — one overflow slot
+        self._buckets = [0] * (len(BUCKET_BOUNDS) + 1)
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -116,6 +154,7 @@ class Histogram:
                 self._n += 1
             self.count += 1
             self.sum += v
+            self._buckets[bisect.bisect_left(BUCKET_BOUNDS, v)] += 1
             if self.min is None or v < self.min:
                 self.min = v
             if self.max is None or v > self.max:
@@ -142,6 +181,69 @@ class Histogram:
         }
         s.update(self.percentiles())
         return s
+
+    def bucket_counts(self) -> Dict[str, int]:
+        """Sparse ``{bucket_index: count}`` over :data:`BUCKET_BOUNDS`
+        (index ``len(BUCKET_BOUNDS)`` is the overflow bucket). String keys
+        so the dict survives a JSON round trip unchanged."""
+        with self._lock:
+            return {str(i): c for i, c in enumerate(self._buckets) if c}
+
+    def export_state(self, max_window: Optional[int] = None
+                     ) -> Dict[str, Any]:
+        """JSON-able mergeable state: exact ``count``/``sum``/``min``/
+        ``max``, cumulative bucket counts, and the retained window samples
+        (oldest first; ``max_window`` keeps only the newest N so a wire
+        report stays bounded). Values are CUMULATIVE since the handle's
+        epoch — re-delivering a state never corrupts a merge target that
+        replaces rather than adds (see ``obs/collector.py``)."""
+        with self._lock:
+            if self._n < self.window:
+                window = self._ring[: self._n]
+            else:
+                window = self._ring[self._i:] + self._ring[: self._i]
+            if max_window is not None and len(window) > int(max_window):
+                window = window[-int(max_window):]
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+                "buckets": {str(i): c for i, c in enumerate(self._buckets)
+                            if c},
+                "window": list(window),
+            }
+
+    def merge(self, other: Any) -> "Histogram":
+        """Fold another histogram — a live :class:`Histogram` or an
+        :meth:`export_state` dict — into this one.
+
+        Exact aggregates (count/sum/min/max) and bucket counts add;
+        the other's window samples are appended to our ring, so the
+        post-merge ``percentiles()`` describe the union of both windows
+        (exact while the union fits the ring, a recent-biased
+        approximation beyond — the property test in
+        ``tests/test_fleetobs.py`` pins the tolerance, p50/p99 included).
+        Returns ``self`` for chaining."""
+        state = other.export_state() if isinstance(other, Histogram) else other
+        with self._lock:
+            self.count += int(state.get("count", 0) or 0)
+            self.sum += float(state.get("sum", 0.0) or 0.0)
+            o_min, o_max = state.get("min"), state.get("max")
+            if o_min is not None:
+                self.min = o_min if self.min is None else min(self.min, o_min)
+            if o_max is not None:
+                self.max = o_max if self.max is None else max(self.max, o_max)
+            for i, c in (state.get("buckets") or {}).items():
+                idx = int(i)
+                if 0 <= idx < len(self._buckets):
+                    self._buckets[idx] += int(c)
+            for v in state.get("window") or ():
+                self._ring[self._i] = float(v)
+                self._i = (self._i + 1) % self.window
+                if self._n < self.window:
+                    self._n += 1
+        return self
 
 
 class _NoopHandle:
@@ -245,14 +347,27 @@ class MetricsRegistry:
         with self._lock:
             metrics = list(self._metrics.items())
         for (name, labels), m in sorted(metrics, key=lambda kv: kv[0]):
-            label_s = ",".join(f"{k}={v}" for k, v in labels)
-            ident = f"{name}{{{label_s}}}" if label_s else name
+            ident = metric_ident(name, labels)
             if isinstance(m, Counter):
                 out["counters"][ident] = m.value
             elif isinstance(m, Gauge):
                 out["gauges"][ident] = m.value
             elif isinstance(m, Histogram):
                 out["histograms"][ident] = m.summary()
+        return out
+
+    def histogram_states(self, max_window: Optional[int] = None
+                         ) -> Dict[str, Dict[str, Any]]:
+        """Mergeable :meth:`Histogram.export_state` per histogram, keyed
+        by snapshot ident — what a telemetry report ships so the fleet
+        collector can :meth:`Histogram.merge` cross-process quantiles."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        out: Dict[str, Dict[str, Any]] = {}
+        for (name, labels), m in sorted(metrics, key=lambda kv: kv[0]):
+            if isinstance(m, Histogram):
+                out[metric_ident(name, labels)] = m.export_state(
+                    max_window=max_window)
         return out
 
 

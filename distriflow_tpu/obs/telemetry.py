@@ -45,6 +45,7 @@ class Telemetry:
         self._flight = None
         self._fleet_providers: Dict[Any, Any] = {}
         self._samplers: list = []
+        self._process_sampler_on = False
 
     # -- handle factories (delegate to the registry) -----------------------
 
@@ -113,6 +114,29 @@ class Telemetry:
         if self.enabled:
             self._samplers.append(fn)
 
+    def register_process_sampler(self) -> None:
+        """Built-in :meth:`register_sampler` refreshing host resource
+        gauges — ``process_rss_bytes`` (peak RSS) and ``process_cpu_s``
+        (user+system CPU seconds) via the stdlib ``resource``/``os``
+        modules — so every telemetry report ships them into the fleet
+        table for free. Idempotent: clients sharing one Telemetry (the
+        loopback tests) register once. No-op when disabled."""
+        if not self.enabled or self._process_sampler_on:
+            return
+        self._process_sampler_on = True
+        import resource  # stdlib on POSIX; this repo targets Linux/TPU VMs
+        rss = self.registry.gauge("process_rss_bytes")
+        cpu = self.registry.gauge("process_cpu_s")
+
+        def _sample() -> None:
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            # ru_maxrss is KiB on Linux (bytes on macOS; Linux is the target)
+            rss.set(ru.ru_maxrss * 1024)
+            t = os.times()
+            cpu.set(t.user + t.system)
+
+        self._samplers.append(_sample)
+
     # -- read side ---------------------------------------------------------
 
     def counter_value(self, name: str, **labels: Any) -> float:
@@ -121,16 +145,23 @@ class Telemetry:
     def total(self, name: str) -> float:
         return self.registry.total(name)
 
-    def snapshot(self) -> Dict[str, Any]:
-        """Plain dict of every counter/gauge/histogram currently
-        registered, plus a ``"fleet"`` key (per-connection health rows)
-        when a server has registered its table — absent otherwise, so
-        the disabled-telemetry empty-snapshot contract is unchanged."""
+    def run_samplers(self) -> None:
+        """Refresh every pull-style gauge now. ``snapshot()`` does this
+        implicitly; the report builder calls it too, so shipped reports
+        carry current process gauges rather than the values frozen at
+        the last local snapshot."""
         for sampler in list(self._samplers):
             try:
                 sampler()
             except Exception:
                 pass  # pull-gauge refresh must never break a snapshot
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain dict of every counter/gauge/histogram currently
+        registered, plus a ``"fleet"`` key (per-connection health rows)
+        when a server has registered its table — absent otherwise, so
+        the disabled-telemetry empty-snapshot contract is unchanged."""
+        self.run_samplers()
         snap = self.registry.snapshot()
         if self._fleet_providers:
             fleet: Dict[str, Any] = {}
@@ -170,6 +201,8 @@ class Telemetry:
         for ident, s in snap["histograms"].items():
             for stat, v in s.items():
                 row[f"hist:{ident}:{stat}"] = v
+        if "fleet" in snap:
+            row["fleet"] = snap["fleet"]  # per-client rows for `dump --fleet`
         row.update(extra)
         self._metrics_logger.log(**row)
         return row

@@ -22,9 +22,14 @@ Rounds are keyed ``(trace_id, update_id)`` with chaos tolerance
   never an orphan.
 
 Clock skew: rows are ordered on the per-process monotonic anchor
-(``mono``) and clock domains (``pid``) are aligned via each domain's
-median wall-minus-mono offset, so a wall-clock step mid-run cannot
-shuffle a timeline.
+(``mono``) and clock domains (``(host, pid)``) are aligned via each
+domain's median wall-minus-mono offset, so a wall-clock step mid-run
+cannot shuffle a timeline. Rows without a ``host`` key (local spans;
+every row before the fleet telemetry plane) fall in the ``(None, pid)``
+domain — single-host assembly is byte-identical to the per-pid
+behavior, while span rows shipped from other hosts by the fleet
+collector (``obs/collector.py``, which stamps each with the client's
+``host``) get their own domain even when two hosts reuse a pid.
 
 Attribution sweeps each round's segments on a shared timeline: at any
 instant the highest-priority active segment owns the time (server apply
@@ -142,14 +147,17 @@ def _f(row: Dict[str, Any], key: str, default: float = 0.0) -> float:
 
 
 def _domain_offsets(rows: List[Dict[str, Any]]) -> Dict[Any, float]:
-    """Per-pid wall-minus-mono offset (median): maps each clock domain's
-    monotonic anchors onto the shared wall timeline."""
-    by_pid: Dict[Any, List[float]] = {}
+    """Per-(host, pid) wall-minus-mono offset (median): maps each clock
+    domain's monotonic anchors onto the shared wall timeline. ``host`` is
+    None for local rows, so single-host assembly degrades to exactly the
+    old per-pid alignment; rows shipped by the fleet collector carry the
+    client's host and get their own domain."""
+    by_domain: Dict[Any, List[float]] = {}
     for r in rows:
         if r.get("mono") is not None and r.get("start") is not None:
-            by_pid.setdefault(r.get("pid"), []).append(
+            by_domain.setdefault((r.get("host"), r.get("pid")), []).append(
                 _f(r, "start") - _f(r, "mono"))
-    return {pid: statistics.median(d) for pid, d in by_pid.items()}
+    return {dom: statistics.median(d) for dom, d in by_domain.items()}
 
 
 def _interval(row: Dict[str, Any],
@@ -157,8 +165,9 @@ def _interval(row: Dict[str, Any],
     """(t0, t1) of a span row in wall seconds, skew-tolerantly: monotonic
     anchor + its domain's offset when available, raw wall otherwise."""
     mono = row.get("mono")
-    if mono is not None and row.get("pid") in offsets:
-        t0 = _f(row, "mono") + offsets[row.get("pid")]
+    dom = (row.get("host"), row.get("pid"))
+    if mono is not None and dom in offsets:
+        t0 = _f(row, "mono") + offsets[dom]
     else:
         t0 = _f(row, "start")
     return t0, t0 + _f(row, "dur_ms") / 1e3

@@ -46,6 +46,7 @@ from distriflow_tpu.utils.config import (
     client_hyperparams,
     server_hyperparams,
 )
+from distriflow_tpu.obs.collector import TelemetryCollector
 from distriflow_tpu.obs.health import FleetTable
 from distriflow_tpu.obs.telemetry import Telemetry, get_telemetry
 from distriflow_tpu.utils.logging import CallbackRegistry, VerboseLogger
@@ -171,6 +172,11 @@ class AbstractServer:
         # latency, staleness, quarantine hits, wire bytes, last-seen —
         # merged into Telemetry.snapshot()["fleet"] while setup
         self.fleet = FleetTable()
+        # fleet telemetry plane (docs/OBSERVABILITY.md §10): ingests the
+        # reports clients piggyback on uploads/heartbeats — fleet/*
+        # aggregates, client-authoritative fleet-table columns, and
+        # shipped span rows into this process's spans.jsonl
+        self.collector = TelemetryCollector(self.telemetry, fleet=self.fleet)
         self.logger = VerboseLogger(type(self).__name__, self.config.verbose)
         self.gate = GradientGate(
             self.config.quarantine or QuarantinePolicy(),
@@ -342,6 +348,9 @@ class AbstractServer:
         self.transport.on_disconnect = self._on_disconnect
         self.transport.on(Events.Upload.value, self._on_upload_wire)
         self.transport.on(Events.Resync.value, self._on_resync_wire)
+        # inference clients have no upload path: their telemetry reports
+        # ride the heartbeat payload instead
+        self.transport.on_heartbeat = self.collector.ingest
         if self.config.apply_queue_depth > 0:
             self._apply_stop.clear()
             self._apply_queue = queue.Queue(self.config.apply_queue_depth)
@@ -449,6 +458,11 @@ class AbstractServer:
             self.fleet.note_upload(client_id, nbytes)
             if msg.metrics is not None:
                 self.log(f"client {msg.client_id} metrics: {msg.metrics}")
+            if msg.report is not None:
+                # the connection id keys the fleet-table fold (same row
+                # note_upload writes); the report's own stable client_id
+                # keys the seq gating so it survives reconnects
+                self.collector.ingest(client_id, msg.report)
             q = self._apply_queue
             if q is None:
                 return self._process_upload(client_id, msg)

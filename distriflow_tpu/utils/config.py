@@ -146,6 +146,12 @@ class ClientHyperparams:
     # min(inflight_window, maximum_staleness + 1) so the pipeline can
     # never push effective staleness past the bound.
     inflight_window: int = 1
+    # fleet telemetry plane (docs/OBSERVABILITY.md §10): how often a client
+    # piggybacks a telemetry report on its upload metadata (inference
+    # clients ride the heartbeat instead). 0 disables shipping. Server-
+    # pushable like every other client hyperparameter, so an operator can
+    # throttle the whole fleet's reporting from one place.
+    telemetry_report_interval_s: float = 5.0
 
     def validate(self) -> "ClientHyperparams":
         if self.batch_size <= 0:
@@ -170,6 +176,11 @@ class ClientHyperparams:
         if self.inflight_window < 1:
             raise ValueError(
                 f"inflight_window must be >= 1, got {self.inflight_window}"
+            )
+        if self.telemetry_report_interval_s < 0:
+            raise ValueError(
+                f"telemetry_report_interval_s must be >= 0, got "
+                f"{self.telemetry_report_interval_s}"
             )
         return self
 

@@ -109,6 +109,13 @@ class UploadMsg:
     span joins the client-side upload span even across reconnects.
     ``span_id`` is the sending span's id; the receiver records it as its
     span's ``parent_id``.
+
+    ``report`` (optional, absent on the wire when unset — old frames
+    parse fine) piggybacks a fleet telemetry report
+    (``distriflow_tpu.obs.collector``) on the upload metadata every
+    ``telemetry_report_interval_s``, so shipping client metrics costs no
+    extra round trips. Retries resend the identical report; the
+    collector's seq gating makes that idempotent.
     """
 
     client_id: str
@@ -118,6 +125,7 @@ class UploadMsg:
     update_id: Optional[str] = None
     trace_id: Optional[str] = None
     span_id: Optional[str] = None
+    report: Optional[Dict[str, Any]] = None
 
     def to_wire(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"client_id": self.client_id}
@@ -133,6 +141,8 @@ class UploadMsg:
             d["trace_id"] = self.trace_id
         if self.span_id is not None:
             d["span_id"] = self.span_id
+        if self.report is not None:
+            d["report"] = self.report
         return d
 
     @staticmethod
@@ -145,6 +155,7 @@ class UploadMsg:
             update_id=d.get("update_id"),
             trace_id=d.get("trace_id"),
             span_id=d.get("span_id"),
+            report=d.get("report"),
         )
 
 

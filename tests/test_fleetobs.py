@@ -1,0 +1,592 @@
+"""Fleet telemetry plane (docs/OBSERVABILITY.md §10).
+
+The design contract pinned here:
+
+- histograms are MERGEABLE: fixed log2 bucket table + exact aggregates,
+  so two processes' states always add; window union keeps p50/p99 honest;
+- reports are loss-tolerant by construction: delta-encoded KEYS over
+  cumulative-since-epoch VALUES, a monotonic seq that survives
+  reconnects, and a full-snapshot fallback armed by the reconnect path —
+  so drop/duplicate/reset faults on the report path never corrupt the
+  fleet totals (they reconcile EXACTLY at quiescence);
+- the server's collector re-exports: ``fleet/<metric>`` gauges,
+  client-authoritative FleetTable columns, shipped span rows into the
+  server's own ``spans.jsonl`` (per-(host,pid) clock domains), and
+  merged fleet histograms for the sentinel's fleet bands;
+- fleet SLO bands are edge-triggered like every other band: one breach
+  entry, one counter bump, one flight bundle.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.client.abstract_client import DistributedClientConfig
+from distriflow_tpu.client.async_client import AsynchronousSGDClient
+from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.obs import (
+    BUCKET_BOUNDS,
+    FleetTable,
+    HealthSentinel,
+    Histogram,
+    ReportBuilder,
+    Telemetry,
+    TelemetryCollector,
+    metric_ident,
+    parse_ident,
+)
+from distriflow_tpu.obs.collector import FLEET_PREFIX, REPORT_VERSION
+from distriflow_tpu.obs.dump import summarize_critical_path, summarize_fleet
+from distriflow_tpu.obs.trace_assembler import assemble
+from distriflow_tpu.server.abstract_server import DistributedServerConfig
+from distriflow_tpu.server.async_server import AsynchronousSGDServer
+from distriflow_tpu.server.models import DistributedServerInMemoryModel
+from distriflow_tpu.utils.config import ClientHyperparams, RetryPolicy
+from distriflow_tpu.utils.messages import UploadMsg
+from tests.mock_model import MockModel
+
+pytestmark = pytest.mark.fleetobs
+
+
+# -- mergeable histograms ---------------------------------------------------
+
+
+def test_histogram_merge_matches_concatenated_samples():
+    """Property: merging two histograms is indistinguishable from one
+    histogram fed the concatenated sample stream — exact on
+    count/sum/min/max and bucket counts, and p50/p99 agree while the
+    union of windows fits the ring."""
+    rng = np.random.RandomState(7)
+    a_samples = rng.lognormal(mean=1.0, sigma=1.5, size=400).tolist()
+    b_samples = rng.lognormal(mean=3.0, sigma=0.5, size=300).tolist()
+
+    a = Histogram("lat_ms", {}, window=1024)
+    b = Histogram("lat_ms", {}, window=1024)
+    ref = Histogram("lat_ms", {}, window=1024)
+    for v in a_samples:
+        a.observe(v)
+        ref.observe(v)
+    for v in b_samples:
+        b.observe(v)
+        ref.observe(v)
+
+    a.merge(b)
+    sm, sr = a.summary(), ref.summary()
+    assert sm["count"] == sr["count"] == 700
+    assert sm["sum"] == pytest.approx(sr["sum"])
+    assert sm["min"] == sr["min"] and sm["max"] == sr["max"]
+    assert a.bucket_counts() == ref.bucket_counts()
+    # window union fits both rings -> quantiles over identical multisets
+    assert sm["p50"] == pytest.approx(sr["p50"])
+    assert sm["p99"] == pytest.approx(sr["p99"])
+
+
+def test_histogram_merge_from_export_state_dict():
+    """merge() accepts the JSON-able export_state too — what actually
+    arrives over the wire (including a JSON round trip)."""
+    src = Histogram("h", {})
+    for v in (0.5, 2.0, 1000.0):
+        src.observe(v)
+    state = json.loads(json.dumps(src.export_state()))
+    dst = Histogram("h", {})
+    dst.observe(4.0)
+    dst.merge(state)
+    s = dst.summary()
+    assert s["count"] == 4
+    assert s["min"] == 0.5 and s["max"] == 1000.0
+    assert s["sum"] == pytest.approx(1006.5)
+
+
+def test_bucket_counts_sparse_and_complete():
+    h = Histogram("h", {})
+    h.observe(0.0001)            # below the first bound
+    h.observe(3.0)
+    h.observe(float(2 ** 40))    # beyond the last bound -> overflow slot
+    counts = h.bucket_counts()
+    assert all(isinstance(k, str) for k in counts)
+    assert sum(counts.values()) == 3
+    assert counts.get(str(len(BUCKET_BOUNDS))) == 1  # the overflow bucket
+
+
+def test_export_state_window_bound():
+    h = Histogram("h", {}, window=512)
+    for v in range(100):
+        h.observe(float(v))
+    state = h.export_state(max_window=16)
+    assert len(state["window"]) == 16
+    assert state["window"] == [float(v) for v in range(84, 100)]  # newest
+    assert state["count"] == 100  # aggregates stay cumulative
+
+
+def test_metric_ident_round_trip():
+    for name, labels in (("plain", {}),
+                         ("phase_ms", {"phase": "fit", "role": "client"}),
+                         ("x_total", {"b": "2", "a": "1"})):
+        ident = metric_ident(name, labels)
+        back_name, back_labels = parse_ident(ident)
+        assert back_name == name
+        assert back_labels == {k: str(v) for k, v in labels.items()}
+
+
+# -- report builder ---------------------------------------------------------
+
+
+def test_report_builder_full_then_delta_keys_cumulative_values():
+    t = Telemetry()
+    c = t.counter("reqs_total", role="client")
+    g = t.gauge("version")
+    c.inc(3)
+    g.set(7)
+    b = ReportBuilder(t, "cid")
+    r1 = b.build()
+    assert r1["v"] == REPORT_VERSION and r1["full"] and r1["seq"] == 1
+    assert r1["counters"]["reqs_total{role=client}"] == 3
+    assert r1["gauges"]["version"] == 7
+
+    r2 = b.build()  # nothing changed -> empty delta, seq still advances
+    assert not r2["full"] and r2["seq"] == 2
+    assert r2["counters"] == {} and r2["gauges"] == {}
+
+    c.inc(2)
+    r3 = b.build()
+    assert list(r3["counters"]) == ["reqs_total{role=client}"]
+    assert r3["counters"]["reqs_total{role=client}"] == 5  # cumulative
+    assert r3["gauges"] == {}
+
+    b.reset()  # the reconnect path: next report re-ships the world
+    r4 = b.build()
+    assert r4["full"] and r4["seq"] == 4
+    assert r4["counters"]["reqs_total{role=client}"] == 5
+    assert r4["gauges"]["version"] == 7
+
+
+def test_report_builder_never_ships_fleet_namespace():
+    """A client sharing the server's Telemetry (loopback) must not echo
+    the collector's own fleet/ aggregates back into a report."""
+    t = Telemetry()
+    t.counter("real_total").inc()
+    t.registry.gauge(FLEET_PREFIX + "real_total").set(41)
+    h = t.histogram(FLEET_PREFIX + "lat_ms")
+    h.observe(1.0)
+    r = ReportBuilder(t, "cid").build()
+    assert "real_total" in r["counters"]
+    assert not any(k.startswith(FLEET_PREFIX) for k in r["gauges"])
+    assert not any(k.startswith(FLEET_PREFIX) for k in r["hists"])
+
+
+def test_report_builder_span_batch_high_water():
+    t = Telemetry()
+    with t.span("upload"):
+        pass
+    b = ReportBuilder(t, "cid")
+    r1 = b.build()
+    assert len(r1["spans"]) == 1
+    assert b.build()["spans"] == []  # already shipped
+    with t.span("upload"):
+        pass
+    r3 = b.build()
+    assert len(r3["spans"]) == 1  # only the new one
+
+
+# -- collector --------------------------------------------------------------
+
+
+def _report(cid, seq, counters=None, full=False, **extra):
+    r = {"v": REPORT_VERSION, "client_id": cid, "host": "hostA", "pid": 1,
+         "seq": seq, "full": full, "time": 0.0,
+         "counters": counters or {}, "gauges": {}, "hists": {}, "spans": []}
+    r.update(extra)
+    return r
+
+
+def test_collector_replace_semantics_and_seq_gating():
+    t = Telemetry()
+    c = TelemetryCollector(t)
+    assert c.ingest("conn1", _report("cid", 1, {"x_total": 3.0}, full=True))
+    # duplicate delivery (an upload retry): same seq -> stale-dropped
+    assert not c.ingest("conn1", _report("cid", 1, {"x_total": 3.0}, full=True))
+    assert c.stale_dropped == 1
+    # values REPLACE (cumulative), never add
+    assert c.ingest("conn1", _report("cid", 2, {"x_total": 5.0}))
+    assert c.totals() == {"x_total": 5.0}
+    # out-of-order stale report must not regress the state
+    assert not c.ingest("conn1", _report("cid", 1, {"x_total": 3.0}))
+    assert c.totals() == {"x_total": 5.0}
+    # wrong version is refused outright
+    assert not c.ingest("conn1", {"v": 99, "seq": 3})
+    assert c.full_reports == 1 and c.reports_ingested == 2
+
+
+def test_collector_fleet_gauges_and_multi_client_totals():
+    t = Telemetry()
+    c = TelemetryCollector(t)
+    c.ingest("c1", _report("cid1", 1, {"x_total{role=client}": 3.0}, full=True))
+    c.ingest("c2", _report("cid2", 1, {"x_total{role=client}": 4.0}, full=True))
+    assert c.totals() == {"x_total{role=client}": 7.0}
+    fleet_gauge = t.registry.find(FLEET_PREFIX + "x_total", role="client")
+    assert fleet_gauge is not None and fleet_gauge.value == 7.0
+    # a full report that no longer carries an ident retires the client's
+    # contribution (its past life is gone wholesale)
+    c.ingest("c1", _report("cid1", 2, {"y_total": 1.0}, full=True))
+    assert c.totals() == {"x_total{role=client}": 4.0, "y_total": 1.0}
+
+
+def test_collector_fleet_histogram_merges_client_states():
+    t = Telemetry()
+    col = TelemetryCollector(t)
+    states = {}
+    for cid, vals in (("a", (1.0, 2.0)), ("b", (100.0, 200.0))):
+        h = Histogram("ack_ms", {"role": "client"})
+        for v in vals:
+            h.observe(v)
+        states[cid] = h.export_state()
+    for i, (cid, st) in enumerate(states.items(), start=1):
+        col.ingest(cid, _report(
+            cid, 1, full=True,
+            hists={metric_ident("ack_ms", {"role": "client"}): st}))
+    merged = col.fleet_histogram("ack_ms", role="client")
+    s = merged.summary()
+    assert s["count"] == 4 and s["min"] == 1.0 and s["max"] == 200.0
+    assert s["sum"] == pytest.approx(303.0)
+
+
+def test_collector_folds_client_authoritative_fleet_row():
+    t = Telemetry()
+    fleet = FleetTable()
+    fleet.connect("conn1")
+    col = TelemetryCollector(t, fleet=fleet)
+    fit_state = Histogram("phase_ms", {"phase": "fit", "role": "client"})
+    for v in (10.0, 12.0, 14.0):
+        fit_state.observe(v)
+    col.ingest("conn1", _report(
+        "stable-cid", 1, full=True,
+        gauges={"process_rss_bytes": 1024.0, "process_cpu_s": 2.5},
+        hists={metric_ident("phase_ms", {"phase": "fit", "role": "client"}):
+               fit_state.export_state()}))
+    row = fleet.snapshot()["conn1"]
+    assert row["client"] == "stable-cid"
+    assert row["host"] == "hostA"
+    assert row["report_seq"] == 1
+    assert row["rss_bytes"] == 1024.0 and row["cpu_s"] == 2.5
+    assert row["fit_ms"] == 12.0  # window median
+
+
+def test_collector_writes_shipped_spans_with_host(tmp_path):
+    tel = Telemetry(save_dir=str(tmp_path))
+    col = TelemetryCollector(tel)
+    span_row = {"span_id": "s1", "trace_id": "t1", "name": "upload",
+                "t0": 1.0, "t1": 2.0, "pid": 42}
+    col.ingest("c1", _report("cid", 1, full=True, spans=[span_row]))
+    # duplicate delivery must not duplicate the row
+    col.ingest("c1", _report("cid", 2, spans=[span_row]))
+    rows = [json.loads(line) for line in
+            open(os.path.join(str(tmp_path), "spans.jsonl"))]
+    shipped = [r for r in rows if r.get("span_id") == "s1"]
+    assert len(shipped) == 1
+    assert shipped[0]["host"] == "hostA"  # stamped from the report
+
+
+# -- process sampler --------------------------------------------------------
+
+
+def test_process_sampler_gauges_and_idempotence():
+    t = Telemetry()
+    t.register_process_sampler()
+    t.register_process_sampler()  # idempotent: one sampler, not two
+    snap = t.snapshot()
+    assert snap["gauges"]["process_rss_bytes"] > 0
+    assert snap["gauges"]["process_cpu_s"] > 0
+    assert len(t._samplers) == 1
+
+
+def test_process_sampler_noop_when_disabled():
+    t = Telemetry(enabled=False)
+    t.register_process_sampler()
+    assert t.snapshot().get("gauges", {}) == {}
+
+
+# -- config -----------------------------------------------------------------
+
+
+def test_report_interval_hyperparam_validation():
+    ClientHyperparams(telemetry_report_interval_s=0).validate()  # 0 = off
+    with pytest.raises(ValueError):
+        ClientHyperparams(telemetry_report_interval_s=-1.0).validate()
+
+
+def test_upload_msg_report_wire_round_trip():
+    r = _report("cid", 3, {"x_total": 1.0})
+    msg = UploadMsg(client_id="c", report=r)
+    wire = json.loads(json.dumps(msg.to_wire()))
+    back = UploadMsg.from_wire(wire)
+    assert back.report == r
+    # absent stays absent (old frames parse fine)
+    bare = UploadMsg(client_id="c")
+    assert "report" not in bare.to_wire()
+    assert UploadMsg.from_wire(bare.to_wire()).report is None
+
+
+# -- (host, pid) clock domains ----------------------------------------------
+
+
+def test_assembler_aligns_clocks_per_host_pid_domain():
+    """Two processes with the SAME pid on different hosts (a real
+    multi-host hazard once shipped spans land in one file) must get
+    separate clock domains: each domain's median wall-minus-mono offset
+    anchors its own monotonic timeline, so a wall-clock jump on one
+    shipped row is corrected by its domain's median — not smeared into
+    the other host's spans."""
+    rows = [
+        # server (hostA, pid 1): dispatch then apply
+        {"span_id": "d1", "trace_id": "t1", "name": "dispatch",
+         "start": 100.00, "mono": 5000.00, "dur_ms": 10.0,
+         "pid": 1, "host": "hostA"},
+        {"span_id": "a1", "trace_id": "t1", "parent_id": "u1",
+         "name": "apply", "start": 100.30, "mono": 5000.30, "dur_ms": 50.0,
+         "pid": 1, "host": "hostA", "status": "ok", "accepted": True},
+        # client (hostB, ALSO pid 1): its mono epoch is wildly different
+        # (per-boot origin), and the fit row's wall stamp jumped +1000 s
+        # (NTP step mid-run) — mono + median offset must still place it
+        {"span_id": "i1", "trace_id": "t1", "name": "install",
+         "start": 100.02, "mono": 77000.02, "dur_ms": 20.0,
+         "pid": 1, "host": "hostB"},
+        {"span_id": "f1", "trace_id": "t1", "name": "fit",
+         "start": 1100.05, "mono": 77000.05, "dur_ms": 150.0,
+         "pid": 1, "host": "hostB"},
+        {"span_id": "u1", "trace_id": "t1", "name": "upload",
+         "start": 100.20, "mono": 77000.20, "dur_ms": 120.0,
+         "pid": 1, "host": "hostB"},
+    ]
+    asm = assemble(rows)
+    assert len(asm.rounds) == 1
+    r = asm.rounds[0]
+    assert r.applied
+    # the jumped fit row was re-anchored: the round's hull is the real
+    # ~350 ms, not the 1000 s the raw wall stamps would imply
+    assert r.wall_ms < 1000.0
+    assert r.phases.get("fit", 0.0) == pytest.approx(150.0, abs=20.0)
+
+
+# -- wire integration -------------------------------------------------------
+
+
+def _wire_session(tmp_path, *, client_plan=None, n_batches=4,
+                  interval=0.001):
+    """One loopback async run with SEPARATE client/server Telemetry
+    (the in-process stand-in for separate processes). Returns
+    (server, client, tel_s, tel_c, applied)."""
+    x = np.arange(2 * n_batches, dtype=np.float32).reshape(-1, 1)
+    y = np.eye(2, dtype=np.float32)[np.arange(len(x)) % 2]
+    dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+    tel_s = Telemetry(save_dir=str(tmp_path / "srv"))
+    tel_c = Telemetry()
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(MockModel()),
+        dataset,
+        DistributedServerConfig(
+            save_dir=str(tmp_path / "m"),
+            heartbeat_interval_s=0.1, heartbeat_timeout_s=5.0,
+            server_hyperparams={"maximum_staleness": 1000},
+            telemetry=tel_s,
+        ),
+    )
+    server.setup()
+    client = AsynchronousSGDClient(
+        server.address,
+        MockModel(),
+        DistributedClientConfig(
+            client_id="wire-client",
+            hyperparams={"telemetry_report_interval_s": interval},
+            heartbeat_interval_s=0.1, heartbeat_timeout_s=5.0,
+            upload_timeout_s=2.0,
+            upload_retry=RetryPolicy(max_retries=8, initial_backoff_s=0.05,
+                                     max_backoff_s=0.5, seed=3),
+            fault_plan=client_plan,
+            telemetry=tel_c,
+        ),
+    )
+    return server, client, tel_s, tel_c
+
+
+def test_wire_reports_build_fleet_view_and_server_side_critical_path(tmp_path):
+    server, client, tel_s, tel_c = _wire_session(tmp_path)
+    try:
+        client.setup(timeout=10.0)
+        done = client.train_until_complete(timeout=60.0)
+        # quiesce: the fleet row must carry the client-authoritative
+        # columns a report folds in
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            rows = [r for r in server.fleet.snapshot().values()
+                    if r.get("client") == "wire-client"]
+            if rows and rows[0].get("fit_ms") is not None:
+                break
+            time.sleep(0.02)
+        tel_s.export_snapshot()  # while the fleet provider is still live
+    finally:
+        client.dispose()
+        server.stop()
+    assert done == 4 and server.applied_updates == 4
+
+    # fleet aggregates rode the server registry as fleet/ gauges
+    fleet_uploads = tel_s.registry.find(
+        FLEET_PREFIX + "client_uploads_total", role="client")
+    if fleet_uploads is None:  # metric naming varies; totals() is the API
+        assert server.collector.totals(), "no counters aggregated"
+    assert server.collector.client_ids() == ["wire-client"]
+    st = server.collector.client_state("wire-client")
+    assert st["seq"] >= 1 and st["counters"]
+
+    # client-authoritative columns in the fleet table
+    row = next(r for r in server.fleet.snapshot().values()
+               if r.get("client") == "wire-client")
+    assert row["fit_ms"] is not None
+    assert row["rss_bytes"] > 0  # the built-in process sampler shipped
+
+    # the server run dir ALONE attributes the multi-process run: shipped
+    # client spans landed in the server's spans.jsonl
+    srv_dir = str(tmp_path / "srv")
+    span_rows = [json.loads(line)
+                 for line in open(os.path.join(srv_dir, "spans.jsonl"))]
+    client_spans = [r for r in span_rows
+                    if r.get("name") in ("upload", "fit") and r.get("host")]
+    assert client_spans, "no shipped client spans in the server spans.jsonl"
+    lines = summarize_critical_path(srv_dir)
+    text = "\n".join(lines)
+    assert "round" in text or "bound_by" in text
+
+    # and `dump --fleet` renders the per-client table from metrics.jsonl
+    fleet_lines = "\n".join(summarize_fleet(srv_dir))
+    assert "wire-client" in fleet_lines
+    assert "fit_ms" in fleet_lines
+
+
+@pytest.mark.chaos
+def test_chaos_report_path_reconciles_exactly_and_full_fallback_once(tmp_path):
+    """FaultPlan drop+duplicate+reset aimed at the upload path (the
+    report carrier): totals reconcile EXACTLY at quiescence, the
+    scripted reset triggers the full-snapshot fallback exactly once
+    beyond the handshake, and duplicated deliveries are retired by seq
+    gating (stale counter moves, state does not)."""
+    plan = FaultPlan(
+        seed=3, drop=0.1, duplicate=0.1,
+        schedule=[ScriptedFault(event="uploadVars", nth=2, action="reset")],
+    )
+    server, client, tel_s, tel_c = _wire_session(
+        tmp_path, client_plan=plan, n_batches=4)
+    # guarantee at least one duplicate report delivery: drop the first
+    # ack so the client retries the identical upload bytes
+    server.config.fault_plan = None  # (ack drop is client-observed)
+    try:
+        client.setup(timeout=10.0)
+        done = client.train_until_complete(timeout=120.0)
+        deadline = time.monotonic() + 10.0
+        while (client.reconnects < 1 or server.applied_updates < 4) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert done == 4 and server.applied_updates == 4
+        assert client.reconnects >= 1, "scripted reset never reconnected"
+        # quiesce the client, then ship the builder's FINAL delta (a live
+        # connection's own heartbeat frames never stop moving the
+        # counters, so exactness is only defined at quiescence)
+        client.dispose()
+        server.collector.ingest("wire-client",
+                                client._report_builder.build())
+        totals = server.collector.totals()
+        local = {ident: v for ident, v
+                 in tel_c.registry.snapshot()["counters"].items()}
+        assert totals == local, {
+            k: (totals.get(k), local.get(k))
+            for k in set(totals) | set(local)
+            if totals.get(k) != local.get(k)}
+        # full fallback: exactly the handshake + the post-reset rebuild
+        assert server.collector.full_reports == 2, (
+            server.collector.full_reports)
+    finally:
+        client.dispose()
+        server.stop()
+
+
+# -- fleet SLO bands --------------------------------------------------------
+
+
+class _StubCollector:
+    def __init__(self, fleet, hist=None):
+        self.fleet = fleet
+        self._hist = hist
+
+    def fleet_histogram(self, name, **labels):
+        return self._hist if self._hist is not None else Histogram(
+            name, {k: str(v) for k, v in labels.items()})
+
+
+def test_fleet_straggler_band_edge_triggered(tmp_path):
+    tel = Telemetry(save_dir=str(tmp_path))
+    fleet = FleetTable()
+    for cid, rm in (("f1", 20.0), ("f2", 22.0), ("slowc", 200.0)):
+        fleet.connect(cid)
+        fleet.note_report(cid, client=f"stable-{cid}")
+        with fleet._lock:
+            fleet._rows[cid]["round_ms"] = rm
+    sentinel = HealthSentinel(
+        tel, collector=_StubCollector(fleet),
+        fleet_straggler_factor=2.0, dump_dir=str(tmp_path))
+    hits = [h for h in sentinel.check() if h["band"] == "fleet_straggler"]
+    assert len(hits) == 1
+    assert hits[0]["client_id"] == "slowc"
+    assert hits[0]["client"] == "stable-slowc"
+    assert hits[0]["bundle"], "no flight bundle dumped"
+    # still in breach -> edge-triggered silence
+    assert not [h for h in sentinel.check()
+                if h["band"] == "fleet_straggler"]
+    assert tel.counter_value("obs_slo_breach_total",
+                             band="fleet_straggler") == 1
+    # recovery then relapse re-arms the edge
+    with fleet._lock:
+        fleet._rows["slowc"]["round_ms"] = 21.0
+    sentinel.check()
+    with fleet._lock:
+        fleet._rows["slowc"]["round_ms"] = 500.0
+    assert [h for h in sentinel.check() if h["band"] == "fleet_straggler"]
+    assert tel.counter_value("obs_slo_breach_total",
+                             band="fleet_straggler") == 2
+
+
+def test_fleet_straggler_needs_two_clients():
+    tel = Telemetry()
+    fleet = FleetTable()
+    fleet.connect("only")
+    with fleet._lock:
+        fleet._rows["only"]["round_ms"] = 1e9
+    sentinel = HealthSentinel(tel, collector=_StubCollector(fleet),
+                              fleet_straggler_factor=2.0)
+    assert sentinel.check() == []
+
+
+def test_fleet_ack_p99_band_over_merged_histogram(tmp_path):
+    tel = Telemetry(save_dir=str(tmp_path))
+    h = Histogram("transport_ack_latency_ms", {"role": "client"})
+    for v in [5.0] * 20 + [900.0] * 5:
+        h.observe(v)
+    sentinel = HealthSentinel(
+        tel, collector=_StubCollector(FleetTable(), hist=h),
+        fleet_ack_p99_ms=100.0, fleet_min_count=8, dump_dir=str(tmp_path))
+    hits = [x for x in sentinel.check() if x["band"] == "fleet_ack_p99"]
+    assert len(hits) == 1 and hits[0]["observed"] > 100.0
+    assert not [x for x in sentinel.check()
+                if x["band"] == "fleet_ack_p99"]  # edge
+
+
+def test_fleet_ack_p99_band_respects_min_count():
+    tel = Telemetry()
+    h = Histogram("transport_ack_latency_ms", {"role": "client"})
+    for v in (900.0, 950.0):  # breach-worthy but too few samples
+        h.observe(v)
+    sentinel = HealthSentinel(
+        tel, collector=_StubCollector(FleetTable(), hist=h),
+        fleet_ack_p99_ms=100.0, fleet_min_count=8)
+    assert sentinel.check() == []
