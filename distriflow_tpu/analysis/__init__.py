@@ -1,0 +1,56 @@
+"""dfcheck — the project-native static-analysis plane.
+
+Run ``python -m distriflow_tpu.analysis [--json] [paths]`` to verify the
+repo's concurrency and tracing invariants over the package source:
+
+* **lock-discipline / lock-order** (:mod:`.lock_check`) — ``# guarded-by:``
+  annotated fields are only touched under their lock; the static
+  acquisition graph is acyclic.
+* **trace-side-effect / trace-concretize** (:mod:`.tracing_check`) — no
+  Python side effects or tracer concretization inside JAX-traced bodies.
+* **metric/span/fleet contracts** (:mod:`.obs_check`) — metric idents
+  parse and match docs/OBSERVABILITY.md; spans are balanced on all paths;
+  ``fleet/`` idents never ship from outside the collector.
+
+Triaged suppressions live in ``analysis/baseline.json``; the tier-1 gate
+(``tests/test_analysis.py``, marker ``analysis``) asserts zero
+non-baselined findings.  :mod:`.witness` holds the runtime lock-order
+witness (``DISTRIFLOW_LOCK_WITNESS=1``) exercised by the doctor drill.
+See docs/ANALYSIS.md for the annotation grammar and baseline workflow.
+"""
+
+from distriflow_tpu.analysis.core import (  # noqa: F401
+    BASELINE_PATH,
+    Finding,
+    load_baseline,
+    load_modules,
+    match_baseline,
+)
+from distriflow_tpu.analysis.witness import (  # noqa: F401
+    LockOrderViolation,
+    OrderedLock,
+    ordered_lock,
+    reset_witness,
+    witness_enabled,
+)
+
+
+def run_checks(paths, checks=None):
+    """Run the selected check families over ``paths``; returns findings
+    sorted by (path, line).  ``checks`` is an iterable of family names
+    (``lock``, ``tracing``, ``obs``); None runs all three."""
+    from distriflow_tpu.analysis.lock_check import check_locks
+    from distriflow_tpu.analysis.obs_check import check_obs
+    from distriflow_tpu.analysis.tracing_check import check_tracing
+
+    fams = set(checks) if checks else {"lock", "tracing", "obs"}
+    modules = load_modules(paths)
+    findings = []
+    if "lock" in fams:
+        findings.extend(check_locks(modules))
+    if "tracing" in fams:
+        findings.extend(check_tracing(modules))
+    if "obs" in fams:
+        findings.extend(check_obs(modules))
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
+    return findings

@@ -1,0 +1,336 @@
+"""dfcheck JAX tracing-safety lint.
+
+Functions that run under a JAX trace — ``@jax.jit`` bodies, Pallas kernels,
+``lax.scan``/``while_loop``/``cond``/``fori_loop`` bodies — execute ONCE at
+trace time, not per step.  Two bug classes follow (the PR 1 warm-trace-cache
+failure that silently swallowed the Pallas FLOP tally was exactly class 1):
+
+1. **trace-side-effect** — Python side effects inside a traced body fire
+   once at trace time and never again: telemetry bumps (``.inc()`` /
+   ``.observe()``), wall-clock reads (``time.*``), ``print``/logging, and
+   mutation (``.append``/``.extend``/subscript-store) of state captured from
+   an enclosing scope.
+2. **trace-concretize** — ``float()/int()/bool()/np.asarray()/np.array()``
+   on a traced value forces concretization: a ``TracerError`` at best, a
+   silently-baked-in constant at worst.  Taint starts at the traced
+   function's parameters and propagates through simple assignments; attribute
+   reads of static metadata (``.shape``/``.dtype``/``.ndim``/``.size``)
+   strip taint, since those are concrete on tracers by design.
+
+Root discovery is syntactic: decorators ``jax.jit``/``jit``/``pmap`` (bare
+or under ``functools.partial``), kernels passed as the first argument to
+``pallas_call``/``pl.pallas_call``, and function-valued arguments of
+``lax.scan``/``while_loop``/``fori_loop``/``cond`` (inline lambdas, or names
+resolved to ``def``\\ s in the same lexical scope).  ``# dfcheck:
+ignore[trace-side-effect]`` / ``ignore[trace-concretize]`` suppress per line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from distriflow_tpu.analysis.core import Finding, SourceModule
+
+_JIT_NAMES = {"jit", "pmap"}
+_BODY_TAKERS = {
+    # callee name -> indices of function-valued positional args
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "pallas_call": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+}
+_CONCRETIZERS = {"float", "int", "bool"}
+_NP_CONCRETIZERS = {"asarray", "array", "item"}
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "sharding"}
+_SIDE_EFFECT_ATTRS = {"inc", "observe"}  # metric mutation entry points
+#: in-place container mutators; deliberately excludes names common on pure
+#: functional APIs (optax ``optimizer.update``, set-like ``.add`` on modules)
+_MUTATORS = {"append", "extend", "insert", "setdefault"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted-name rendering: ``jax.lax.scan`` -> "jax.lax.scan"."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    name = _dotted(dec)
+    if name.split(".")[-1] in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        callee = _dotted(dec.func)
+        tail = callee.split(".")[-1]
+        if tail in _JIT_NAMES:
+            return True  # @jax.jit(static_argnums=...)
+        if tail == "partial" and dec.args:
+            return _dotted(dec.args[0]).split(".")[-1] in _JIT_NAMES
+    return False
+
+
+class _Scope:
+    """One lexical scope's local ``def``s, for resolving body-arg names."""
+
+    def __init__(self, parent: Optional["_Scope"] = None):
+        self.parent = parent
+        self.defs: Dict[str, ast.AST] = {}
+
+    def resolve(self, name: str) -> Optional[ast.AST]:
+        scope: Optional[_Scope] = self
+        while scope is not None:
+            if name in scope.defs:
+                return scope.defs[name]
+            scope = scope.parent
+        return None
+
+
+def _collect_roots(mod: SourceModule) -> List[Tuple[ast.AST, str]]:
+    """(function node, qualname) for every traced-body root in the module."""
+    roots: List[Tuple[ast.AST, str]] = []
+    seen: Set[int] = set()
+
+    def add(fn: ast.AST, qual: str) -> None:
+        if id(fn) not in seen and isinstance(
+            fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            seen.add(id(fn))
+            roots.append((fn, qual))
+
+    def walk(node: ast.AST, scope: _Scope, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope.defs[child.name] = child
+                cq = f"{qual}.{child.name}" if qual else child.name
+                if any(_is_jit_decorator(d) for d in child.decorator_list):
+                    add(child, cq)
+                walk(child, _Scope(scope), cq)
+                continue
+            if isinstance(child, ast.ClassDef):
+                walk(child, _Scope(scope), f"{qual}.{child.name}" if qual else child.name)
+                continue
+            if isinstance(child, ast.Call):
+                tail = _dotted(child.func).split(".")[-1]
+                if tail in _BODY_TAKERS:
+                    for idx in _BODY_TAKERS[tail]:
+                        if idx < len(child.args):
+                            arg = child.args[idx]
+                            if isinstance(arg, ast.Lambda):
+                                add(arg, f"{qual}.<lambda>" if qual else "<lambda>")
+                            elif isinstance(arg, ast.Name):
+                                target = scope.resolve(arg.id)
+                                if target is not None:
+                                    add(target, f"{qual}.{arg.id}" if qual else arg.id)
+                if tail in _JIT_NAMES and child.args:
+                    # jax.jit(fn) / partial-free call form
+                    arg = child.args[0]
+                    if isinstance(arg, ast.Name):
+                        target = scope.resolve(arg.id)
+                        if target is not None:
+                            add(target, f"{qual}.{arg.id}" if qual else arg.id)
+                    elif isinstance(arg, ast.Lambda):
+                        add(arg, f"{qual}.<lambda>" if qual else "<lambda>")
+            walk(child, scope, qual)
+
+    walk(mod.tree, _Scope(), "")
+    return roots
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = {a.arg for a in args.args + args.posonlyargs + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    names.discard("self")
+    return names
+
+
+def _tainted_names(expr: ast.AST, taint: Set[str]) -> List[str]:
+    """Tainted Names reachable in ``expr`` WITHOUT crossing a static-metadata
+    attribute (``x.shape[0]`` is concrete even when ``x`` is a tracer)."""
+    out: List[str] = []
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(node, ast.Call):
+            tail = _dotted(node.func).split(".")[-1]
+            if tail in ("len",):  # len() of a tracer is static
+                continue
+        if isinstance(node, ast.Name) and node.id in taint:
+            out.append(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class _TracedBodyLint:
+    def __init__(self, mod: SourceModule, fn: ast.AST, qual: str,
+                 findings: List[Finding]):
+        self.mod = mod
+        self.qual = qual
+        self.findings = findings
+        self.taint: Set[str] = _param_names(fn)
+        self.locals: Set[str] = set(self.taint)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        # first pass: every assigned name is local (captured-state detection)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            self.locals.add(sub.id)
+            elif isinstance(node, (ast.For,)):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        self.locals.add(sub.id)
+            elif isinstance(node, ast.comprehension):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        self.locals.add(sub.id)
+            elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+                for sub in ast.walk(node.optional_vars):
+                    if isinstance(sub, ast.Name):
+                        self.locals.add(sub.id)
+        # second pass: propagate taint through assignments to a fixpoint
+        # (ast.walk order is not execution order, so iterate until stable)
+        assigns = [n for n in ast.walk(fn) if isinstance(n, ast.Assign)]
+        changed = True
+        while changed:
+            changed = False
+            for node in assigns:
+                if _tainted_names(node.value, self.taint):
+                    for t in node.targets:
+                        for sub in ast.walk(t):
+                            if isinstance(sub, ast.Name) and sub.id not in self.taint:
+                                self.taint.add(sub.id)
+                                changed = True
+        for stmt in body:
+            self._visit(stmt)
+
+    def _flag(self, node: ast.AST, check: str, what: str, detail: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if self.mod.ignored(line, check):
+            return
+        self.findings.append(
+            Finding(
+                check=check,
+                path=self.mod.relpath,
+                line=line,
+                symbol=self.qual,
+                message=what,
+                detail=detail,
+            )
+        )
+
+    def _visit(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._visit_call(sub)
+            # subscript store on captured state: xs[i] = ... where xs is not
+            # local to the traced body
+            if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id not in self.locals
+                    ):
+                        self._flag(
+                            sub,
+                            "trace-side-effect",
+                            f"subscript store into captured {t.value.id!r} "
+                            "inside a traced body",
+                            f"store:{t.value.id}",
+                        )
+
+    def _visit_call(self, call: ast.Call) -> None:
+        dotted = _dotted(call.func)
+        tail = dotted.split(".")[-1]
+        head = dotted.split(".")[0] if dotted else ""
+        # -- side effects ------------------------------------------------
+        if head == "time" and tail in (
+            "time", "perf_counter", "monotonic", "sleep", "process_time",
+        ):
+            self._flag(
+                call, "trace-side-effect",
+                f"{dotted}() inside a traced body runs once at trace time",
+                dotted,
+            )
+            return
+        if dotted == "print" or head in ("logging",) or tail in ("log_exception",):
+            self._flag(
+                call, "trace-side-effect",
+                f"{dotted}() inside a traced body fires only at trace time",
+                dotted,
+            )
+            return
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            recv = _dotted(call.func.value)
+            if attr in _SIDE_EFFECT_ATTRS:
+                self._flag(
+                    call, "trace-side-effect",
+                    f"telemetry mutation {recv}.{attr}() inside a traced body "
+                    "fires once at trace time, not per step",
+                    f"{recv}.{attr}",
+                )
+                return
+            if (
+                attr in _MUTATORS
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id not in self.locals
+            ):
+                self._flag(
+                    call, "trace-side-effect",
+                    f"mutation {recv}.{attr}() of captured state inside a "
+                    "traced body",
+                    f"{recv}.{attr}",
+                )
+                return
+        # -- concretization ----------------------------------------------
+        conc = None
+        if dotted in _CONCRETIZERS:
+            conc = dotted
+        elif isinstance(call.func, ast.Attribute) and call.func.attr in _NP_CONCRETIZERS:
+            if _dotted(call.func.value).split(".")[0] in ("np", "numpy"):
+                conc = f"np.{call.func.attr}"
+            elif call.func.attr == "item":
+                # tracer.item() concretizes regardless of receiver module
+                if isinstance(call.func.value, ast.Name):
+                    conc = "item"
+        if conc:
+            args = list(call.args)
+            if conc == "item" and isinstance(call.func, ast.Attribute):
+                args = [call.func.value]
+            names = [n for a in args for n in _tainted_names(a, self.taint)]
+            if names:
+                self._flag(
+                    call, "trace-concretize",
+                    f"{conc}() concretizes traced value(s) "
+                    f"{', '.join(sorted(set(names)))}",
+                    f"{conc}:{','.join(sorted(set(names)))}",
+                )
+
+
+def check_tracing(modules: List[SourceModule]) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        for fn, qual in _collect_roots(mod):
+            _TracedBodyLint(mod, fn, qual, findings)
+    return findings

@@ -10,6 +10,16 @@ reference ``federated_client.ts:138-140``).
 Client identity: explicit config > persisted identity file (the cookie
 equivalent — the reference stores a 1-year ``Distributed-learner-uuid``
 cookie, ``src/client/utils.ts:49-64``) > fresh uuid.
+
+Concurrency: the transport handler thread, the pipelined comm thread, and
+the background reconnect loop all touch client state. Shared mutable fields
+carry ``# guarded-by: <lock>`` annotations enforced by ``python -m
+distriflow_tpu.analysis`` (docs/ANALYSIS.md): ``_download_lock`` serializes
+weight installs, ``_comm_cv`` guards the upload-pipeline accounting, and
+``_stats_lock`` guards the small cross-thread stats (per-version update
+counts, telemetry-report clock). ``self.transport`` is deliberately
+unguarded: it is swapped atomically by the reconnect loop and callers
+capture it once per operation (``transport = self.transport``).
 """
 
 from __future__ import annotations
@@ -141,7 +151,11 @@ class AbstractClient:
         self.callbacks = CallbackRegistry("download", "new_version", "upload", "reconnect")
         self.transport: Optional[ClientTransport] = None
         self.msg: Optional[DownloadMsg] = None  # last Download
-        self.version_update_counts: Dict[str, int] = {}  # reference :36,112-122
+        self.version_update_counts: Dict[str, int] = {}  # reference :36,112-122  # guarded-by: _stats_lock
+        # guards the cross-thread stats below: a pipelined upload (comm
+        # thread) and a serial upload (handler thread) may finish
+        # concurrently, and the reconnect loop resets the report clock
+        self._stats_lock = threading.Lock()
         self._first_download = threading.Event()
         self._download_lock = threading.Lock()
         # reconnect machinery: _transport_ready is set while a dialed
@@ -182,7 +196,7 @@ class AbstractClient:
         # telemetry_report_interval_s; the process sampler adds host
         # RSS/CPU gauges to what ships (idempotent on shared Telemetry)
         self._report_builder = ReportBuilder(self.telemetry, self.client_id)
-        self._last_report_t = 0.0
+        self._last_report_t = 0.0  # guarded-by: _stats_lock
         self.telemetry.register_process_sampler()
         # int8/topk gradient compression: per-leaf compression residual
         # carried into the next upload (error feedback); keyed by tree path
@@ -201,7 +215,7 @@ class AbstractClient:
         self._comm_q: Optional["queue.Queue[Any]"] = None
         self._comm_thread: Optional[threading.Thread] = None
         self._comm_slots: Optional[threading.Semaphore] = None
-        self._comm_pending = 0
+        self._comm_pending = 0  # guarded-by: _comm_cv
         self._comm_cv = threading.Condition()
         self._comm_error: Optional[BaseException] = None
 
@@ -294,7 +308,8 @@ class AbstractClient:
                 # the server may be fresh (restart) or missed in-flight
                 # deltas: next telemetry report is a full snapshot, now
                 self._report_builder.reset()
-                self._last_report_t = 0.0
+                with self._stats_lock:
+                    self._last_report_t = 0.0
                 self.log(f"reconnected to {self.server_address} "
                          f"(attempt {attempt}, total reconnects {self.reconnects})")
                 self.callbacks.fire("reconnect", self.reconnects)
@@ -378,7 +393,9 @@ class AbstractClient:
         failed); True when the window is empty. No-op when serial."""
         with self._comm_cv:
             return self._comm_cv.wait_for(
-                lambda: self._comm_pending == 0, timeout)
+                # wait_for evaluates the predicate WITH the condition held —
+                # safe, but beyond the analyzer's lexical proof
+                lambda: self._comm_pending == 0, timeout)  # dfcheck: ignore[lock-discipline]
 
     def _stop_comm_thread(self) -> None:
         thread = self._comm_thread
@@ -589,9 +606,12 @@ class AbstractClient:
                          ack_wait_ms=ack_wait_ms)
         version = msg.gradients.version if msg.gradients is not None else None
         if version is not None:
-            self.version_update_counts[version] = (
-                self.version_update_counts.get(version, 0) + 1
-            )
+            # read-modify-write shared with the comm thread when uploads are
+            # pipelined: without the lock two concurrent acks can lose a count
+            with self._stats_lock:
+                self.version_update_counts[version] = (
+                    self.version_update_counts.get(version, 0) + 1
+                )
         self.callbacks.fire("upload", msg, result)
         return result
 
@@ -608,9 +628,12 @@ class AbstractClient:
         if interval <= 0:
             return None
         now = time.monotonic()
-        if now - self._last_report_t < interval:
-            return None
-        self._last_report_t = now
+        # check-and-advance under the lock: two uploads racing the interval
+        # boundary must not both win and ship two full report builds
+        with self._stats_lock:
+            if now - self._last_report_t < interval:
+                return None
+            self._last_report_t = now
         return builder.build()
 
     # -- hyperparameters -----------------------------------------------------

@@ -208,10 +208,10 @@ class FaultPlan:
         self.delay_s = delay_s
         self.schedule = list(schedule)
         self.exempt = frozenset(exempt)
-        self._rng = random.Random(seed)
+        self._rng = random.Random(seed)  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._counts: collections.Counter = collections.Counter()  # frames seen
-        self.injected: collections.Counter = collections.Counter()  # faults fired
+        self._counts: collections.Counter = collections.Counter()  # frames seen  # guarded-by: _lock
+        self.injected: collections.Counter = collections.Counter()  # faults fired  # guarded-by: _lock
 
     def frames_seen(self, event: str) -> int:
         with self._lock:

@@ -91,17 +91,20 @@ class DistributedDataset:
                 f"dataset of {n} examples yields no batches at batch_size={bs} "
                 f"with small_last_batch={self.config.small_last_batch}"
             )
-        self.epoch = 0
+        self.epoch = 0  # guarded-by: _cond
         self._lock = threading.Lock()
+        # _cond wraps _lock, so ``with self._cond`` IS the lock hold; all
+        # dispatch state below is annotated against _cond for that reason
         self._cond = threading.Condition(self._lock)
-        self._incomplete: Set[int] = set(range(self.num_batches))
-        self._outstanding: Set[int] = set()  # served, awaiting ack
-        self._unserved: List[int] = self._epoch_order()
+        self._incomplete: Set[int] = set(range(self.num_batches))  # guarded-by: _cond
+        self._outstanding: Set[int] = set()  # served, awaiting ack  # guarded-by: _cond
+        self._unserved: List[int] = self._epoch_order()  # guarded-by: _cond
         self._preprocess: List[Preprocess] = []
-        self.exhausted = False  # all epochs fully acked
+        self.exhausted = False  # all epochs fully acked  # guarded-by: _cond
 
     # -- ordering ---------------------------------------------------------
 
+    # dfcheck: holds _cond
     def _epoch_order(self) -> List[int]:
         order = list(range(self.num_batches))
         if self.config.shuffle:
@@ -199,7 +202,7 @@ class DistributedDataset:
         were outstanding (dispatched, awaiting ack) at snapshot time.
         JSON-able by construction (see ``CheckpointStore.save(manifest=)``).
         """
-        with self._lock:
+        with self._cond:
             return {
                 "epoch": int(self.epoch),
                 "num_batches": int(self.num_batches),
@@ -237,12 +240,12 @@ class DistributedDataset:
 
     @property
     def incomplete_batches(self) -> Set[int]:
-        with self._lock:
+        with self._cond:
             return set(self._incomplete)
 
     @property
     def outstanding_batches(self) -> Set[int]:
-        with self._lock:
+        with self._cond:
             return set(self._outstanding)
 
     # -- batch materialization --------------------------------------------

@@ -48,8 +48,13 @@ silent socket.io hang). Checks, in order:
     scripted 0.3 s upload delay (and only then); the bench ledger must
     flag a synthetically slowed row as ``regress`` on exactly one
     metric (see ``docs/OBSERVABILITY.md`` §9);
-14. native C++ host library presence (optional — numpy fallback is fine);
-15. checkpoint write/read round trip in a temp dir.
+14. lock-order witness drill: a scripted A->B / B->A inversion on
+    witnessed locks (``analysis/witness.py``) must raise
+    ``LockOrderViolation`` exactly once, a clean same-order run must
+    raise nothing, and the disabled factory must hand back a plain
+    ``threading.Lock`` (the zero-cost-off contract);
+15. native C++ host library presence (optional — numpy fallback is fine);
+16. checkpoint write/read round trip in a temp dir.
 
 Exit code 0 when every mandatory check passes; each check prints
 ``ok``/``FAIL`` with a one-line detail, so CI and humans read the same
@@ -1086,6 +1091,59 @@ def main() -> int:
 
     ok &= _check("critical-path drill (submit-delay attribution + "
                  "ledger gate)", critical_path)
+
+    def lock_witness():
+        import threading
+
+        from distriflow_tpu.analysis.witness import (
+            LockOrderViolation,
+            OrderedLock,
+            ordered_lock,
+            reset_witness,
+        )
+
+        # zero-cost-off contract: the factory hands back a PLAIN lock when
+        # the witness is disabled (no wrapper in any hot path by default)
+        plain = ordered_lock("doctor.plain", enabled=False)
+        if isinstance(plain, OrderedLock):
+            raise RuntimeError("ordered_lock(enabled=False) returned a wrapper")
+
+        reset_witness()
+        try:
+            a = OrderedLock("doctor.A")
+            b = OrderedLock("doctor.B")
+
+            # clean run: the same A -> B order from two threads is silent
+            def take_ab():
+                with a:
+                    with b:
+                        pass
+
+            take_ab()
+            t = threading.Thread(target=take_ab)
+            t.start()
+            t.join()
+
+            # scripted inversion: B -> A must raise exactly once, at the
+            # inner acquire, before the inner lock is touched
+            raised = 0
+            try:
+                with b:
+                    with a:
+                        raise RuntimeError("inverted acquire succeeded")
+            except LockOrderViolation:
+                raised = 1
+            if raised != 1:
+                raise RuntimeError("lock-order inversion did not raise")
+
+            # the refused acquire must not corrupt witness state: the
+            # recorded order still works and the locks are all free
+            take_ab()
+        finally:
+            reset_witness()
+        return "inversion raised once; clean order silent"
+
+    ok &= _check("lock-order witness drill (scripted inversion)", lock_witness)
 
     def native():
         from distriflow_tpu import native

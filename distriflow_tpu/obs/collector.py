@@ -187,15 +187,15 @@ class TelemetryCollector:
         self._lock = threading.Lock()
         # per-client replace-not-add state: seq high-water + latest
         # cumulative maps (counters/gauges/hists keyed by ident)
-        self._clients: Dict[str, Dict[str, Any]] = {}
+        self._clients: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
         # span_ids already written (bounded): retries/duplicates and the
         # shared-Telemetry loopback case must not duplicate rows
         self._span_seen: "collections.OrderedDict[str, None]" = \
-            collections.OrderedDict()
-        self._span_logger = None
-        self.reports_ingested = 0
-        self.full_reports = 0
-        self.stale_dropped = 0
+            collections.OrderedDict()  # guarded-by: _lock
+        self._span_logger = None  # guarded-by: _lock
+        self.reports_ingested = 0  # guarded-by: _lock
+        self.full_reports = 0  # guarded-by: _lock
+        self.stale_dropped = 0  # guarded-by: _lock
         self._c_reports = telemetry.counter("fleet_reports_total")
         self._c_full = telemetry.counter("fleet_reports_full_total")
         self._c_stale = telemetry.counter("fleet_reports_stale_total")
@@ -389,10 +389,14 @@ class TelemetryCollector:
         t = self.telemetry.tracer
         if getattr(t, "_logger", None) is not None:
             return t._logger
-        if self._span_logger is None and self.telemetry.save_dir is not None:
-            from distriflow_tpu.obs.tracing import SPANS_FILENAME
-            from distriflow_tpu.utils.metrics_log import MetricsLogger
-            self._span_logger = MetricsLogger(
-                os.path.join(self.telemetry.save_dir, SPANS_FILENAME),
-                stamp_time=False)
-        return self._span_logger
+        # lazy init under the lock: two handler threads ingesting reports
+        # concurrently must not each build a MetricsLogger for the same
+        # file (two handles interleaving writes into one spans.jsonl)
+        with self._lock:
+            if self._span_logger is None and self.telemetry.save_dir is not None:
+                from distriflow_tpu.obs.tracing import SPANS_FILENAME
+                from distriflow_tpu.utils.metrics_log import MetricsLogger
+                self._span_logger = MetricsLogger(
+                    os.path.join(self.telemetry.save_dir, SPANS_FILENAME),
+                    stamp_time=False)
+            return self._span_logger

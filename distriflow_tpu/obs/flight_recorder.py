@@ -88,8 +88,8 @@ class FlightRecorder:
         self.capacity = int(capacity)
         self.save_dir = save_dir
         self.max_bundle_bytes = int(max_bundle_bytes)
-        self._ring: deque = deque(maxlen=self.capacity)
-        self._seq = itertools.count()
+        self._ring: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._seq = itertools.count()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._dumps = itertools.count()
         self.dumped: List[str] = []  # paths written this process

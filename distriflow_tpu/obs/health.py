@@ -222,9 +222,10 @@ class FleetTable:
 
     def __init__(self, capacity: int = 1024):
         self.capacity = int(capacity)
-        self._rows: Dict[str, Dict[str, Any]] = {}
+        self._rows: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
+    # dfcheck: holds _lock
     def _row(self, client_id: str) -> Dict[str, Any]:
         row = self._rows.get(client_id)
         if row is None:

@@ -158,7 +158,7 @@ class PhaseProfiler:
     def __init__(self, registry: Any, role: str):
         self.role = role
         self._registry = registry
-        self._hists: Dict[str, Any] = {}
+        self._hists: Dict[str, Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._local = threading.local()
         self._h_wall = registry.histogram(STEP_WALL, role=role)
@@ -166,6 +166,9 @@ class PhaseProfiler:
         self._h_idle = registry.histogram(STEP_IDLE, role=role)
 
     def _hist(self, name: str) -> Any:
+        # Deliberate double-checked fast path: dict.get on a never-shrinking
+        # dict is GIL-atomic, and a miss re-checks under the lock below.
+        # Triaged in analysis/baseline.json rather than ignored inline.
         h = self._hists.get(name)  # fast path: no lock on hit
         if h is None:
             with self._lock:

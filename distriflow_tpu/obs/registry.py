@@ -133,16 +133,16 @@ class Histogram:
         self.name = name
         self.labels = labels
         self.window = int(window)
-        self._ring = [0.0] * self.window  # fixed-size: no growth per observe
-        self._n = 0  # filled slots (<= window)
-        self._i = 0  # next write index
-        self.count = 0
-        self.sum = 0.0
-        self.min: Optional[float] = None
-        self.max: Optional[float] = None
+        self._ring = [0.0] * self.window  # guarded-by: _lock
+        self._n = 0  # filled slots (<= window)  # guarded-by: _lock
+        self._i = 0  # next write index  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
+        self.sum = 0.0  # guarded-by: _lock
+        self.min: Optional[float] = None  # guarded-by: _lock
+        self.max: Optional[float] = None  # guarded-by: _lock
         # cumulative bucket counts over the FULL life of the handle (the
         # mergeable fleet export; see BUCKET_BOUNDS) — one overflow slot
-        self._buckets = [0] * (len(BUCKET_BOUNDS) + 1)
+        self._buckets = [0] * (len(BUCKET_BOUNDS) + 1)  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -173,12 +173,16 @@ class Histogram:
         return out
 
     def summary(self) -> Dict[str, float]:
-        s: Dict[str, float] = {
-            "count": self.count,
-            "sum": self.sum,
-            "min": self.min if self.min is not None else 0.0,
-            "max": self.max if self.max is not None else 0.0,
-        }
+        # snapshot the scalar aggregates under the lock: a concurrent
+        # observe() between the count and sum reads would otherwise hand
+        # back a torn (count, sum) pair whose mean never happened
+        with self._lock:
+            s: Dict[str, float] = {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min if self.min is not None else 0.0,
+                "max": self.max if self.max is not None else 0.0,
+            }
         s.update(self.percentiles())
         return s
 

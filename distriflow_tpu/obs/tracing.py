@@ -134,7 +134,7 @@ class Tracer:
     def __init__(self, enabled: bool = True, save_dir: Optional[str] = None,
                  max_spans: int = _MAX_SPANS):
         self.enabled = bool(enabled)
-        self._spans: collections.deque = collections.deque(maxlen=max_spans)
+        self._spans: collections.deque = collections.deque(maxlen=max_spans)  # guarded-by: _lock
         self._lock = threading.Lock()
         self._tls = threading.local()  # per-thread open-span stack
         self._logger = None

@@ -37,6 +37,7 @@ from distriflow_tpu.server.models import (
     DistributedServerModel,
     is_server_model,
 )
+from distriflow_tpu.analysis.witness import ordered_lock
 from distriflow_tpu.server.quarantine import GradientGate
 from distriflow_tpu.utils.config import (
     ClientHyperparams,
@@ -187,31 +188,34 @@ class AbstractServer:
         self.recovered = False  # True when setup() resumed from a manifest
         self.callbacks = CallbackRegistry("new_version", "upload", "connect", "disconnect")
 
-        self.num_clients = 0
-        self.num_updates = 0
-        self.updates: List[Dict[str, SerializedArray]] = []  # reference :41
+        self.num_clients = 0  # guarded-by: _lock
+        self.num_updates = 0  # guarded-by: _lock
+        self.updates: List[Dict[str, SerializedArray]] = []  # reference :41  # guarded-by: _lock
         # per-buffered-update aggregation weight (staleness decay); always
         # kept in lockstep with ``updates`` and consumed by mean_serialized
-        self._update_decays: List[float] = []
-        self.updating = False  # re-entrancy flag, reference :42
-        self._lock = threading.Lock()
+        self._update_decays: List[float] = []  # guarded-by: _lock
+        self.updating = False  # re-entrancy flag, reference :42  # guarded-by: _lock
+        # ordered_lock: plain threading.Lock unless DISTRIFLOW_LOCK_WITNESS
+        # is set, in which case acquisition ORDER between these named
+        # locks is recorded and an inversion raises (analysis/witness.py)
+        self._lock = ordered_lock("AbstractServer._lock")
         self.download_msg: Optional[DownloadMsg] = None
         # idempotent uploads: bounded LRU of applied update_id -> ack result,
         # plus in-flight gating so two concurrent deliveries of the same
         # update apply exactly once (the loser waits and re-acks the cached
         # result). duplicate_uploads counts suppressed re-applies.
-        self._applied_ids: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
-        self._dedup_inflight: Dict[str, threading.Event] = {}
-        self._dedup_lock = threading.Lock()
-        self.duplicate_uploads = 0
+        self._applied_ids: "collections.OrderedDict[str, Any]" = collections.OrderedDict()  # guarded-by: _dedup_lock
+        self._dedup_inflight: Dict[str, threading.Event] = {}  # guarded-by: _dedup_lock
+        self._dedup_lock = ordered_lock("AbstractServer._dedup_lock")
+        self.duplicate_uploads = 0  # guarded-by: _dedup_lock
         # delta broadcasts: which version each CONNECTION was last sent
         # (connection ids are per-dial uuids, so a reconnected client shows
         # up base-less and automatically gets a full broadcast), plus a
         # bounded window of host param snapshots to diff against. Guarded
         # by a dedicated leaf lock — the send paths run outside self._lock.
-        self._delta_lock = threading.Lock()
-        self._client_bases: Dict[str, str] = {}
-        self._param_history: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+        self._delta_lock = ordered_lock("AbstractServer._delta_lock")
+        self._client_bases: Dict[str, str] = {}  # guarded-by: _delta_lock
+        self._param_history: "collections.OrderedDict[str, Any]" = collections.OrderedDict()  # guarded-by: _delta_lock
         # apply pipeline (config.apply_queue_depth): created in setup()
         self._apply_queue: Optional["queue.Queue"] = None
         self._apply_worker: Optional[threading.Thread] = None

@@ -16,6 +16,12 @@ Two deliberate fixes over the reference:
 
 A disconnecting client's outstanding batch is requeued (failure recovery the
 reference lacks — lost batches there are only re-served on epoch wrap).
+
+Concurrency: handler threads, the apply worker, and the lease monitor all
+share the dispatch/apply state. Shared mutable fields carry ``# guarded-by:
+_lock`` annotations (enforced by ``python -m distriflow_tpu.analysis`` —
+see docs/ANALYSIS.md); helpers documented to run under the lock are marked
+``# dfcheck: holds _lock``.
 """
 
 from __future__ import annotations
@@ -57,45 +63,46 @@ class AsynchronousSGDServer(AbstractServer):
     ):
         super().__init__(model, config, transport)
         self.dataset = dataset
-        self.version_counter = 0  # integer staleness clock
+        self.version_counter = 0  # integer staleness clock  # guarded-by: _lock
         self._h_staleness = self.telemetry.histogram("server_gradient_staleness")
         self._c_applied = self.telemetry.counter("server_updates_applied_total")
         self._c_rejected = self.telemetry.counter("server_updates_rejected_total")
         self._c_lease_expired = self.telemetry.counter("server_lease_expirations_total")
         self._c_suppressed = self.telemetry.counter("server_first_wins_suppressed_total")
         self._c_requeued = self.telemetry.counter("server_recovery_requeued_total")
-        self._client_versions: Dict[str, int] = {}
+        self._client_versions: Dict[str, int] = {}  # guarded-by: _lock
         # outstanding batches per client, in dispatch order. One entry in
         # serial mode; up to the dispatch-ahead window when the pushed
         # client hyperparams carry inflight_window > 1 (the next batch
         # piggybacks on the ack/broadcast for the previous one, so a
         # pipelined client never idles on dispatch).
-        self._client_batches: Dict[str, List[int]] = {}
-        self._waiting: set = set()  # starved clients awaiting redispatch
-        self._completion_sent = False
-        self.applied_updates = 0
-        self.rejected_updates = 0
+        self._client_batches: Dict[str, List[int]] = {}  # guarded-by: _lock
+        self._waiting: set = set()  # starved clients  # guarded-by: _lock
+        self._completion_sent = False  # guarded-by: _lock
+        self.applied_updates = 0  # guarded-by: _lock
+        self.rejected_updates = 0  # guarded-by: _lock
         # straggler mitigation: (client_id, batch) -> monotonic deadline;
         # the monitor thread requeues expired leases for speculative
         # re-dispatch (config.batch_lease_s > 0 enables). Keyed per
         # dispatch, not per client, so every batch in a client's
         # dispatch-ahead window carries its own lease.
-        self._lease_deadlines: Dict[Tuple[str, int], float] = {}
+        self._lease_deadlines: Dict[Tuple[str, int], float] = {}  # guarded-by: _lock
         self._lease_stop = threading.Event()
         self._lease_thread: Optional[threading.Thread] = None
-        self.lease_expirations = 0
+        self.lease_expirations = 0  # guarded-by: _lock
         # gradients suppressed by first-wins arbitration (their batch was
         # already completed by another client — straggler's late answer)
-        self.suppressed_uploads = 0
+        self.suppressed_uploads = 0  # guarded-by: _lock
         # reconnect reconciliation: model-version string -> the counter value
         # when that version was published. A gradient from a client that
         # reconnected mid-flight has no per-connection dispatch record, but
         # it still names the version it was computed against — staleness is
         # judged from the GRADIENT's version, not the connection's history.
-        self._version_tokens: "collections.OrderedDict[str, int]" = collections.OrderedDict()
+        self._version_tokens: "collections.OrderedDict[str, int]" = collections.OrderedDict()  # guarded-by: _lock
 
     _VERSION_TOKEN_WINDOW = 64  # comfortably > any sane maximum_staleness
 
+    # dfcheck: holds _lock
     def _note_version_token(self) -> None:
         """Record the current (version string, counter) pair; call with
         ``self._lock`` held (or before the transport starts)."""
@@ -165,7 +172,8 @@ class AsynchronousSGDServer(AbstractServer):
             return False
         with self._lock:
             self._client_batches.setdefault(client_id, []).append(batch.batch)
-            self._client_versions[client_id] = self.version_counter
+            dispatch_version = self.version_counter
+            self._client_versions[client_id] = dispatch_version
             if self.config.batch_lease_s > 0:
                 self._lease_deadlines[(client_id, batch.batch)] = (
                     time.monotonic() + self.config.batch_lease_s
@@ -174,10 +182,12 @@ class AsynchronousSGDServer(AbstractServer):
         # the dispatch opens the update's trace: its trace_id rides the
         # download header, the client copies it into the resulting upload,
         # and the server's apply span closes the loop — one trace covers
-        # dispatch -> train -> upload -> apply, across retries/reconnects
+        # dispatch -> train -> upload -> apply, across retries/reconnects.
+        # The span records the version captured under the lock above — a
+        # concurrent apply must not skew what THIS dispatch was stamped with.
         with self.telemetry.span(
             "dispatch", client_id=client_id, batch=batch.batch,
-            version=self.version_counter,
+            version=dispatch_version,
         ) as span:
             msg = DownloadMsg(
                 # full-or-delta weights for THIS connection (delta when the
@@ -291,7 +301,10 @@ class AsynchronousSGDServer(AbstractServer):
             if first:
                 accepted = self._apply(client_id, msg)
             else:
-                self.suppressed_uploads += 1
+                # under the lock: races the manifest snapshot in _apply's
+                # save path, which reads this counter while holding it
+                with self._lock:
+                    self.suppressed_uploads += 1
                 self._c_suppressed.inc()
                 self.log(
                     f"suppressed gradient for batch {msg.batch} from "
@@ -443,8 +456,11 @@ class AsynchronousSGDServer(AbstractServer):
                         # the batch), only the lease is retired
                         self._lease_deadlines.pop((cid, batch))
                         expired.append((cid, batch))
+                # counted while still under the lock: the manifest snapshot
+                # reads this field holding _lock, and the monitor thread is
+                # the only writer after setup
+                self.lease_expirations += len(expired)
             for cid, batch in expired:
-                self.lease_expirations += 1
                 self._c_lease_expired.inc()
                 self.telemetry.flight.record("lease_expiry", client_id=cid,
                                              batch=batch)
@@ -457,6 +473,7 @@ class AsynchronousSGDServer(AbstractServer):
 
     # -- crash-consistent recovery ------------------------------------------
 
+    # dfcheck: holds _lock
     def _manifest(self) -> Dict[str, Any]:
         """Base manifest (dedup keys) + the async training plane: dataset
         cursor, version clock, and the apply/reject accounting. Runs under
@@ -476,6 +493,9 @@ class AsynchronousSGDServer(AbstractServer):
         )
         return m
 
+    # restore runs in setup(), before the transport/monitor threads exist —
+    # single-threaded by construction, so it owns the lock's state trivially
+    # dfcheck: holds _lock
     def _restore_manifest(self, manifest: Dict[str, Any]) -> bool:
         """Resume mid-epoch on a fresh server process: version clock and
         token window back, counters cumulative across incarnations, and

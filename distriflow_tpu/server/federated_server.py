@@ -30,7 +30,7 @@ class FederatedServer(AbstractServer):
     #: uploads dropped without buffering (unknown version, too stale,
     #: mid-aggregation, malformed) — the federated analog of the async
     #: server's ``rejected_updates``; chaos drills assert on it
-    dropped_uploads = 0
+    dropped_uploads = 0  # guarded-by: _lock
 
     def handle_connection(self, client_id: str) -> None:
         # send current weights (reference :69) — built per connection so the
@@ -137,7 +137,11 @@ class FederatedServer(AbstractServer):
             try:
                 self.update_model()
             finally:
-                self.updating = False
+                # re-lock for the flag drop: a concurrent handler reading
+                # ``updating`` under the lock must never see a torn window
+                # where aggregation finished but drops were still active
+                with self._lock:
+                    self.updating = False
         return True
 
     def _well_formed(self, vars_: Dict[str, SerializedArray]) -> bool:

@@ -234,13 +234,19 @@ class InferenceServer:
         self._queue: "queue_mod.Queue[Optional[_Request]]" = queue_mod.Queue()
         self._backlog: Deque[_Request] = deque()  # pulled, awaiting a slot
         self._dispatcher: Optional[threading.Thread] = None
-        self._stopped = False
+        # an Event, not a bare bool: stop() flips it from a control thread
+        # while handler threads re-check it post-enqueue (the TOCTOU close
+        # in _on_generate) — the Event makes the publish explicit instead
+        # of leaning on the GIL for visibility
+        self._stopped = threading.Event()
+        # single-writer counters: mutated ONLY on the scheduler thread,
+        # read cross-thread by tests/soaks (GIL-atomic int loads)
         self.decode_batches = 0  # engine decode iterations dispatched
         self.batched_requests = 0  # requests admitted into the engine
         # requests owned by each live connection, so a disconnect can
         # cancel its queued work and free its slots (chaos-reset tests)
         self._inflight_lock = threading.Lock()
-        self._inflight: Dict[str, List[_Request]] = {}
+        self._inflight: Dict[str, List[_Request]] = {}  # guarded-by: _inflight_lock
         # slot state (host side; device cache allocated lazily on first
         # admission). Free slots sit with done=True so the decode scan
         # leaves them frozen; their writes stay confined to their own row.
@@ -301,7 +307,7 @@ class InferenceServer:
     # -- lifecycle ---------------------------------------------------------
 
     def setup(self) -> "InferenceServer":
-        self._stopped = False
+        self._stopped.clear()
         # restart hygiene: a request that raced a previous stop() was
         # error-completed but may still sit in the queue — the new
         # scheduler must not serve orphans whose callers already errored
@@ -315,7 +321,7 @@ class InferenceServer:
         return self
 
     def stop(self) -> None:
-        self._stopped = True  # before the drain: closes the enqueue race
+        self._stopped.set()  # before the drain: closes the enqueue race
         self.transport.stop()
         if self._dispatcher is not None:
             self._queue.put(None)  # wake + exit sentinel
@@ -415,7 +421,7 @@ class InferenceServer:
             # may have drained and exited between the liveness check above
             # and the put) — error the item now rather than letting the
             # waiter ride the 600 s backstop
-            if self._stopped and not item.done.is_set():
+            if self._stopped.is_set() and not item.done.is_set():
                 item.error = RuntimeError("inference server stopped")
                 item.done.set()
             # generous last-resort bound (cold compiles can take minutes);

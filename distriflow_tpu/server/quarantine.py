@@ -86,10 +86,13 @@ class GradientGate:
         self._log = log or (lambda *a: None)
         self._c_quarantined = telemetry.counter("server_quarantined_total")
         self._c_rollbacks = telemetry.counter("server_rollbacks_total")
+        # quarantined_updates / rollbacks are serialized by the OWNING
+        # server's handler lock (every gate call sits inside the server's
+        # ``with self._lock``), so they carry no guard of their own
         self.quarantined_updates = 0
         self.rollbacks = 0
-        self._ema: Optional[float] = None
-        self._accepted = 0
+        self._ema: Optional[float] = None  # guarded-by: _lock
+        self._accepted = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     @property

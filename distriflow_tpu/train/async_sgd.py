@@ -196,11 +196,11 @@ class AsyncSGDTrainer:
         self.logger = VerboseLogger(f"AsyncSGD[{spec.name}]", verbose)
         self.callbacks = CallbackRegistry("new_version", "upload")
 
-        self.params: Optional[Params] = None
-        self._opt_state = None
-        self.version = 0
-        self.applied_updates = 0
-        self.rejected_updates = 0
+        self.params: Optional[Params] = None  # guarded-by: _lock
+        self._opt_state = None  # guarded-by: _lock
+        self.version = 0  # guarded-by: _lock
+        self.applied_updates = 0  # guarded-by: _lock
+        self.rejected_updates = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         _t = get_telemetry()
         self._h_staleness = _t.histogram("train_gradient_staleness", mode="async")
@@ -235,9 +235,9 @@ class AsyncSGDTrainer:
         self.admission_control = bool(admission_control)
         stale_window = int(self.hyperparams.maximum_staleness) + 1
         self._admission = threading.BoundedSemaphore(stale_window)
-        self._ticket_head = 0  # next ticket to issue (at snapshot)
-        self._ticket_tail = 0  # next ticket allowed to submit
-        self._aborted_tickets: set = set()
+        self._ticket_head = 0  # next ticket to issue (at snapshot)  # guarded-by: _lock
+        self._ticket_tail = 0  # next ticket allowed to submit  # guarded-by: _ticket_cv
+        self._aborted_tickets: set = set()  # guarded-by: _ticket_cv
         self._ticket_cv = threading.Condition()
 
         # per-phase wall-clock accounting (verdict #3: "nothing measures
@@ -251,7 +251,8 @@ class AsyncSGDTrainer:
         # only, and the actual device execution accrues while train()
         # waits for the queue at the end. Without the drain phase the
         # breakdown summed to ~10% of wall (round-4 verdict weak #3).
-        self.phase_ms = {"stage": 0.0, "snapshot": 0.0, "fit": 0.0,
+        # guarded-by: _phase_lock
+        self.phase_ms = {"stage": 0.0, "snapshot": 0.0, "fit": 0.0,  # guarded-by: _phase_lock
                          "submit": 0.0, "admission_wait": 0.0,
                          "pipeline_wait": 0.0, "drain": 0.0}
         self._phase_lock = threading.Lock()
@@ -276,8 +277,8 @@ class AsyncSGDTrainer:
         # streaming-bound and compute-bound async throughput. Incompatible
         # with host preprocess callbacks (checked at take time).
         self.stage_dataset = bool(stage_dataset)
-        self._staged_data: Dict[Any, Tuple[Any, Any]] = {}
-        self._slice_cache: Dict[int, Callable] = {}
+        self._staged_data: Dict[Any, Tuple[Any, Any]] = {}  # guarded-by: _build_lock
+        self._slice_cache: Dict[int, Callable] = {}  # guarded-by: _build_lock
         # guards the lazy jit/staging caches: without it N workers racing
         # the first miss each compile the identical program (20-40 s over
         # a remote backend) or re-transfer the whole dataset
@@ -497,9 +498,10 @@ class AsyncSGDTrainer:
     def init(self, rng: Optional[jax.Array] = None) -> Params:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         params = init_params(self.spec, rng)
-        self.params = jax.device_put(params, self.devices[0])
-        self._opt_state = self.optimizer.init(self.params)
-        return self.params
+        with self._lock:
+            self.params = jax.device_put(params, self.devices[0])
+            self._opt_state = self.optimizer.init(self.params)
+            return self.params
 
     # -- server side -------------------------------------------------------
 
@@ -521,9 +523,9 @@ class AsyncSGDTrainer:
         """Checkpoint params + optimizer state + version (synchronous)."""
         if self.store is None:
             raise RuntimeError("no checkpoint_dir configured")
-        if self.params is None:
-            raise RuntimeError("trainer not initialized")
         with self._lock:  # capture consistent refs only; write outside
+            if self.params is None:
+                raise RuntimeError("trainer not initialized")
             snap = (self.params, self._opt_state, self.version)
         return self._write_checkpoint(*snap)
 
@@ -531,7 +533,8 @@ class AsyncSGDTrainer:
         """Resume from the latest (or named) version. False when empty."""
         if self.store is None:
             raise RuntimeError("no checkpoint_dir configured")
-        if self.params is None:
+        # lifecycle: restore() runs before workers start; init() locks itself
+        if self.params is None:  # dfcheck: ignore[lock-discipline]
             self.init()
         version = version or self.store.last()
         if version is None:
@@ -574,6 +577,7 @@ class AsyncSGDTrainer:
             self.version += 1
             self.applied_updates += 1
             self._c_applied.inc()
+            new_version = self.version
             snap = None
             if (self.store is not None and self.save_every
                     and self.version % self.save_every == 0):
@@ -587,7 +591,7 @@ class AsyncSGDTrainer:
                 # the batch). Log; the next save boundary retries.
                 self.logger.log(f"auto-checkpoint failed: {e!r}")
         self.callbacks.fire("upload", client_id, grad_version)
-        self.callbacks.fire("new_version", str(self.version))
+        self.callbacks.fire("new_version", str(new_version))
         return True
 
     # -- worker side -------------------------------------------------------
@@ -709,7 +713,9 @@ class AsyncSGDTrainer:
                                         client_id=f"worker-{worker_index}")
                             self._phase(
                                 "submit", t0,
-                                self.params if self.profile_phases else ())
+                                # any recent params ref works as a barrier
+                                # target; exactness is not required here
+                                self.params if self.profile_phases else ())  # dfcheck: ignore[lock-discipline]
                     except BaseException:
                         # failure recovery: return the batches to the queue so
                         # another worker picks them up (the redelivery role of
@@ -817,7 +823,8 @@ class AsyncSGDTrainer:
 
     def train(self, num_workers: Optional[int] = None) -> Dict[str, int]:
         """Run workers over the dataset until exhausted; returns counters."""
-        if self.params is None:
+        # lifecycle: no worker threads exist yet; init() locks itself
+        if self.params is None:  # dfcheck: ignore[lock-discipline]
             self.init()
         n = num_workers if num_workers is not None else len(self.devices)
         errors: List[BaseException] = []
@@ -843,17 +850,20 @@ class AsyncSGDTrainer:
         # value fetch is the tunnel-proof barrier: on remote backends
         # block_until_ready can return before execution finishes.
         t_drain = time.perf_counter()
-        if self.params is not None:
-            jax.block_until_ready(self.params)
-            first = jax.tree.leaves(self.params)[0]
+        with self._lock:
+            params = self.params
+        if params is not None:
+            jax.block_until_ready(params)
+            first = jax.tree.leaves(params)[0]
             float(jnp.reshape(first, (-1,))[0])
         with self._phase_lock:
             self.phase_ms["drain"] += (time.perf_counter() - t_drain) * 1e3
-        return {
-            "applied": self.applied_updates,
-            "rejected": self.rejected_updates,
-            "version": self.version,
-        }
+        with self._lock:
+            return {
+                "applied": self.applied_updates,
+                "rejected": self.rejected_updates,
+                "version": self.version,
+            }
 
     # -- introspection -----------------------------------------------------
 
