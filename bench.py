@@ -1240,6 +1240,183 @@ def bench_serving_paged_mixed(short_len=1024, long_len=8192, max_seq=16384,
     }
 
 
+# -- serving: speculative decoding (draft/verify) vs plain paged decode ----
+
+
+def bench_serving_speculative(ctx_short=1024, ctx_long=16384, n_tokens=96,
+                              k=4):
+    """Round-12 row (docs/PERFORMANCE.md §7g): draft/verify speculative
+    decoding (``ServingConfig.speculate_k``) against plain paged decode
+    at the SAME page-pool budget, greedy, B=1 — speculation's target
+    regime (per-user decode latency; batch too small to fill the chip).
+
+    The zoo's ``lm_draft`` is distilled in-leg on the target's own greedy
+    trajectory for the short serving prompt — the offline step a real
+    deployment runs once over its traffic. With a random-weight target
+    there is no transferable draft (its greedy attractors are
+    prompt-specific), so the short-context acceptance sits near the
+    ceiling BY CONSTRUCTION and the row measures the serving-plane
+    mechanics (draft dispatch, batched verify, dual-pool commit) at a
+    pinned, *measured* acceptance; the long context serves the SAME
+    draft, so its acceptance shows the honest no-transfer floor — the
+    "when speculation loses" regime §7g documents. Decode ms/token is
+    differenced (an ``n_tokens`` call minus a 1-token call, prefix map
+    primed) so prefill/admission cost cancels, and both servers' outputs
+    are asserted bit-identical — the §7g greedy contract, re-proven at
+    bench dims every run."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distriflow_tpu.models.generate import generate, pages_per_slot
+    from distriflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        transformer_lm,
+    )
+    from distriflow_tpu.models.zoo import draft_config_for
+    from distriflow_tpu.obs import get_telemetry
+    from distriflow_tpu.server import InferenceServer
+    from distriflow_tpu.utils.config import ServingConfig
+
+    squeeze = SLOW or FAST or time_left() < 150
+    if squeeze:
+        ctx_short, ctx_long = ctx_short // 4, ctx_long // 4
+    labels = {ctx_short: "1k", ctx_long: "16k"}  # ledger keys stay nominal
+
+    rng = np.random.RandomState(0)
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=256, n_heads=4, n_layers=4, d_ff=1024,
+        max_seq=ctx_long, dtype=jnp.bfloat16)
+    params = transformer_lm(cfg, example_seq=128).init(jax.random.PRNGKey(0))
+    dcfg = draft_config_for("lm_draft", cfg)
+    prompts = {c: rng.randint(0, 32000, (1, c - n_tokens)).astype(np.int32)
+               for c in (ctx_short, ctx_long)}
+
+    # -- distill: fit lm_draft to the target's short-context trajectory ---
+    t0 = time.perf_counter()
+    steps = 30 if squeeze else 50
+    corpus = jnp.asarray(np.asarray(generate(
+        cfg, dict(params), jnp.asarray(prompts[ctx_short]), n_tokens)))
+    # teacher labels from the SERVED (bf16) target: label[i] is the argmax
+    # the server emits after consuming corpus[:, :i+1]
+    teach = jnp.argmax(TransformerLM(cfg).apply(dict(params), corpus), -1)
+    # train under f32 compute (CPU-friendly; converges in tens of steps);
+    # the server re-applies the same weights under the bf16 draft config
+    drf = TransformerLM(dataclasses.replace(dcfg, dtype=jnp.float32))
+    dparams = transformer_lm(
+        dataclasses.replace(dcfg, dtype=jnp.float32), example_seq=16,
+    ).init(jax.random.PRNGKey(1))
+    x, y = corpus[:, :-1], teach[:, :-1]
+    plen = prompts[ctx_short].shape[1]
+    mask = jnp.zeros(x.shape, jnp.float32).at[:, plen - 1:].set(1.0)
+    opt = optax.adam(4e-3)
+
+    def distill_loss(p):
+        lg = drf.apply(p, x).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(lg, y)
+        return (ce * mask).sum() / mask.sum()
+
+    @jax.jit
+    def distill_step(p, st):
+        loss, g = jax.value_and_grad(distill_loss)(p)
+        up, st = opt.update(g, st)
+        return optax.apply_updates(p, up), st, loss
+
+    st = opt.init(dparams)
+    for _ in range(steps):
+        dparams, st, loss = distill_step(dparams, st)
+    distill_secs = time.perf_counter() - t0
+    log(f"serving_speculative: distilled lm_draft {steps} steps on the "
+        f"{labels[ctx_short]} trajectory ({n_tokens} tok), final CE "
+        f"{float(loss):.3f} ({distill_secs:.1f}s)")
+
+    PAGE_SIZE = 128
+    pool_pages = 4 * pages_per_slot(cfg.max_seq, PAGE_SIZE)
+    tel = get_telemetry()
+
+    def run_layout(spec):
+        extra = ({"speculate_k": k, "draft_model": "lm_draft"}
+                 if spec else {})
+        server = InferenceServer(
+            cfg, params, port=0,
+            serving=ServingConfig(
+                kv_layout="paged", max_slots=4, page_size=PAGE_SIZE,
+                page_pool_pages=pool_pages, batch_window_s=0.02, **extra),
+            draft_params=dparams if spec else None)
+        server.transport.heartbeat_timeout = 0  # see bench_serving
+        server.setup()
+        out = {}
+        try:
+            client = _serving_client(server.address)
+            try:
+                for ctx in (ctx_short, ctx_long):
+                    prompt = prompts[ctx]
+                    client.generate(prompt, n_tokens=3)  # compile + prime
+                    p0 = tel.counter_value("serving_spec_proposed_total")
+                    a0 = tel.counter_value("serving_spec_accepted_total")
+                    t = time.perf_counter()
+                    client.generate(prompt, n_tokens=1)
+                    t1 = time.perf_counter() - t
+                    t = time.perf_counter()
+                    full = client.generate(prompt, n_tokens=n_tokens)
+                    tn = time.perf_counter() - t
+                    prop = tel.counter_value(
+                        "serving_spec_proposed_total") - p0
+                    acc = tel.counter_value(
+                        "serving_spec_accepted_total") - a0
+                    out[ctx] = {
+                        "ms_tok": (tn - t1) * 1e3 / (n_tokens - 1),
+                        "out": full,
+                        "accept": acc / prop if prop else None,
+                        "acc_per_round": acc * k / prop if prop else None,
+                    }
+            finally:
+                client.close()
+        finally:
+            server.stop()
+        return out
+
+    spec_out = run_layout(True)
+    plain_out = run_layout(False)
+    for ctx in (ctx_short, ctx_long):
+        # the §7g contract at bench dims: greedy spec == greedy plain, bit
+        # for bit, regardless of what the draft proposed
+        np.testing.assert_array_equal(spec_out[ctx]["out"],
+                                      plain_out[ctx]["out"])
+
+    row = {
+        "config": "serving_speculative",
+        "metric": (f"decode speedup, spec k={k} distilled draft vs plain "
+                   f"@ equal KV pool (greedy B=1, {labels[ctx_short]} ctx)"),
+        "value": round(plain_out[ctx_short]["ms_tok"]
+                       / spec_out[ctx_short]["ms_tok"], 3),
+        "accepted_per_step": round(
+            spec_out[ctx_short]["acc_per_round"], 2),
+        "distill_secs": round(distill_secs, 1),
+        "traffic": (f"B=1 +{n_tokens} tok, k={k}, pool {pool_pages} pages,"
+                    f" ctx {ctx_short}/{ctx_long}"),
+    }
+    for ctx in (ctx_short, ctx_long):
+        lab = labels[ctx]
+        row[f"spec_ms_tok_{lab}"] = round(spec_out[ctx]["ms_tok"], 3)
+        row[f"plain_ms_tok_{lab}"] = round(plain_out[ctx]["ms_tok"], 3)
+        if spec_out[ctx]["accept"] is not None:
+            row[f"accept_rate_{lab}"] = round(spec_out[ctx]["accept"], 3)
+    log(f"serving_speculative: spec/plain ms/tok "
+        f"{labels[ctx_short]}={row[f'spec_ms_tok_{labels[ctx_short]}']}"
+        f"/{row[f'plain_ms_tok_{labels[ctx_short]}']} "
+        f"{labels[ctx_long]}={row[f'spec_ms_tok_{labels[ctx_long]}']}"
+        f"/{row[f'plain_ms_tok_{labels[ctx_long]}']}, "
+        f"accept {row.get(f'accept_rate_{labels[ctx_short]}')}"
+        f"/{row.get(f'accept_rate_{labels[ctx_long]}')}, "
+        f"speedup {row['value']}x @ {labels[ctx_short]}")
+    return row
+
+
 # -- long context: 16k/32k chunked prefill + decode latency ----------------
 
 
@@ -1433,6 +1610,28 @@ def bench_decode(n_chips):
 # -- flagship MoE: Switch top-1 / GShard top-2 on the real chip ------------
 
 
+def _moe_phase_fwd_flops(cfg, n_tok):
+    """Exact analytic fwd FLOPs of ONE MoE layer's phases, mirroring the
+    einsums in models/transformer.py::MoEFFN: router Dense(E) over every
+    token; dispatch "xtec,xtd->xecd" and combine "xtec,xecd->xtd" over
+    the CHOICE-MAJOR t = k*g axis; expert = two [E,C,d]x[d,f] matmuls.
+    Unit-tested against einsum contraction math in
+    tests/test_bench_record.py."""
+    from distriflow_tpu.parallel.ring_attention import _auto_block
+
+    k, E = cfg.moe_top_k, cfg.n_experts
+    g = _auto_block(n_tok, cfg.moe_group_size)
+    G = n_tok // g
+    C = max(1, int(cfg.capacity_factor * k * g / E))
+    d, f = cfg.d_model, cfg.d_ff
+    return {
+        "router": 2.0 * n_tok * d * E,
+        "dispatch": 2.0 * G * k * g * E * C * d,
+        "expert": 4.0 * G * E * C * d * f,
+        "combine": 2.0 * G * k * g * E * C * d,
+    }
+
+
 def bench_moe(n_chips, matrix):
     """MoE rows (round-3): tokens/s + exact MFU for Switch top-1 and GShard
     top-2 at flagship dims, a routing-overhead ratio vs the dense flagship
@@ -1461,6 +1660,7 @@ def bench_moe(n_chips, matrix):
     dense = next(
         (e for e in matrix if e.get("config") == "transformer_lm_flagship"), {})
     variants = {}
+    top2_phases = {}  # router/dispatch/expert/combine split of the top2 row
     shared_params = None  # top-1/top-2 share the SAME param tree (the
     # router is Dense(E) either way) — init once, skip a jitted-init compile
     for k, name in ((1, "top1"), (2, "top2")):
@@ -1496,6 +1696,37 @@ def bench_moe(n_chips, matrix):
         mfu = _mfu_or_none(trainer, (x1, y1), r["step_ms"] / 1e3)
         toks = r["samples_per_sec"] * S
         variants[name] = {"tok_s": round(toks / n_chips, 1), "mfu": mfu}
+        if k == 2:
+            # round-12 satellite: name the top2-vs-dense MFU gap's culprit.
+            # Exact analytic model-FLOPs per MoE phase (fwd only — backward
+            # is a uniform 2x, so fwd shares equal total shares), divided
+            # by the step program's exact-FLOP tally (the same numerator
+            # mfu uses) and apportioned over the measured step at uniform
+            # achieved FLOP/s. Uniform-throughput attribution is a LOWER
+            # bound for dispatch/combine: the one-hot contractions run at
+            # far lower arithmetic intensity than the expert matmuls, so
+            # their real wall share can only be higher.
+            fwd = _moe_phase_fwd_flops(cfg, B * S)
+            try:
+                # per-device step FLOPs; the analytic tally above is
+                # whole-batch, so scale it down by the mesh degree
+                total = trainer.cost_analysis((x1, y1))["flops"]
+            except Exception as e:
+                total = 0.0
+                log(f"moe phase split: cost_analysis unavailable ({e!r})")
+            if total > 0:
+                top2_phases = {
+                    f"top2_{p}_ms": round(
+                        r["step_ms"] * (v * MOE_LAYERS * 3 / max(n_chips, 1))
+                        / total, 3)
+                    for p, v in fwd.items()
+                }
+                top2_phases["top2_other_ms"] = round(
+                    r["step_ms"] - sum(top2_phases.values()), 3)
+                log(f"moe top2 phase split (exact-FLOP shares of "
+                    f"{r['step_ms']:.1f} ms): " + ", ".join(
+                        f"{p.removeprefix('top2_').removesuffix('_ms')}="
+                        f"{v}" for p, v in top2_phases.items()))
         overhead = None
         if dense.get("step_ms"):
             # per-LAYER ratio vs the dense flagship (depths differ): >1 =
@@ -1540,6 +1771,7 @@ def bench_moe(n_chips, matrix):
         "mfu": variants["top1"]["mfu"],
         "top2_tok_s": variants["top2"]["tok_s"],
         "top2_mfu": variants["top2"]["mfu"],
+        **top2_phases,
     }
 
 
@@ -1624,6 +1856,49 @@ def bench_transformer_large(n_chips):
                      rounds=2, reps=2 if squeeze else 3)
 
 
+# headline legs with a pinned MFU floor (round-12 satellite; the round-5
+# verdict's named fix for the CIFAR 0.2865-vs-0.30 floor noise): a leg
+# landing under its floor re-runs ONCE and the surviving row records
+# retried=true, so the ledger can tell "one bad window" from "regressed".
+# Floors sit under the worst healthy run on record, not at the typical
+# value — they trip on pathology (slow window, cold tunnel), not jitter.
+_MFU_FLOORS = {
+    "cifar10_convnet_sync": 0.30,   # round-4/5 floor bar (mfu_min gates)
+    "transformer_lm_flagship": 0.45,  # r05 slow-window 248k vs 309k tok/s
+}
+
+
+def _floor_retry(matrix, fn, args):
+    """Degradation retry (round-12): a headline leg under its pinned
+    MFU floor re-runs once; the better row survives and carries
+    ``retried: true`` (a bool, so the ledger's numeric filter skips
+    it). The floor reads ``mfu_min`` (the measured spread floor)
+    where the leg reports one, else ``mfu``; CPU runs report neither
+    and never retry. Unit-tested in tests/test_bench_record.py."""
+    row = matrix[-1]
+    floor = _MFU_FLOORS.get(row.get("config"))
+    measured = row.get("mfu_min") or row.get("mfu")
+    if not floor or not measured or measured >= floor:
+        return
+    if time_left() < 45:
+        log(f"{row['config']}: mfu {measured} under floor {floor}, "
+            f"but no budget to retry ({time_left():.0f}s left)")
+        row["retried"] = False
+        return
+    log(f"{row['config']}: mfu {measured} under floor {floor} — "
+        f"re-running the leg once")
+    row["retried"] = True
+    try:
+        rerun = fn(*args)
+    except Exception:
+        log(f"--- {row['config']} floor retry FAILED (keeping the "
+            f"original row) ---\n{traceback.format_exc()}")
+        return
+    rerun["retried"] = True
+    if (rerun.get("mfu_min") or rerun.get("mfu") or 0) > measured:
+        matrix[-1] = rerun
+
+
 # -- record assembly -------------------------------------------------------
 
 # optional row fields, in drop order, should the line exceed the record
@@ -1631,6 +1906,8 @@ def bench_transformer_large(n_chips):
 # window must be enforced mechanically, not hoped about)
 _DROP_ORDER = [
     "recon_pct", "pipe_eff", "inflight_depth", "asm_overlap_ms",
+    "distill_secs", "top2_router_ms", "top2_other_ms", "top2_combine_ms",
+    "top2_dispatch_ms", "top2_expert_ms",
     "idle_ms", "overlap_ms", "submit_ms",
     "fit_ms", "drain_ms", "dispatch_ms", "ceiling_sps", "seq_ms", "conc_ms",
     "params_m", "round_ms", "workers", "step_ms", "mfu_med", "top2_mfu",
@@ -1721,6 +1998,7 @@ def main() -> None:
         for attempt in (1, 2):
             try:
                 matrix.append(fn(*args))
+                _floor_retry(matrix, fn, args)
                 break
             except Exception:
                 tb = traceback.format_exc()
@@ -1752,6 +2030,7 @@ def main() -> None:
         run(bench_serving)
         run(bench_serving_continuous)
         run(bench_serving_paged_mixed)
+        run(bench_serving_speculative)
         run(bench_decode, n_chips)
         run(bench_long_context)
     run(bench_mnist_sync, n_chips)

@@ -730,6 +730,247 @@ def _build_slot_fns(config: TransformerConfig, chunk: int,
     return insert, pick_rows, decode
 
 
+# ---------------------------------------------------------------------------
+# Speculative decoding: draft/verify device programs (docs/PERFORMANCE.md
+# §7g). A small draft model proposes k tokens per round; the target scores
+# all k+1 positions in ONE multi-token pass over the slot batch — the same
+# per-row visibility-mask einsum path chunked prefill uses, so the target's
+# logits at each position are computed by the same math as solo decode and
+# greedy acceptance reproduces the solo token stream exactly. Sampled rows
+# use the Leviathan et al. rejection-sampling correction, keyed by the
+# engine's fold_in(seed, absolute_position) determinism (distinct subkey
+# tags per decision so the draft sample, the accept coin and the residual
+# sample never share a key).
+
+#: fold_in tags under the per-position key: one stream per decision kind
+_SPEC_DRAFT_TAG = 1   # the draft model's own sample
+_SPEC_ACCEPT_TAG = 2  # the accept/reject uniform
+_SPEC_RESID_TAG = 3   # the residual (correction) sample
+
+
+def _set_cache_positions(cache, pos):
+    """Replace every ``cache_index`` leaf with ``pos`` ([B] int32) — the
+    per-row rollback/commit primitive speculative rounds use."""
+    p = jnp.asarray(pos, jnp.int32)
+
+    def walk(node):
+        if hasattr(node, "items"):
+            return {name: (p if name == "cache_index" else walk(sub))
+                    for name, sub in node.items()}
+        return node
+
+    return walk(cache)
+
+
+def _find_cache_leaf(cache, wanted):
+    if hasattr(cache, "items"):
+        for name, sub in cache.items():
+            if name == wanted:
+                return sub
+            found = _find_cache_leaf(sub, wanted)
+            if found is not None:
+                return found
+    return None
+
+
+def _oob_write_position(cache, max_seq: int) -> int:
+    """A logical position whose cache write is GUARANTEED to drop, for
+    diverting per-row writes we must suppress (static, from the cache's
+    own geometry). Paged: ``pages_per_slot * page_size`` — that position
+    maps through the pinned sentinel column, so the scatter lands past
+    the pool and JAX drops it (positions in ``[max_seq, pp*ps)`` would
+    land in a real page's tail when max_seq isn't page-aligned, which is
+    why plain ``max_seq`` is NOT safe here). Slab slot mode: ``max_seq``
+    itself is out of bounds and drops."""
+    pt = _find_cache_leaf(cache, "page_table")
+    if pt is None:
+        return max_seq
+    ck = _find_cache_leaf(cache, "cached_k")
+    return (pt.shape[1] - 1) * ck.shape[1]
+
+
+@functools.lru_cache(maxsize=8)
+def _build_spec_fns(config: TransformerConfig,
+                    draft_config: TransformerConfig,
+                    k: int, with_sampling: bool):
+    """Jit programs for one speculative round over the slot batch:
+
+    - ``draft_k(d_params, d_cache, tok, temps, top_ks, top_ps, seeds)``
+      -> ``(d_cache, drafts [B,k], qprobs [B,k,V])`` — k sequential
+      single-token draft-model steps from each row's committed position
+      (the draft cache writes ride its OWN page tables over the shared
+      pool). ``qprobs`` are the draft's post-truncation proposal
+      distributions (a [B,k,1] placeholder on the greedy-only build).
+    - ``verify(params, cache, tok, drafts, qprobs, temps, top_ks,
+      top_ps, seeds, done, eos)`` -> ``(cache, emit [B,k+1], n_emit,
+      n_acc, new_tok, new_done, catch_up, new_idx)`` — ONE target pass
+      over ``[tok, d_1..d_k]`` (s = k+1; per-row visibility masks keep
+      every position's attention window exact), greedy prefix-match or
+      rejection-sampling acceptance, correction/bonus token, in-round
+      eos freezing, and the per-row cache_index rollback to the
+      committed length. Writes at rejected positions are left in place:
+      they are invisible (behind the rolled-back index) and overwritten
+      by the next round's writes at those positions.
+    - ``commit(d_params, d_cache, last_draft, catch_up, new_idx)`` ->
+      ``d_cache`` — re-syncs the draft cache: rows that accepted all k
+      drafts are missing d_k's OWN KV entry (the draft scan wrote only
+      its inputs), so one extra draft apply writes it; other rows divert
+      that write out of bounds. Both then commit to ``new_idx``.
+
+    Greedy bit-identity: accepted tokens are exactly the target's argmax
+    at their position, and the correction token is the target's argmax
+    after the accepted prefix — by induction the emitted stream equals
+    solo target greedy decode, whatever the draft proposes (the draft
+    only controls HOW MANY tokens each round yields, 1..k+1)."""
+    target = _decode_module(config)
+    draft = _decode_module(draft_config)
+
+    def _keyed(seed, pos, tag):
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(seed), pos), tag)
+
+    @jax.jit
+    def draft_k(d_params, d_cache, tok, temps, top_ks, top_ps, seeds):
+        def dstep(carry, _):
+            cache, tk = carry
+            logits, vars_ = draft.apply(
+                {**d_params, "cache": cache}, tk[:, None], mutable=["cache"])
+            cache = _as_dict(vars_["cache"])
+            pos = _cache_positions(cache)  # post-apply: position of nxt
+            lg = logits[:, -1]
+            greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            if with_sampling:
+                t = jnp.where(temps > 0, temps, 1.0)[:, None]
+                tl = _truncate_logit_rows(lg / t, top_ks, top_ps)
+
+                def one(seed, p_, row):
+                    return jax.random.categorical(
+                        _keyed(seed, p_, _SPEC_DRAFT_TAG), row)
+
+                sampled = jax.vmap(one)(seeds, pos, tl).astype(jnp.int32)
+                nxt = jnp.where(temps > 0, sampled, greedy)
+                q = jax.nn.softmax(tl.astype(jnp.float32), axis=-1)
+            else:
+                nxt = greedy
+                q = jnp.zeros((lg.shape[0], 1), jnp.float32)
+            return (cache, nxt), (nxt, q)
+
+        (d_cache, _), (drafts, qs) = jax.lax.scan(
+            dstep, (d_cache, tok), None, length=k)
+        return d_cache, drafts.T, jnp.transpose(qs, (1, 0, 2))
+
+    @jax.jit
+    def verify(params, cache, tok, drafts, qprobs, temps, top_ks, top_ps,
+               seeds, done, eos):
+        b = tok.shape[0]
+        p = _cache_positions(cache)  # committed per-row positions
+        seq = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, k+1]
+        logits, vars_ = target.apply(
+            {**params, "cache": cache}, seq, mutable=["cache"])
+        cache = _as_dict(vars_["cache"])
+        tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+        if with_sampling:
+            v = logits.shape[-1]
+            t = jnp.where(temps > 0, temps, 1.0)
+            flat = (logits / t[:, None, None]).reshape(b * (k + 1), v)
+            tl = _truncate_logit_rows(
+                flat, jnp.repeat(top_ks, k + 1), jnp.repeat(top_ps, k + 1))
+            pprobs = jax.nn.softmax(
+                tl.astype(jnp.float32), axis=-1).reshape(b, k + 1, v)
+            # draft token j (0-based) sits at absolute position p + 1 + j;
+            # accept with prob min(1, p(d)/q(d)) under that position's key
+            dpos = p[:, None] + 1 + jnp.arange(k)[None, :]
+
+            def urow(seed, posr):
+                def u1(pp_):
+                    return jax.random.uniform(
+                        _keyed(seed, pp_, _SPEC_ACCEPT_TAG), ())
+                return jax.vmap(u1)(posr)
+
+            us = jax.vmap(urow)(seeds, dpos)  # [B, k]
+            pd = jnp.take_along_axis(
+                pprobs[:, :k], drafts[..., None], axis=-1)[..., 0]
+            qd = jnp.take_along_axis(
+                qprobs, drafts[..., None], axis=-1)[..., 0]
+            acc_sampled = us < jnp.minimum(pd / jnp.maximum(qd, 1e-20), 1.0)
+            acc = jnp.where(
+                (temps > 0)[:, None], acc_sampled, drafts == tgt[:, :k])
+        else:
+            acc = drafts == tgt[:, :k]
+        n_acc = jnp.sum(
+            jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)  # [B] 0..k
+        corr_greedy = jnp.take_along_axis(tgt, n_acc[:, None], axis=1)[:, 0]
+        if with_sampling:
+            # correction at the first rejection: sample the residual
+            # norm(max(p - q, 0)); full acceptance (n_acc == k) pads q
+            # with zeros so the "residual" is exactly the target's bonus
+            # distribution p_k — one code path serves both cases
+            qpad = jnp.concatenate(
+                [qprobs, jnp.zeros((b, 1, qprobs.shape[-1]),
+                                   qprobs.dtype)], axis=1)
+            sel_p = jnp.take_along_axis(
+                pprobs, n_acc[:, None, None], axis=1)[:, 0]
+            sel_q = jnp.take_along_axis(
+                qpad, n_acc[:, None, None], axis=1)[:, 0]
+            resid = jnp.maximum(sel_p - sel_q, 0.0)
+            rs = jnp.sum(resid, axis=-1, keepdims=True)
+            # rs == 0 can only arise numerically (p <= q pointwise means
+            # every token accepts); fall back to p itself
+            dist = jnp.where(rs > 1e-20, resid / jnp.maximum(rs, 1e-20),
+                             sel_p)
+
+            def c1(seed, pos_, row):
+                return jax.random.categorical(
+                    _keyed(seed, pos_, _SPEC_RESID_TAG),
+                    jnp.log(jnp.maximum(row, 1e-30)))
+
+            corr_sampled = jax.vmap(c1)(
+                seeds, p + 1 + n_acc, dist).astype(jnp.int32)
+            corr = jnp.where(temps > 0, corr_sampled, corr_greedy)
+        else:
+            corr = corr_greedy
+        # emitted tokens this round: d_1..d_{n_acc}, then the correction
+        cols = jnp.arange(k + 1)[None, :]
+        drafts_pad = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+        emit = jnp.where(
+            cols < n_acc[:, None], drafts_pad,
+            jnp.where(cols == n_acc[:, None], corr[:, None], jnp.int32(0)))
+        # in-round eos freeze: cut at the first emitted eos, exactly where
+        # the solo scan would freeze (the host pads the remaining budget)
+        hit = (eos >= 0)[:, None] & (emit == eos[:, None]) \
+            & (cols <= n_acc[:, None])
+        hit_any = jnp.any(hit, axis=1)
+        first_eos = jnp.argmax(hit, axis=1)
+        n_emit = jnp.where(
+            hit_any, jnp.minimum(n_acc + 1, first_eos + 1), n_acc + 1)
+        new_done = done | hit_any
+        new_tok = jnp.where(new_done, jnp.maximum(eos, 0), corr)
+        # rows done at entry stay frozen (their slot is retired — writes
+        # drop through the sentinel table; host reads nothing from them)
+        emit = jnp.where(done[:, None], jnp.maximum(eos, 0)[:, None], emit)
+        n_emit = jnp.where(done, k + 1, n_emit)
+        n_acc = jnp.where(done, 0, n_acc)
+        new_idx = p + n_acc + 1  # rollback: rejected positions invisible
+        catch_up = (n_acc == k) & (~done)
+        return (_set_cache_positions(cache, new_idx), emit, n_emit, n_acc,
+                new_tok, new_done, catch_up, new_idx)
+
+    @jax.jit
+    def commit(d_params, d_cache, last_draft, catch_up, new_idx):
+        cur = _cache_positions(d_cache)  # p + k after the draft scan
+        divert = jnp.where(
+            catch_up, cur,
+            jnp.int32(_oob_write_position(d_cache, draft_config.max_seq)))
+        d_cache = _set_cache_positions(d_cache, divert)
+        _, vars_ = draft.apply(
+            {**d_params, "cache": d_cache}, last_draft[:, None],
+            mutable=["cache"])
+        return _set_cache_positions(_as_dict(vars_["cache"]), new_idx)
+
+    return draft_k, verify, commit
+
+
 def generate(
     config: TransformerConfig,
     params,

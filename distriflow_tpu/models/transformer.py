@@ -590,7 +590,13 @@ class Attention(nn.Module):
             # per-row windows: row i sees [0, idx[i] + q) — other slots'
             # depths never leak into the mask, and masked scores at -1e30
             # underflow to exactly 0.0 in softmax, so a row's output is
-            # bit-identical whatever garbage its batchmates left behind
+            # bit-identical whatever garbage its batchmates left behind.
+            # This s > 1 branch is ALSO the speculative verify pass
+            # (models/generate.py::_build_spec_fns): the target scores a
+            # [tok, d_1..d_k] window in one dispatch, and because each
+            # position's window here is exactly the window s sequential
+            # s=1 steps would have seen, greedy acceptance over these
+            # logits reproduces the solo token stream bit-for-bit
             q_pos = idx[:, None] + jnp.arange(s)[None, :]  # [B, s]
             if cfg.causal:
                 visible = k_pos[None] <= q_pos[..., None]  # [B, s, K]

@@ -10,6 +10,11 @@
 - :func:`cifar_convnet` — CIFAR-10 ConvNet for BASELINE config #2.
 - MobileNetV2 lives in ``distriflow_tpu/models/mobilenet.py``; the
   transformer (long-context flagship) in ``distriflow_tpu/models/transformer.py``.
+- :func:`flagship_lm_config` / :func:`draft_lm_config` — the small/flagship
+  LM pairing the serving engine uses as draft/target for speculative
+  decoding (``ServingConfig.speculate_k``; docs/PERFORMANCE.md §7g).
+  :func:`draft_config_for` resolves ``ServingConfig.draft_model`` names and
+  forces the fields a draft MUST share with its target.
 
 All models compute in a configurable dtype (default float32; pass
 ``jnp.bfloat16`` to target the MXU's native precision).
@@ -17,6 +22,7 @@ All models compute in a configurable dtype (default float32; pass
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Sequence
 
 import flax.linen as nn
@@ -24,6 +30,7 @@ import jax.numpy as jnp
 
 from distriflow_tpu.models.base import ModelSpec
 from distriflow_tpu.models.flax_model import spec_from_flax
+from distriflow_tpu.models.transformer import TransformerConfig
 
 
 class MLP(nn.Module):
@@ -91,4 +98,60 @@ def cifar_convnet(dtype: Any = jnp.float32) -> ModelSpec:
         input_shape=(32, 32, 3),
         output_shape=(10,),
         name="cifar_convnet",
+    )
+
+
+# -- LM pairing for speculative decoding (docs/PERFORMANCE.md §7g) ----------
+
+
+def flagship_lm_config(max_seq: int = 2048,
+                       dtype: Any = jnp.bfloat16) -> TransformerConfig:
+    """The bench-flagship LM dims (bench.py's ``transformer_lm_flagship``
+    row) as a serving target config."""
+    return TransformerConfig(
+        vocab_size=32000, d_model=512, n_heads=8, n_layers=8, d_ff=2048,
+        max_seq=max_seq, dtype=dtype)
+
+
+def draft_lm_config(max_seq: int = 2048,
+                    dtype: Any = jnp.bfloat16) -> TransformerConfig:
+    """The zoo's small LM: ~1/20th the flagship's FLOPs per token (2
+    layers at a quarter width), sized so k draft steps cost well under
+    one target step — the regime where speculation can win."""
+    return TransformerConfig(
+        vocab_size=32000, d_model=128, n_heads=4, n_layers=2, d_ff=512,
+        max_seq=max_seq, dtype=dtype)
+
+
+#: ``ServingConfig.draft_model`` names -> config factories. ``"self"`` is
+#: resolved by :func:`draft_config_for` (the target config itself:
+#: self-speculation, acceptance ~= k by construction — the mechanical
+#: ceiling the serving_speculative bench row measures).
+_DRAFT_LMS = {"lm_draft": draft_lm_config}
+
+
+def draft_config_for(name: str,
+                     target: TransformerConfig) -> TransformerConfig:
+    """Resolve a ``ServingConfig.draft_model`` name against a target
+    config. The draft keeps its own depth/width but is forced onto the
+    fields a draft/target pair MUST share for verification to be
+    meaningful and for the page-table geometry to line up: vocab (token
+    ids must mean the same thing), ``max_seq`` (page-table width), dtype
+    and attention-kernel toggles (so both halves compile for the same
+    backend)."""
+    if name == "self":
+        return target
+    factory = _DRAFT_LMS.get(name)
+    if factory is None:
+        raise ValueError(
+            f"unknown draft_model {name!r}; known: "
+            f"{sorted(_DRAFT_LMS) + ['self']}")
+    draft = factory(max_seq=target.max_seq, dtype=target.dtype)
+    return dataclasses.replace(
+        draft,
+        vocab_size=target.vocab_size,
+        max_seq=target.max_seq,
+        dtype=target.dtype,
+        use_flash_attention=target.use_flash_attention,
+        use_flash_decode=target.use_flash_decode,
     )

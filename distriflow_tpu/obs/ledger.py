@@ -58,6 +58,30 @@ BANDS: Dict[str, Dict[str, Dict[str, float]]] = {
         "prefix_hit_rate": {"warn_pct": 20.0, "regress_pct": 50.0},
         "page_occupancy": {"warn_pct": 20.0, "regress_pct": 50.0},
     },
+    "serving_speculative": {
+        # round-12 draft/verify row (docs/PERFORMANCE.md §7g): "value" is
+        # the spec-vs-plain decode speedup at the distilled short context
+        # and guards the serving-plane mechanics; the per-context ms/token
+        # pairs get CI-host slack like the other serving latencies.
+        # accepted_per_step / accept_rate are pinned by the in-leg
+        # distillation (near-ceiling at the short context by construction)
+        # — movement there means the draft plumbing changed, not the host.
+        # distill_secs is setup cost, advisory-only.
+        "value": {"warn_pct": 10.0, "regress_pct": 25.0},
+        "spec_ms_tok": {"warn_pct": 15.0, "regress_pct": 40.0},
+        "plain_ms_tok": {"warn_pct": 15.0, "regress_pct": 40.0},
+        "accepted_per_step": {"warn_pct": 15.0, "regress_pct": 40.0},
+        "accept_rate_1k": {"warn_pct": 10.0, "regress_pct": 25.0},
+        "accept_rate_16k": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "distill_secs": {"warn_pct": 1e9, "regress_pct": 1e9},
+    },
+    "transformer_moe_flagship": {
+        # round-12 phase attribution (router/dispatch/expert/combine via
+        # the exact-FLOP tally): shares of a jittery step_ms, so they get
+        # the same CI-host slack as the serving latencies. "other" is the
+        # unattributed remainder — diagnostic only.
+        "top2_": {"warn_pct": 15.0, "regress_pct": 40.0},
+    },
     "long_context": {
         # prefill seconds / ms-per-token on 16k-32k prompts: chunked
         # prefill makes these steady, but CI hosts jitter ~15%

@@ -54,6 +54,12 @@ _PRIORITY = {
     "quarantine": 9,
     "apply": 8,
     "decode": 7,
+    # speculative serving phases (docs/PERFORMANCE.md §7g): verify is the
+    # target-model pass and owns overlapped instants; draft and commit are
+    # the small-model halves on either side of it
+    "spec_verify": 7,
+    "spec_draft": 6,
+    "spec_commit": 6,
     "fit": 6,
     "ef_compress": 6,
     "serialize": 5,

@@ -48,6 +48,17 @@ the engine (``B`` rows > free capacity ever possible, i.e. ``B >
 max_slots``, or multi-row sampled prompts whose historical contract ties
 all rows to ONE key stream) fall back to the serialized solo path.
 
+**Speculative decoding** (round 12, ``ServingConfig.speculate_k``; design
+in docs/PERFORMANCE.md §7g): under the paged layout a small draft model
+proposes ``k`` tokens per round and the target verifies all ``k + 1``
+positions in one multi-token pass, so a round emits 1..k+1 tokens for one
+target dispatch. Greedy rows stay bit-identical to solo decode; sampled
+rows use the rejection-sampling correction under the same per-row
+``fold_in(seed, position)`` determinism. The draft's KV rides its own
+page tables over the SAME ``_PagePool``, so admission reserves — and
+retirement/disconnect reclaims — both models' pages through one
+allocator, exactly once.
+
 **Mesh-aware serving** (round 3): ``params`` may be Megatron/TP-sharded
 device arrays — the decode programs GSPMD-partition from the param
 shardings (heads-sharded KV cache, psum'd o_proj; see
@@ -73,6 +84,7 @@ from distriflow_tpu.models.generate import (
     _build_paged_fns,
     _build_prefill,
     _build_slot_fns,
+    _build_spec_fns,
     _check_fits,
     beam_search,
     generate,
@@ -82,7 +94,8 @@ from distriflow_tpu.models.generate import (
     set_page_tables,
     slot_cache,
 )
-from distriflow_tpu.models.transformer import TransformerConfig
+from distriflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from distriflow_tpu.models.zoo import draft_config_for
 from distriflow_tpu.obs import FleetTable, get_telemetry
 from distriflow_tpu.utils.config import ServingConfig
 from distriflow_tpu.utils.logging import VerboseLogger
@@ -216,6 +229,7 @@ class InferenceServer:
         verbose: Optional[bool] = None,
         serving: Optional[ServingConfig] = None,
         telemetry: Any = None,
+        draft_params: Any = None,
     ):
         self.config = config
         self.params = params
@@ -279,6 +293,36 @@ class InferenceServer:
         # insertion order doubles as LRU (move_to_end on hit), and pool
         # pressure evicts from the cold end.
         self._prefix_map: "OrderedDict[bytes, int]" = OrderedDict()
+        # speculative decoding (round 12; docs/PERFORMANCE.md §7g): the
+        # draft model keeps its OWN paged cache but draws page ids from
+        # the SAME _PagePool — one allocator, so draft KV competes with
+        # target KV for the pool honestly and every occupancy metric
+        # already accounts for it. ``draft_model="self"`` shares the
+        # target's params (self-speculation: the mechanical ceiling the
+        # bench measures); otherwise a zoo draft config, with ``params``
+        # passed in or deterministically initialised at seed 0.
+        self._spec_k = self.serving.speculate_k
+        self._self_draft = False
+        self.draft_config: Optional[TransformerConfig] = None
+        self.draft_params: Any = None
+        self._draft_cache: Any = None
+        self._draft_tables = np.zeros((0, 0), np.int32)
+        self._draft_tables_dirty = False
+        self._draft_pages: List[List[int]] = [[] for _ in range(s)]
+        if self._spec_k:
+            name = self.serving.draft_model or "lm_draft"
+            self.draft_config = draft_config_for(name, config)
+            self._self_draft = name == "self"
+            if self._self_draft:
+                self.draft_params = None  # always read self.params live
+            elif draft_params is not None:
+                self.draft_params = draft_params
+            else:
+                variables = TransformerLM(self.draft_config, mesh=None).init(
+                    jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+                self.draft_params = {"params": variables["params"]}
+            self._draft_tables = np.full(
+                (s, self._pp + 1), self._n_pages, np.int32)
         # serving metrics (contract table in docs/OBSERVABILITY.md §1)
         tel = telemetry if telemetry is not None else get_telemetry()
         self._m_batches = tel.counter("serving_decode_batches_total")
@@ -293,6 +337,9 @@ class InferenceServer:
             "serving_prefix_tokens_saved_total")
         self._m_pages_alloc = tel.counter("serving_pages_allocated_total")
         self._m_pages_freed = tel.counter("serving_pages_released_total")
+        self._m_spec_proposed = tel.counter("serving_spec_proposed_total")
+        self._m_spec_accepted = tel.counter("serving_spec_accepted_total")
+        self._m_spec_rate = tel.gauge("serving_spec_accepted_per_step")
         # continuous phase profiler (docs/OBSERVABILITY.md §5): serving
         # records phases only — the engine loop mostly idles in _gather, so
         # a per-iteration step() would drown the digests in idle wall time
@@ -341,9 +388,14 @@ class InferenceServer:
         """Swap serving weights (e.g. after a training round). Requests
         mid-decode continue on the NEW params from their next chunk — the
         engine re-reads ``self.params`` every dispatch; the KV cache is
-        config-shaped only, so it survives the swap."""
+        config-shaped only, so it survives the swap. Under
+        ``draft_model="self"`` the draft follows automatically —
+        :meth:`_live_draft_params` reads ``self.params`` at dispatch."""
         with self._device_lock:
             self.params = params
+
+    def _live_draft_params(self) -> Any:
+        return self.params if self._self_draft else self.draft_params
 
     # -- config accessors (None -> module constant, read at use time so
     #    tests that monkeypatch the constants keep working) ----------------
@@ -518,10 +570,17 @@ class InferenceServer:
         """Logical pages one row holds over its FULL horizon, reserved up
         front so a live row can never hit mid-decode pool exhaustion:
         prompt plus generated tokens, rounded up to the chunk boundary
-        (a row frozen at eos keeps appending until retirement)."""
+        (a row frozen at eos keeps appending until retirement). Under
+        speculation a verify pass writes the whole ``[tok, d_1..d_k]``
+        window, so the final round overshoots the committed horizon by up
+        to ``speculate_k + 1`` positions — reserve them; positions past
+        ``pages_per_slot * page_size`` drop through the table sentinel and
+        never need backing pages (the ``min`` cap)."""
         chunk = self.serving.decode_chunk
         written = plen
-        if n_tokens > 1:
+        if self._spec_k:
+            written += (n_tokens - 1) + self._spec_k + 1
+        elif n_tokens > 1:
             written += -(-(n_tokens - 1) // chunk) * chunk
         ps = self.serving.page_size
         return min(-(-written // ps), self._pp)
@@ -567,15 +626,19 @@ class InferenceServer:
         blocking on this head rather than skipping it."""
         plen = req.prompt.shape[1]
         need = self._pages_needed(plen, req.n_tokens)
+        # the draft's KV is never prefix-shared (its pages hold DRAFT
+        # activations — a different model — so target prefix hashes say
+        # nothing about them): every draft page is owned, full horizon
+        dneed = need if self._spec_k else 0
         plans: List[Dict[str, Any]] = []
         for row in range(req.prompt.shape[0]):
             shared, hashes = self._row_plan(req.prompt[row])
             plans.append({"shared": shared, "hashes": hashes,
-                          "owned": None, "committed": False})
+                          "owned": None, "draft": [], "committed": False})
         # ref shared pages FIRST so eviction below can never free them
         for plan in plans:
             self._pool.ref(plan["shared"])
-        total_owned = sum(need - len(p["shared"]) for p in plans)
+        total_owned = sum(need + dneed - len(p["shared"]) for p in plans)
         if total_owned > self._pool.free_pages:
             self._evict_prefix(total_owned - self._pool.free_pages)
         if total_owned > self._pool.free_pages:
@@ -584,11 +647,13 @@ class InferenceServer:
             return False
         for plan in plans:
             plan["owned"] = self._pool.alloc(need - len(plan["shared"]))
+            plan["draft"] = self._pool.alloc(dneed)
             if plan["shared"]:
                 self._m_prefix_hits.inc()
                 self._m_prefix_tokens.inc(
                     len(plan["shared"]) * self.serving.page_size)
-            self._m_pages_alloc.inc(len(plan["shared"]) + len(plan["owned"]))
+            self._m_pages_alloc.inc(
+                len(plan["shared"]) + len(plan["owned"]) + len(plan["draft"]))
         req.page_plan = plans
         return True
 
@@ -598,7 +663,7 @@ class InferenceServer:
         by their slot and released by :meth:`_retire_slot`."""
         if plan is None or plan["committed"]:
             return
-        pages = plan["shared"] + plan["owned"]
+        pages = plan["shared"] + plan["owned"] + plan.get("draft", [])
         self._pool.unref(pages)
         self._m_pages_freed.inc(len(pages))
         plan["committed"] = True  # never release twice
@@ -622,7 +687,7 @@ class InferenceServer:
         """Refresh one connection's fleet row with the KV pages its live
         slots currently hold (0 once everything retired)."""
         held = sum(
-            len(self._slot_pages[s])
+            len(self._slot_pages[s]) + len(self._draft_pages[s])
             for s, r in enumerate(self._slot_req)
             if r is not None and r.client_id == client_id)
         self.fleet.note_pages(client_id, held)
@@ -667,6 +732,15 @@ class InferenceServer:
                             self.config, self.params,
                             self.serving.max_slots,
                             self.serving.page_size, self._n_pages)
+                        if self._spec_k:
+                            # draft pool: own KV arrays (different model
+                            # dims) but the SAME page-id space as the
+                            # target's, so one host allocator covers both
+                            self._draft_cache = paged_cache(
+                                self.draft_config,
+                                self._live_draft_params(),
+                                self.serving.max_slots,
+                                self.serving.page_size, self._n_pages)
                     else:
                         self._slot_cache = slot_cache(
                             self.config, self.params, self.serving.max_slots)
@@ -703,7 +777,11 @@ class InferenceServer:
                         for s, r in enumerate(self._slot_req):
                             if r is None:
                                 self._tables[s, :] = self._n_pages
+                                if self._spec_k:
+                                    self._draft_tables[s, :] = self._n_pages
                         self._tables_dirty = True
+                        if self._spec_k:
+                            self._draft_tables_dirty = True
                     for req in {id(r): r for r, _ in members}.values():
                         self._finish_error(req, e)
             self.batched_requests += len(admit)
@@ -768,6 +846,10 @@ class InferenceServer:
                 s = int(slots[j])
                 self._tables[s, :] = self._n_pages
                 self._tables[s, :len(pages)] = pages
+                if self._spec_k:
+                    dpages = plan["draft"]
+                    self._draft_tables[s, :] = self._n_pages
+                    self._draft_tables[s, :len(dpages)] = dpages
         with self._prof.phase("prefill"), self._device_lock, self.logger.time(
             f"admit[{n}->{bucket}x{plen}]"
         ):
@@ -801,6 +883,26 @@ class InferenceServer:
             first = np.asarray(pick_rows(
                 logits, temps, top_ks, top_ps, seeds,
                 np.full((bucket,), plen, np.int32)))[:n]
+        if self._spec_k:
+            # the draft prefills the FULL prompt: even when the target rode
+            # shared prefix pages, the draft cache holds no KV for them
+            # (different model), so there is nothing for it to reuse
+            d_prefill, d_extend = _build_prefill(self.draft_config)
+            d_insert, _ = _build_paged_fns(self.draft_config, srv.page_size)
+            dparams = self._live_draft_params()
+            with self._prof.phase("spec_draft"), self._device_lock:
+                pc = srv.prefill_chunk
+                if pc is None or pc >= plen:
+                    _, d_row = d_prefill(dparams, stacked)
+                else:
+                    _, d_row = d_prefill(dparams, stacked[:, :pc])
+                    for i in range(pc, plen, pc):
+                        _, d_row = d_extend(
+                            dparams, d_row, stacked[:, i:i + pc])
+                self._draft_cache = d_insert(
+                    self._draft_cache, d_row, slots, np.int32(plen),
+                    np.int32(0), self._draft_tables.copy())
+                self._draft_tables_dirty = False
         for j, (req, row) in enumerate(members):
             s = int(slots[j])
             self._slot_req[s] = req
@@ -810,6 +912,7 @@ class InferenceServer:
                 plan = req.page_plan[row]
                 plan["committed"] = True
                 self._slot_pages[s] = plan["shared"] + plan["owned"]
+                self._draft_pages[s] = plan["draft"]
                 self._register_prefix(plan)
                 self._note_client_pages(req.client_id)
             self._tok[s] = first[j]
@@ -845,6 +948,9 @@ class InferenceServer:
         active = [i for i, r in enumerate(self._slot_req) if r is not None]
         if not active:
             self._m_slots.set(0)
+            return
+        if self._spec_k:
+            self._spec_round(active)
             return
         with self._prof.phase("decode_iter"):
             sampling = bool((self._temps[active] > 0).any())
@@ -905,6 +1011,86 @@ class InferenceServer:
             self._m_slots.set(
                 sum(1 for r in self._slot_req if r is not None))
 
+    def _spec_round(self, active: List[int]) -> None:
+        """One speculative round over every live slot: draft k tokens,
+        verify all k+1 positions in ONE target pass, commit the accepted
+        prefix (docs/PERFORMANCE.md §7g; device programs in
+        ``models/generate.py::_build_spec_fns``). Each round yields 1 to
+        ``k + 1`` tokens per row — the host clips to the row's remaining
+        budget and retires rows exactly like the plain chunk path. The
+        three dispatches stay separate (each synced before its phase
+        closes) so ``spec_draft``/``spec_verify``/``spec_commit`` attribute
+        wall time honestly in the profiler digest and trace assembler."""
+        srv = self.serving
+        k = self._spec_k
+        sampling = bool((self._temps[active] > 0).any())
+        draft_k, verify, commit = _build_spec_fns(
+            self.config, self.draft_config, k, sampling)
+        t0 = time_mod.monotonic()
+        with self._device_lock:
+            if self._tables_dirty and self._slot_cache is not None:
+                self._slot_cache = set_page_tables(
+                    self._slot_cache, self._tables.copy())
+                self._tables_dirty = False
+            if self._draft_tables_dirty and self._draft_cache is not None:
+                self._draft_cache = set_page_tables(
+                    self._draft_cache, self._draft_tables.copy())
+                self._draft_tables_dirty = False
+            dparams = self._live_draft_params()
+            with self._prof.phase("spec_draft"):
+                self._draft_cache, drafts, qprobs = draft_k(
+                    dparams, self._draft_cache, self._tok, self._temps,
+                    self._top_ks, self._top_ps, self._seeds)
+                drafts.block_until_ready()
+            with self._prof.phase("spec_verify"):
+                (self._slot_cache, emit, n_emit, n_acc, new_tok, new_done,
+                 catch, new_idx) = verify(
+                    self.params, self._slot_cache, self._tok, drafts,
+                    qprobs, self._temps, self._top_ks, self._top_ps,
+                    self._seeds, self._done, self._eos)
+                emit = np.array(emit)
+                n_emit = np.array(n_emit)
+                n_acc = np.array(n_acc)
+                new_tok = np.array(new_tok)
+                new_done = np.array(new_done)
+            with self._prof.phase("spec_commit"):
+                self._draft_cache = commit(
+                    dparams, self._draft_cache, drafts[:, -1], catch,
+                    new_idx)
+                jax.block_until_ready(self._draft_cache)
+        elapsed_ms = (time_mod.monotonic() - t0) * 1000.0
+        self.decode_batches += 1
+        self._m_batches.inc()
+        self._tok = new_tok
+        self._done = new_done
+        emitted_now = 0
+        accepted_now = 0
+        for s in active:
+            req = self._slot_req[s]
+            row = int(self._slot_row[s])
+            have = int(self._slot_emitted[s])
+            take = min(int(n_emit[s]), req.n_tokens - have)
+            emitted_now += take
+            accepted_now += int(n_acc[s])
+            self._slot_emitted[s] = have + take
+            req.rows_out[row] = np.concatenate(
+                [req.rows_out[row], emit[s, :take].astype(np.int32)])
+            if new_done[s]:
+                pad = req.n_tokens - have - take
+                if pad:
+                    req.rows_out[row] = np.concatenate([
+                        req.rows_out[row],
+                        np.full((pad,), req.eos, np.int32)])
+                self._complete_row(s)
+            elif have + take >= req.n_tokens:
+                self._complete_row(s)
+        self._m_tokens.inc(emitted_now)
+        self._m_spec_proposed.inc(k * len(active))
+        self._m_spec_accepted.inc(accepted_now)
+        self._m_spec_rate.set(accepted_now / len(active))
+        self._m_tpot.observe(elapsed_ms * len(active) / max(emitted_now, 1))
+        self._m_slots.set(sum(1 for r in self._slot_req if r is not None))
+
     def _complete_row(self, s: int) -> None:
         """Finish one slot's row (its tokens already sit in ``rows_out``):
         retire the slot and resolve the request once every row is in."""
@@ -932,12 +1118,18 @@ class InferenceServer:
             self._done[s] = True
             self._temps[s] = 0.0
             self._eos[s] = -1
-            if self._paged and self._slot_pages[s]:
+            if self._paged and (self._slot_pages[s] or self._draft_pages[s]):
                 pages = self._slot_pages[s]
                 self._slot_pages[s] = []
                 self._pool.unref(pages)
-                self._m_pages_freed.inc(len(pages))
                 self._tables[s, :] = self._n_pages
+                dpages = self._draft_pages[s]
+                self._draft_pages[s] = []
+                if dpages:
+                    self._pool.unref(dpages)
+                    self._draft_tables[s, :] = self._n_pages
+                    self._draft_tables_dirty = True
+                self._m_pages_freed.inc(len(pages) + len(dpages))
                 self._tables_dirty = True
                 self._note_occupancy()
                 if req is not None:

@@ -355,6 +355,18 @@ class ServingConfig:
     construction. ``prefix_sharing`` lets requests whose prompts share
     full leading pages pin the same read-only pages (refcounted,
     copy-on-write on divergence).
+
+    ``speculate_k`` enables draft/verify speculative decoding on the
+    engine (docs/PERFORMANCE.md §7g): a small draft model proposes ``k``
+    tokens per round and the target model scores all ``k+1`` positions in
+    one batched pass, accepting the agreeing prefix (greedy) or the
+    rejection-sampling-corrected prefix (sampled). ``0`` (default) keeps
+    plain chunked decode. Requires the paged layout — the draft model's
+    KV rides spare pages of the same pool, so admission reserves (and
+    retirement reclaims) both models' pages. ``draft_model`` names the
+    zoo draft config (``models/zoo.py::draft_config_for``); ``"self"``
+    means self-speculation (draft == target — the mechanical ceiling
+    benches measure).
     """
 
     max_slots: int = 8
@@ -366,6 +378,8 @@ class ServingConfig:
     page_size: int = 128
     page_pool_pages: Optional[int] = None
     prefix_sharing: bool = True
+    speculate_k: int = 0
+    draft_model: Optional[str] = None
 
     def pool_pages(self, max_seq: int) -> int:
         """Resolved pool size in pages: explicit override or the
@@ -397,6 +411,20 @@ class ServingConfig:
         if self.page_pool_pages is not None and self.page_pool_pages <= 0:
             raise ValueError(
                 f"page_pool_pages must be positive when set, got {self.page_pool_pages}")
+        if self.speculate_k < 0:
+            raise ValueError(
+                f"speculate_k must be >= 0, got {self.speculate_k}")
+        if self.speculate_k > 0 and self.kv_layout != "paged":
+            # the draft model's KV rides spare pages of the target's pool;
+            # there is no slab home for it — fail at construction, not at
+            # the first admission
+            raise ValueError(
+                "speculate_k > 0 requires kv_layout='paged' (the draft "
+                f"KV rides the page pool), got kv_layout={self.kv_layout!r}")
+        if self.draft_model is not None and self.speculate_k == 0:
+            raise ValueError(
+                "draft_model is set but speculate_k is 0 — enable "
+                "speculation or drop the draft")
         return self
 
 

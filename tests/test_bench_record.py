@@ -17,7 +17,12 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import RECORD_LIMIT, _fit_line  # noqa: E402
+from bench import (  # noqa: E402
+    RECORD_LIMIT,
+    _fit_line,
+    _floor_retry,
+    _moe_phase_fwd_flops,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -91,6 +96,137 @@ def test_fit_line_core_record_when_even_rows_cannot_save_it():
               "vs_baseline": None, "device": "d", "n_chips": 1, "matrix": []}
     line = _fit_line(result, limit=200)
     assert len(line) <= 200  # hard guarantee, even if the tail is sliced
+
+
+def test_floor_retry_reruns_under_floor_leg_and_keeps_better_row():
+    """Round-12 degradation retry: a headline leg landing under its
+    pinned MFU floor re-runs ONCE; the better row survives and carries
+    ``retried: true`` (a bool — the ledger's numeric filter must skip
+    it, so a retry never becomes a gated metric)."""
+    calls = []
+
+    def leg():
+        calls.append(1)
+        return {"config": "cifar10_convnet_sync", "value": 900.0,
+                "mfu": 0.33, "mfu_min": 0.32}
+
+    matrix = [{"config": "cifar10_convnet_sync", "value": 800.0,
+               "mfu": 0.29, "mfu_min": 0.28}]
+    _floor_retry(matrix, leg, ())
+    assert calls == [1]  # exactly one re-run
+    assert matrix[0]["mfu_min"] == 0.32  # better rerun replaced the row
+    assert matrix[0]["retried"] is True
+
+
+def test_floor_retry_keeps_original_when_rerun_is_worse_or_raises():
+    orig = {"config": "transformer_lm_flagship", "value": 1.0, "mfu": 0.40}
+    matrix = [dict(orig)]
+    _floor_retry(matrix, lambda: {"config": "transformer_lm_flagship",
+                                  "value": 0.9, "mfu": 0.38}, ())
+    assert matrix[0]["mfu"] == 0.40 and matrix[0]["retried"] is True
+
+    matrix = [dict(orig)]
+    _floor_retry(matrix, lambda: 1 / 0, ())  # a crashing retry is absorbed
+    assert matrix[0]["mfu"] == 0.40 and matrix[0]["retried"] is True
+
+
+def test_floor_retry_no_ops_at_or_above_floor_and_on_cpu_rows():
+    def boom():
+        raise AssertionError("must not re-run")
+
+    # at the floor: no retry, no 'retried' key
+    matrix = [{"config": "cifar10_convnet_sync", "mfu": 0.31,
+               "mfu_min": 0.30}]
+    _floor_retry(matrix, boom, ())
+    assert "retried" not in matrix[0]
+    # CPU rows report mfu=None and never retry
+    matrix = [{"config": "cifar10_convnet_sync", "mfu": None,
+               "mfu_min": None}]
+    _floor_retry(matrix, boom, ())
+    assert "retried" not in matrix[0]
+    # configs without a pinned floor never retry
+    matrix = [{"config": "moe_transformer_lm", "mfu": 0.05}]
+    _floor_retry(matrix, boom, ())
+    assert "retried" not in matrix[0]
+
+
+def test_floor_retry_skips_rerun_when_budget_exhausted(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "time_left", lambda: 10.0)
+    matrix = [{"config": "cifar10_convnet_sync", "mfu": 0.2, "mfu_min": 0.2}]
+    _floor_retry(matrix, lambda: pytest.fail("must not re-run"), ())
+    assert matrix[0]["retried"] is False  # flagged, not silently skipped
+
+
+def test_moe_phase_fwd_flops_matches_einsum_contractions():
+    """Round-12 MoE phase attribution: the analytic per-layer fwd FLOPs
+    must mirror MoEFFN's actual einsums — dispatch/combine contract over
+    the CHOICE-MAJOR t = k*g axis ([G, k*g, E, C] one-hots), expert is
+    two [E,C,d]x[d,f] matmuls, router is Dense(E) over every token."""
+    from distriflow_tpu.models.transformer import TransformerConfig
+    from distriflow_tpu.parallel.ring_attention import _auto_block
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq=64, n_experts=4, moe_top_k=2, use_flash_attention=False)
+    n_tok = 2 * 64
+    g = _auto_block(n_tok, cfg.moe_group_size)
+    G, k, E, C = n_tok // g, 2, 4, max(
+        1, int(cfg.capacity_factor * 2 * g / 4))
+    d, f = 16, 32
+    fwd = _moe_phase_fwd_flops(cfg, n_tok)
+    # 2 FLOPs per MAC, contraction sizes straight off the einsum specs
+    assert fwd["router"] == 2.0 * n_tok * d * E
+    assert fwd["dispatch"] == 2.0 * G * (k * g) * E * C * d  # xtec,xtd
+    assert fwd["combine"] == fwd["dispatch"]  # xtec,xecd — same contraction
+    assert fwd["expert"] == 4.0 * G * E * C * d * f  # two d<->f matmuls
+    assert fwd["expert"] > 0 and fwd["dispatch"] > 0
+    # at the bench's flagship dims (d512/ff2048, g=1024) the expert
+    # matmuls dominate dispatch by exactly 2f/(k*g) = 2x — the routing
+    # tax the attribution exists to expose is the other ~half
+    big = TransformerConfig(
+        vocab_size=32000, d_model=512, n_heads=8, n_layers=2, d_ff=2048,
+        max_seq=1024, n_experts=8, moe_top_k=2)
+    bf = _moe_phase_fwd_flops(big, 8 * 1024)
+    assert bf["expert"] == 2 * bf["dispatch"]
+
+
+def test_moe_phase_attribution_against_real_cost_analysis():
+    """The leg's integration path: a (tiny) top-2 MoE SyncTrainer's
+    cost_analysis() exposes 'flops' > 0, and the analytic per-layer fwd
+    tally x layers x 3 (fwd+bwd) stays under that total — the attributed
+    phase times can never exceed the measured step."""
+    import jax
+    import numpy as np
+
+    from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+    from distriflow_tpu.parallel import data_parallel_mesh
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    B, S = 8, 32  # conftest fakes an 8-device host mesh; B must divide
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq=S, n_experts=4, moe_top_k=2, use_flash_attention=False)
+    mesh = data_parallel_mesh(jax.devices())
+    trainer = SyncTrainer(transformer_lm(cfg, mesh=mesh, example_seq=S),
+                          mesh=mesh, learning_rate=1e-3)
+    trainer.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 64, (B, S)).astype(np.int32)
+    y = rng.randint(0, 64, (B, S)).astype(np.int32)
+    total = trainer.cost_analysis((x, y))["flops"]  # per-device
+    assert total > 0
+    n_dev = len(jax.devices())
+    fwd = _moe_phase_fwd_flops(cfg, B * S)  # global (all devices)
+    attributed = sum(fwd.values()) * cfg.n_layers * 3 / n_dev
+    assert 0 < attributed < total  # embed/attn/lm_head make up the rest
+    # the bench's apportionment: shares of a measured step must sum under
+    # it, leaving a nonnegative 'other' remainder
+    step_ms = 10.0
+    phase_ms = {p: step_ms * (v * cfg.n_layers * 3 / n_dev) / total
+                for p, v in fwd.items()}
+    assert 0 < sum(phase_ms.values()) < step_ms
 
 
 @pytest.mark.slow

@@ -1,0 +1,279 @@
+"""Speculative decoding on the paged KV pool (round 12; docs/PERFORMANCE.md
+§7g).
+
+Pins the draft/verify serving contracts:
+
+- GREEDY speculative decode is bit-identical to solo target decode — for
+  self-speculation (acceptance ~= k by construction) AND for a random
+  draft at k in {1, 4} (acceptance ~= 1/vocab, so the reject/correction
+  path carries almost every token). The draft only controls how MANY
+  tokens a round yields, never WHICH tokens.
+- Sampled speculative decode keeps the per-(request, seed) determinism
+  contract: same seed -> same stream, different seed -> (almost surely)
+  different.
+- eos emitted mid-round freezes exactly where solo freezes; the host pads
+  the remaining budget with eos — stream-identical to the solo path.
+- ``speculate_k`` config validation: negative k, slab layout, and a
+  dangling ``draft_model`` are all rejected.
+- Dual-pool page accounting under chaos: a mid-decode disconnect releases
+  the TARGET pages and the DRAFT pages exactly once — pool back to
+  all-free, zero refcounts, allocated == released.
+- spec counters/gauge move and reconcile: accepted <= proposed and the
+  per-step gauge sits in [0, k].
+
+Tiny f32 CPU models; deliberately NOT in conftest's slow set — tier-1
+exercises the speculative path every run.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distriflow_tpu.client import InferenceClient
+from distriflow_tpu.models.generate import generate
+from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+from distriflow_tpu.models.zoo import draft_config_for, draft_lm_config
+from distriflow_tpu.obs import get_telemetry
+from distriflow_tpu.server import InferenceServer
+from distriflow_tpu.utils.config import ServingConfig
+
+pytestmark = pytest.mark.spec
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=48,
+    dtype=jnp.float32, use_flash_attention=False,
+)
+PS = 16  # 3 pages per slot
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer_lm(CFG, example_seq=16).init(jax.random.PRNGKey(0))
+
+
+def _server(params, k, draft="lm_draft", **kw):
+    return InferenceServer(
+        CFG, params, port=0,
+        serving=ServingConfig(batch_window_s=0.1, decode_chunk=4,
+                              kv_layout="paged", page_size=PS,
+                              speculate_k=k, draft_model=draft, **kw),
+    ).setup()
+
+
+def _client(server):
+    return InferenceClient(server.address).setup()
+
+
+# -- config surface --------------------------------------------------------
+
+
+def test_speculate_k_validation():
+    with pytest.raises(ValueError):
+        ServingConfig(speculate_k=-1).validate()
+    with pytest.raises(ValueError):  # speculation needs the page pool
+        ServingConfig(speculate_k=2, kv_layout="slab").validate()
+    with pytest.raises(ValueError):  # dangling draft without speculation
+        ServingConfig(draft_model="lm_draft").validate()
+    srv = ServingConfig(speculate_k=3, kv_layout="paged",
+                        draft_model="self").validate()
+    assert srv.speculate_k == 3
+
+
+def test_draft_config_resolution():
+    assert draft_config_for("self", CFG) is CFG
+    d = draft_config_for("lm_draft", CFG)
+    # the fields a draft/target pair MUST share are forced from the target
+    assert d.vocab_size == CFG.vocab_size
+    assert d.max_seq == CFG.max_seq
+    assert d.dtype == CFG.dtype
+    assert d.use_flash_attention == CFG.use_flash_attention
+    # ... while the draft keeps its own (smaller) depth/width
+    full = draft_lm_config()
+    assert (d.n_layers, d.d_ff) == (full.n_layers, full.d_ff)
+    with pytest.raises(ValueError):
+        draft_config_for("no_such_draft", CFG)
+
+
+# -- greedy bit-identity ---------------------------------------------------
+
+
+@pytest.mark.parametrize("k,draft", [(1, "lm_draft"), (4, "lm_draft"),
+                                     (2, "self")])
+def test_spec_greedy_bit_identical_to_solo(params, k, draft):
+    """The acceptance bar: whatever the draft proposes — a near-perfect
+    self-draft or a random-weight draft rejected almost every round —
+    the emitted greedy stream equals solo target decode exactly."""
+    server = _server(params, k, draft)
+    try:
+        rs = np.random.RandomState(3)
+        for plen, n in [(5, 9), (20, 12), (33, 7)]:
+            prompt = rs.randint(0, 64, (1, plen)).astype(np.int32)
+            solo = np.asarray(
+                generate(CFG, dict(params), jnp.asarray(prompt), n))
+            with _client(server) as c:
+                got = c.generate(prompt, n_tokens=n)
+            np.testing.assert_array_equal(got, solo)
+    finally:
+        server.stop()
+
+
+def test_spec_multi_row_and_single_token(params):
+    """Row-independent greedy batches ride speculation too, and an
+    n_tokens=1 request (no decode round at all) still round-trips."""
+    server = _server(params, 2, "self")
+    try:
+        prompt = np.random.RandomState(11).randint(
+            0, 64, (3, 8)).astype(np.int32)
+        solo = np.asarray(generate(CFG, dict(params), jnp.asarray(prompt), 6))
+        with _client(server) as c:
+            np.testing.assert_array_equal(
+                c.generate(prompt, n_tokens=6), solo)
+            np.testing.assert_array_equal(
+                c.generate(prompt[:1], n_tokens=1),
+                np.asarray(generate(
+                    CFG, dict(params), jnp.asarray(prompt[:1]), 1)))
+    finally:
+        server.stop()
+
+
+def test_spec_eos_freezes_mid_round(params):
+    """An eos landing inside a verify window cuts the round exactly where
+    solo would freeze; the host pads the remaining budget with eos."""
+    server = _server(params, 3, "self")
+    try:
+        prompt = np.random.RandomState(9).randint(
+            0, 64, (1, 10)).astype(np.int32)
+        solo = np.asarray(generate(CFG, dict(params), jnp.asarray(prompt), 10))
+        eos_tok = int(solo[0, 12])  # third generated token
+        solo_eos = np.asarray(generate(
+            CFG, dict(params), jnp.asarray(prompt), 10, eos_id=eos_tok))
+        with _client(server) as c:
+            got = c.generate(prompt, n_tokens=10, eos_id=eos_tok)
+        np.testing.assert_array_equal(got, solo_eos)
+    finally:
+        server.stop()
+
+
+# -- sampled path ----------------------------------------------------------
+
+
+def test_spec_sampled_deterministic_per_seed(params):
+    """Rejection-sampled speculation keeps the per-request seed contract:
+    the stream is a pure function of (request, seed), batch-independent —
+    the accept coins and residual draws key off fold_in(seed, position)
+    with per-decision subkey tags."""
+    server = _server(params, 3, "lm_draft")
+    try:
+        prompt = np.random.RandomState(5).randint(
+            0, 64, (1, 10)).astype(np.int32)
+        with _client(server) as c:
+            a = c.generate(prompt, n_tokens=12, temperature=0.9,
+                           top_k=20, seed=42)
+            b = c.generate(prompt, n_tokens=12, temperature=0.9,
+                           top_k=20, seed=42)
+            d = c.generate(prompt, n_tokens=12, temperature=0.9,
+                           top_k=20, seed=43)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, d)  # 12 tokens over 20 survivors
+        assert (a[:, :10] == prompt).all() and a.shape == (1, 22)
+        assert (a[:, 10:] < CFG.vocab_size).all() and (a[:, 10:] >= 0).all()
+    finally:
+        server.stop()
+
+
+# -- accounting ------------------------------------------------------------
+
+
+def test_spec_counters_and_acceptance_ceiling(params):
+    """Counters reconcile (0 <= accepted <= proposed) and self-speculation
+    sits at the mechanical ceiling: with draft == target every draft
+    token matches the verify argmax, so accepted == proposed whenever the
+    budget doesn't clip the round."""
+    tel = get_telemetry()
+    p0 = tel.counter_value("serving_spec_proposed_total")
+    a0 = tel.counter_value("serving_spec_accepted_total")
+    server = _server(params, 2, "self")
+    try:
+        prompt = np.random.RandomState(6).randint(
+            0, 64, (1, 8)).astype(np.int32)
+        with _client(server) as c:
+            c.generate(prompt, n_tokens=13)  # 4 full rounds of 2+1
+        prop = tel.counter_value("serving_spec_proposed_total") - p0
+        acc = tel.counter_value("serving_spec_accepted_total") - a0
+        assert prop > 0 and 0 <= acc <= prop
+        # self-draft: every proposed token matches the target's argmax
+        assert acc == prop
+        assert 0.0 <= tel.gauge("serving_spec_accepted_per_step").value <= 2.0
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_spec_disconnect_reclaims_draft_and_target_pages(params):
+    """A client vanishing mid-verify must return BOTH models' pages
+    exactly once: after the engine settles and the prefix map flushes,
+    the (shared) pool is all-free with zero refcounts and the
+    allocated/released counters match — the same reconciliation identity
+    the plain paged layout pins, now covering the draft half."""
+    tel = get_telemetry()
+    server = _server(params, 3, "lm_draft", prefix_sharing=False)
+    try:
+        a0 = tel.counter_value("serving_pages_allocated_total")
+        r0 = tel.counter_value("serving_pages_released_total")
+        prompt = np.random.RandomState(7).randint(
+            0, 64, (1, 20)).astype(np.int32)
+        c = _client(server)
+        t = threading.Thread(
+            target=lambda: c.generate(prompt, n_tokens=25), daemon=True)
+        t.start()
+        deadline = time.time() + 30
+        while (not any(server._draft_pages)) and time.time() < deadline:
+            time.sleep(0.01)  # wait until a slot holds committed pages
+        assert server._pool.used_pages > 0
+        # the reservation covers both halves: the draft rides the SAME
+        # pool, so a spec row holds strictly more pages than target-only
+        held = [len(server._slot_pages[s]) + len(server._draft_pages[s])
+                for s in range(server.serving.max_slots)]
+        c.close()  # mid-decode disconnect
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (all(r is None for r in server._slot_req)
+                    and server._pool.used_pages == 0):
+                break
+            time.sleep(0.02)
+        pool = server._pool
+        assert pool.free_pages == pool.n_pages
+        assert (pool._refs == 0).all()
+        assert all(not p for p in server._slot_pages)
+        assert all(not p for p in server._draft_pages)
+        alloc = tel.counter_value("serving_pages_allocated_total") - a0
+        freed = tel.counter_value("serving_pages_released_total") - r0
+        assert alloc > 0 and alloc == freed
+        assert max(held) > 0 and max(held) % 2 == 0  # target + equal draft
+    finally:
+        server.stop()
+
+
+def test_spec_retirement_releases_both_pools(params):
+    """The clean path: after normal completion, no slot holds target or
+    draft pages and the pool reconciles without any disconnect chaos."""
+    server = _server(params, 2, "lm_draft")
+    try:
+        prompt = np.random.RandomState(8).randint(
+            0, 64, (1, 12)).astype(np.int32)
+        with _client(server) as c:
+            c.generate(prompt, n_tokens=8)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            server.release_prefix_cache()
+            if server._pool.used_pages == 0:
+                break
+            time.sleep(0.02)
+        assert server._pool.free_pages == server._pool.n_pages
+        assert (server._pool._refs == 0).all()
+    finally:
+        server.stop()
