@@ -1417,6 +1417,128 @@ def bench_serving_speculative(ctx_short=1024, ctx_long=16384, n_tokens=96,
     return row
 
 
+# -- serving fleet: prefix-affinity routing vs round-robin over 2 replicas -
+
+
+def bench_serving_fleet(ctx=1024, n_tokens=64, n_groups=6, warm_waves=2):
+    """Round-13 row (docs/PERFORMANCE.md §7h): the fleet router's
+    prefix-affinity policy against round-robin over TWO replicas, same
+    model, same page-pool budget, same traffic.
+
+    Traffic is ``n_groups`` users, each re-sending its own shared-prefix
+    prompt every wave (the agent/chat regime the router targets). Each
+    replica's pool is sized so affinity's partition (half the groups per
+    replica) fits warm, but round-robin's duplication (every group's
+    prefix on BOTH replicas) overflows and churns the prefix maps —
+    the capacity-level cost of ignoring placement, on top of the extra
+    cold prefills. Headline: aggregate warm-wave tok/s/user, affinity
+    over round-robin; the per-replica prefix-hit counters land in the
+    row as hit rates so the ledger also pins WHY the wall time moved."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distriflow_tpu.fleet import FleetRouter
+    from distriflow_tpu.models.generate import pages_per_slot
+    from distriflow_tpu.models.transformer import (
+        TransformerConfig,
+        transformer_lm,
+    )
+    from distriflow_tpu.obs.telemetry import Telemetry
+    from distriflow_tpu.server import InferenceServer
+    from distriflow_tpu.utils.config import ServingConfig
+
+    if SLOW or FAST or time_left() < 150:
+        ctx = ctx // 4
+
+    PAGE_SIZE = 128
+    rng = np.random.RandomState(0)
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=256, n_heads=4, n_layers=4, d_ff=1024,
+        max_seq=ctx + n_tokens, dtype=jnp.bfloat16)
+    params = transformer_lm(cfg, example_seq=128).init(jax.random.PRNGKey(0))
+    prompts = [rng.randint(0, 32000, (1, ctx)).astype(np.int32)
+               for _ in range(n_groups)]
+
+    # pool budget: affinity steady state is n_groups/2 warm prefixes per
+    # replica plus two in-flight working sets; round-robin needs ALL
+    # n_groups prefixes resident on BOTH replicas and does not fit
+    prefix_pages = (ctx - 1) // PAGE_SIZE
+    need = pages_per_slot(ctx + n_tokens, PAGE_SIZE)
+    pool_pages = (n_groups // 2) * prefix_pages + 2 * need
+
+    def run_leg(policy):
+        replicas = [InferenceServer(
+            cfg, params, port=0, telemetry=Telemetry(),
+            serving=ServingConfig(
+                kv_layout="paged", max_slots=n_groups, page_size=PAGE_SIZE,
+                page_pool_pages=pool_pages, batch_window_s=0.05))
+            for _ in range(2)]
+        for server in replicas:
+            server.transport.heartbeat_timeout = 0  # see bench_serving
+            server.setup()
+        router = FleetRouter(port=0, policy=policy, telemetry=Telemetry())
+        for i, server in enumerate(replicas):
+            router.add_replica(server.address, name=f"replica-{i}")
+        router.setup()
+        try:
+            clients = [_serving_client(router.address)
+                       for _ in range(n_groups)]
+            try:
+                def one_wave():
+                    barrier = threading.Barrier(n_groups)
+
+                    def call(i):
+                        barrier.wait()
+                        clients[i].generate(prompts[i], n_tokens=n_tokens)
+
+                    threads = [threading.Thread(target=call, args=(i,))
+                               for i in range(n_groups)]
+                    start = time.perf_counter()
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    return time.perf_counter() - start
+
+                one_wave()  # cold: compiles + first prefills serialize it
+                wall = sum(one_wave() for _ in range(warm_waves))
+            finally:
+                for c in clients:
+                    c.close()
+            hits = sum(s.prefix_hits for s in replicas)
+        finally:
+            router.stop()
+            for server in replicas:
+                server.stop()
+        # hits counted over every wave; only warm-wave requests CAN hit
+        hit_rate = hits / float(warm_waves * n_groups)
+        tok_s_user = warm_waves * n_tokens / wall
+        return tok_s_user, hit_rate
+
+    rr_tok_s_user, rr_hit_rate = run_leg("round_robin")
+    aff_tok_s_user, aff_hit_rate = run_leg("affinity")
+    speedup = aff_tok_s_user / rr_tok_s_user
+    log(f"serving_fleet: affinity {aff_tok_s_user:.2f} tok/s/user "
+        f"(hit rate {aff_hit_rate:.2f}) vs round-robin "
+        f"{rr_tok_s_user:.2f} (hit rate {rr_hit_rate:.2f}) "
+        f"-> {speedup:.2f}x @ pool {pool_pages} pages/replica")
+    return {
+        "config": "serving_fleet",
+        "metric": "warm tok/s/user, affinity vs round-robin (2 replicas)",
+        "value": round(speedup, 2),
+        "affinity_tok_s_user": round(aff_tok_s_user, 2),
+        "rr_tok_s_user": round(rr_tok_s_user, 2),
+        "affinity_hit_rate": round(aff_hit_rate, 3),
+        "rr_hit_rate": round(rr_hit_rate, 3),
+        "traffic": (f"{n_groups} users x {warm_waves} warm waves, "
+                    f"ctx {ctx} +{n_tokens} tok, pool "
+                    f"{pool_pages} pages/replica"),
+    }
+
+
 # -- long context: 16k/32k chunked prefill + decode latency ----------------
 
 
@@ -2031,6 +2153,7 @@ def main() -> None:
         run(bench_serving_continuous)
         run(bench_serving_paged_mixed)
         run(bench_serving_speculative)
+        run(bench_serving_fleet)
         run(bench_decode, n_chips)
         run(bench_long_context)
     run(bench_mnist_sync, n_chips)

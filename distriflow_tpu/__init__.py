@@ -30,7 +30,7 @@ from distriflow_tpu.utils import *  # noqa: F401,F403
 # during the build avoids hard failures from in-progress layers.
 import importlib.util as _ilu
 
-for _mod in ("models", "parallel", "data", "checkpoint", "train", "server", "client", "comm", "obs"):
+for _mod in ("models", "parallel", "data", "checkpoint", "train", "server", "client", "comm", "obs", "fleet"):
     if _ilu.find_spec(f"distriflow_tpu.{_mod}") is None:
         continue  # layer not built yet; real import errors inside a layer still propagate
     _m = __import__(f"distriflow_tpu.{_mod}", fromlist=["*"])

@@ -10,7 +10,11 @@ from distriflow_tpu.client.abstract_client import (
 )
 from distriflow_tpu.client.async_client import AsynchronousSGDClient
 from distriflow_tpu.client.federated_client import FederatedClient
-from distriflow_tpu.client.inference_client import InferenceClient
+from distriflow_tpu.client.inference_client import (
+    InferenceClient,
+    RequestRefused,
+    RequestShed,
+)
 
 __all__ = [
     "AbstractClient",
@@ -19,4 +23,6 @@ __all__ = [
     "AsynchronousSGDClient",
     "FederatedClient",
     "InferenceClient",
+    "RequestRefused",
+    "RequestShed",
 ]

@@ -27,6 +27,29 @@ from distriflow_tpu.utils.serialization import (
 DECODE_TIMEOUT_S = 120.0  # first request pays XLA compilation on the server
 
 
+class RequestShed(RuntimeError):
+    """The fleet router refused this request under queue pressure (SLO-
+    tiered admission, docs/PERFORMANCE.md §7h). Carries the tier the
+    request ran at and the queue depth that justified the shed; callers
+    retry later or at a more urgent tier."""
+
+    def __init__(self, tier: int, queue_depth: int):
+        super().__init__(
+            f"request shed at tier {tier} (queue depth {queue_depth})")
+        self.tier = tier
+        self.queue_depth = queue_depth
+
+
+class RequestRefused(RuntimeError):
+    """The server answered with a structured refusal instead of a result
+    (e.g. ``{"refused": "draining"}`` from a draining replica addressed
+    directly, without a router in front to fail the request over)."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"request refused: {reason}")
+        self.reason = reason
+
+
 class InferenceClient:
     """Remote decoding against an :class:`InferenceServer`."""
 
@@ -91,16 +114,35 @@ class InferenceClient:
         top_p: Optional[float] = None,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        tier: Optional[int] = None,
+        request_id: Optional[str] = None,
     ) -> np.ndarray:
         """Remote :func:`distriflow_tpu.models.generate`; returns
-        ``[B, P + n_tokens]`` int32 (``eos_id`` freezes finished rows)."""
+        ``[B, P + n_tokens]`` int32 (``eos_id`` freezes finished rows).
+
+        ``tier``/``request_id`` are router-plane extras (both optional on
+        the wire, so pre-router servers keep working): the SLO priority
+        class the fleet router sheds by, and an end-to-end idempotency
+        key — resending the SAME request_id after a timeout returns the
+        cached result instead of recomputing. Raises
+        :class:`RequestShed` on a router shed and
+        :class:`RequestRefused` on a draining replica's refusal."""
         payload = self._prompt_payload(prompt)
         payload.update(
             n_tokens=int(n_tokens), temperature=float(temperature),
             top_k=top_k, top_p=top_p, eos_id=eos_id, seed=int(seed),
         )
+        if tier is not None:
+            payload["tier"] = int(tier)
+        if request_id is not None:
+            payload["request_id"] = str(request_id)
         ack = self._request("generate", payload)
         self.last_serving_meta = ack.get("serving")
+        if "result" not in ack:
+            if ack.get("shed"):
+                raise RequestShed(int(ack.get("tier", -1)),
+                                  int(ack.get("queue_depth", -1)))
+            raise RequestRefused(str(ack.get("refused", ack)))
         result = unpack_bytes(ack["result"])
         return deserialize_array(result["tokens"])
 

@@ -771,6 +771,24 @@ class ClientTransport:
             elif not self._stopped:
                 raise  # established-connection failure: keep it loud
         finally:
+            # Drain before closing: fail_pending() resolves the in-flight
+            # request futures inside main()'s teardown, but the chained
+            # concurrent.futures (run_coroutine_threadsafe) only observe
+            # that on a later loop iteration — closing immediately would
+            # abandon them, and a caller mid-``request()`` would burn its
+            # full ack timeout against a dead loop instead of seeing the
+            # retryable ConnectionLost now (the fleet router's failover
+            # path depends on the prompt signal).
+            try:
+                pending = asyncio.all_tasks(self._loop)
+                for task in pending:
+                    task.cancel()
+                if pending:
+                    self._loop.run_until_complete(
+                        asyncio.gather(*pending, return_exceptions=True))
+                self._loop.run_until_complete(asyncio.sleep(0))
+            except Exception:
+                pass
             self._loop.close()
 
     def request(self, event: str, payload: Any, timeout: float = ACK_TIMEOUT_S) -> Any:

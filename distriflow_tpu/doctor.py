@@ -538,6 +538,120 @@ def main() -> int:
     ok &= _check("fleet telemetry drill (wire reports + straggler band)",
                  fleet_telemetry)
 
+    def fleet_failover():
+        """Fleet-router drill (docs/PERFORMANCE.md §7h): two paged
+        replicas behind an affinity router. Clean phase: ten
+        shared-prefix requests must route >= 80% to the warm replica
+        (the affinity contract). Chaos phase: a scripted FaultPlan reset
+        tears the router->warm connection mid-decode with one request in
+        flight and one being sent — both must complete exactly once on
+        the survivor, bit-identical to solo decode, and replaying a
+        completed request_id against the survivor must return the cached
+        ack without a second engine admission (the exactly-once proof)."""
+        import threading
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+        from distriflow_tpu.fleet import FleetRouter, RouterClient
+        from distriflow_tpu.models.generate import generate
+        from distriflow_tpu.models.transformer import (
+            TransformerConfig,
+            transformer_lm,
+        )
+        from distriflow_tpu.obs import Telemetry
+        from distriflow_tpu.server import InferenceServer
+        from distriflow_tpu.utils.config import ServingConfig
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=48, dtype=jnp.float32, use_flash_attention=False)
+        params = transformer_lm(cfg, example_seq=16).init(
+            jax.random.PRNGKey(0))
+        rng = np.random.default_rng(17)
+        shared = rng.integers(1, 64, size=(1, 33)).astype(np.int32)
+        solo = {n: np.asarray(generate(cfg, dict(params), shared, n))
+                for n in (3, 5, 12)}
+        N_CLEAN = 10
+        # frames on the warm conn: 1 warm-up + N_CLEAN clean + 1 in-flight
+        # long decode; the NEXT generate send is the scripted kill
+        plan = FaultPlan(seed=13, schedule=[ScriptedFault(
+            event="generate", nth=N_CLEAN + 3, action="reset")])
+
+        def replica():
+            return InferenceServer(
+                cfg, params, port=0, telemetry=Telemetry(),
+                serving=ServingConfig(
+                    batch_window_s=0.05, decode_chunk=4, kv_layout="paged",
+                    page_size=16, max_slots=2, page_pool_pages=24)).setup()
+
+        sa, sb = replica(), replica()
+        router = FleetRouter(port=0, policy="affinity", stats_interval_s=0.0,
+                             redial=False, telemetry=Telemetry())
+        router.add_replica(sa.address, name="A", fault_plan=plan)
+        router.add_replica(sb.address, name="B")
+        router.setup()
+        try:
+            with RouterClient(router.address) as c:
+                out = c.generate(shared, 3)  # warm-up: cold fleet -> A
+                assert np.array_equal(out, solo[3])
+                warm = c.last_replica
+                routes = []
+                for _ in range(N_CLEAN):
+                    out = c.generate(shared, 3)
+                    assert np.array_equal(out, solo[3])
+                    routes.append(c.last_replica)
+                warm_frac = routes.count(warm) / float(N_CLEAN)
+                assert warm_frac >= 0.8, (
+                    f"warm routing {warm_frac:.0%} < 80% ({routes})")
+
+                results = {}
+
+                def long_decode():
+                    with RouterClient(router.address) as cl:
+                        results["out"] = cl.generate(shared, 12)
+                        results["route"] = cl.last_route
+
+                t = threading.Thread(target=long_decode)
+                t.start()
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:  # A mid-decode
+                    if any(r is not None for r in sa._slot_req):
+                        break
+                    time.sleep(0.002)
+                out = c.generate(shared, 5)  # the scripted kill fires here
+                t.join(timeout=60.0)
+                assert not t.is_alive(), "in-flight request lost"
+                assert c.last_replica == "B" and np.array_equal(out, solo[5])
+                assert results["route"]["replica"] == "B"
+                assert np.array_equal(results["out"], solo[12])
+                failovers = router._tel.counter_value(
+                    "router_failovers_total")
+                assert failovers >= 2.0, failovers
+            # exactly-once: a completed request_id replayed against the
+            # survivor returns the cached ack, no second admission
+            from distriflow_tpu.client import InferenceClient
+            with InferenceClient(sb.address) as direct:
+                first = direct.generate(shared, 5, request_id="doctor-replay")
+                admitted = sb.batched_requests
+                again = direct.generate(shared, 5, request_id="doctor-replay")
+                assert np.array_equal(first, again)
+                assert sb.batched_requests == admitted, "dedup double-applied"
+        finally:
+            router.stop()
+            sa.stop()
+            sb.stop()
+        return (f"clean: {warm_frac:.0%} of {N_CLEAN} shared-prefix requests "
+                f"on warm replica {warm}; chaos: scripted reset mid-decode, "
+                f"2 requests failed over to B bit-identical "
+                f"({failovers:.0f} failovers), replayed request_id served "
+                "from dedup cache (no second admission)")
+
+    ok &= _check("fleet failover drill (affinity routing + exactly-once)",
+                 fleet_failover)
+
     def kill_and_resume():
         """Hard-stop an async training run at a seeded-random mid-run point,
         restart a FRESH server (new object, fresh dataset instance — the

@@ -1,0 +1,21 @@
+"""Serving fleet: an affinity-aware router over N inference replicas.
+
+Round 13 (docs/PERFORMANCE.md §7h): ``FleetRouter`` fronts independent
+``InferenceServer`` replicas with prefix-affinity routing (the shared
+chain hash in ``prefix_hash.py``), SLO-tiered admission with queue-depth
+shedding, and drain/failover over request-id idempotency.
+"""
+
+from distriflow_tpu.fleet.client import RouterClient
+from distriflow_tpu.fleet.prefix_hash import page_hashes, shareable_pages
+from distriflow_tpu.fleet.registry import ReplicaRegistry, ReplicaState
+from distriflow_tpu.fleet.router import FleetRouter
+
+__all__ = [
+    "FleetRouter",
+    "RouterClient",
+    "ReplicaRegistry",
+    "ReplicaState",
+    "page_hashes",
+    "shareable_pages",
+]

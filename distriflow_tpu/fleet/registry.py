@@ -1,0 +1,227 @@
+"""Replica registry: the router's view of each inference replica.
+
+One :class:`ReplicaState` per registered ``InferenceServer``, fed by the
+``fleet_stats`` poll the router runs over the same transport the
+heartbeat/fleet-telemetry plane uses (liveness, queue depth, page
+occupancy, speculative accept rate, draining flag), plus a bounded
+per-replica **shadow prefix map** — chain hash -> depth — learned from
+the prompts the router itself routed (ack metadata proves they reached
+the slots path). The shadow map is a HINT, never correctness: a stale
+entry at worst routes a request to a replica that admits it cold, and
+greedy decode is bit-identical either way (pinned by
+``tests/test_fleet_router.py``). Replicas ship the prefix hashes they
+evict (`release_prefix_cache()` / pool-pressure eviction) in their stats
+ack, and :meth:`ReplicaRegistry.update_stats` forgets those entries so a
+post-evict route doesn't chase warmth that is no longer there.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+#: per-replica shadow-map entry cap — bounds router memory regardless of
+#: traffic mix; LRU within one replica's map (touch on hit, evict cold)
+SHADOW_CAP = 4096
+
+
+class ReplicaState:
+    """Mutable per-replica record. All mutation goes through the owning
+    :class:`ReplicaRegistry` under its lock."""
+
+    def __init__(self, name: str, address: str):
+        self.name = name
+        self.address = address
+        self.conn: Any = None            # ClientTransport, owned by the router
+        self.alive = False
+        self.draining = False
+        self.stats: Dict[str, Any] = {}  # last fleet_stats ack, verbatim
+        self.stats_t = 0.0               # monotonic time of that ack
+        # chain hash -> depth (1-based page count the hash proves warm)
+        self.shadow: "OrderedDict[bytes, int]" = OrderedDict()
+        self.outstanding = 0             # requests forwarded, not yet acked
+        self.routed = 0                  # requests ever routed here
+        self.rr_seq = 0                  # insertion order, the final tie-break
+
+    # -- read helpers (racy reads are fine: stats are advisory) ------------
+
+    def stat(self, key: str, default: Any = None) -> Any:
+        return self.stats.get(key, default)
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self.stat("queue_depth", 0))
+
+    @property
+    def page_occupancy(self) -> float:
+        return float(self.stat("page_occupancy", 0.0))
+
+    @property
+    def speculate_k(self) -> int:
+        return int(self.stat("speculate_k", 0))
+
+    @property
+    def spec_accept_per_step(self) -> Optional[float]:
+        v = self.stat("spec_accept_per_step")
+        return None if v is None else float(v)
+
+    @property
+    def prefix_capable(self) -> bool:
+        return bool(self.stat("prefix_sharing", False))
+
+
+class ReplicaRegistry:
+    """Thread-safe registry of :class:`ReplicaState` rows.
+
+    Router handler threads (routing decisions, ack learning) and the
+    stats poller all touch the same rows, so every mutation and every
+    multi-field read goes through ``_lock``."""
+
+    def __init__(self, shadow_cap: int = SHADOW_CAP):
+        self._lock = threading.Lock()
+        self.shadow_cap = int(shadow_cap)
+        self._replicas: "OrderedDict[str, ReplicaState]" = OrderedDict()  # guarded-by: _lock
+
+    # -- membership --------------------------------------------------------
+
+    def add(self, name: str, address: str) -> ReplicaState:
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            state = ReplicaState(name, address)
+            state.rr_seq = len(self._replicas)
+            self._replicas[name] = state
+            return state
+
+    def get(self, name: str) -> Optional[ReplicaState]:
+        with self._lock:
+            return self._replicas.get(name)
+
+    def all(self) -> List[ReplicaState]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def live(self) -> List[ReplicaState]:
+        """Replicas eligible for NEW work: alive and not draining."""
+        with self._lock:
+            return [r for r in self._replicas.values()
+                    if r.alive and not r.draining]
+
+    def live_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values() if r.alive)
+
+    # -- liveness / stats --------------------------------------------------
+
+    def mark_live(self, name: str) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None:
+                r.alive = True
+
+    def mark_dead(self, name: str) -> None:
+        """A dead replica's warmth is unknowable — drop the shadow map so
+        a later revival starts cold instead of chasing stale hints."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None:
+                r.alive = False
+                r.shadow.clear()
+
+    def mark_draining(self, name: str, draining: bool = True) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None:
+                r.draining = draining
+
+    def update_stats(self, name: str, stats: Dict[str, Any]) -> None:
+        """Fold one ``fleet_stats`` ack in: refresh the advisory numbers,
+        the draining flag, and FORGET any prefix hashes the replica says
+        it evicted since the last poll (the satellite-2 contract)."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return
+            r.stats = dict(stats)
+            r.stats_t = time.monotonic()
+            r.alive = True
+            r.draining = bool(stats.get("draining", False))
+            for hexdigest in stats.get("evicted_prefixes", ()):
+                try:
+                    r.shadow.pop(bytes.fromhex(hexdigest), None)
+                except (ValueError, TypeError):
+                    continue
+
+    # -- shadow prefix map -------------------------------------------------
+
+    def learn(self, name: str, hashes: List[bytes]) -> None:
+        """Record that ``hashes`` (chain hashes of one routed prompt's
+        leading pages) are now resident on ``name`` — called after a
+        successful slots-path ack, because admission registers the full
+        prompt into the replica's prefix map whether or not it hit."""
+        if not hashes:
+            return
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return
+            for depth, h in enumerate(hashes, start=1):
+                r.shadow[h] = depth
+                r.shadow.move_to_end(h)
+            while len(r.shadow) > self.shadow_cap:
+                r.shadow.popitem(last=False)
+
+    def warmth(self, name: str, hashes: List[bytes]) -> int:
+        """Warmest-prefix depth: how many LEADING hashes of this prompt
+        the replica's shadow map holds consecutively (mirrors the
+        server's ``_row_plan`` walk — a gap ends the shared run)."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return 0
+            depth = 0
+            for h in hashes:
+                if h not in r.shadow:
+                    break
+                r.shadow.move_to_end(h)
+                depth += 1
+            return depth
+
+    # -- accounting --------------------------------------------------------
+
+    def note_submit(self, name: str) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None:
+                r.outstanding += 1
+                r.routed += 1
+
+    def note_done(self, name: str) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None and r.outstanding > 0:
+                r.outstanding -= 1
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Operator/doctor view: one row per replica (no raw hashes)."""
+        with self._lock:
+            return {
+                name: {
+                    "address": r.address,
+                    "alive": r.alive,
+                    "draining": r.draining,
+                    "routed": r.routed,
+                    "outstanding": r.outstanding,
+                    "shadow_entries": len(r.shadow),
+                    "queue_depth": r.queue_depth,
+                    "page_occupancy": r.page_occupancy,
+                    "speculate_k": r.speculate_k,
+                    "spec_accept_per_step": r.spec_accept_per_step,
+                    "stats_age_s": (
+                        round(time.monotonic() - r.stats_t, 3)
+                        if r.stats_t else None),
+                }
+                for name, r in self._replicas.items()
+            }

@@ -1,0 +1,414 @@
+"""FleetRouter: an affinity-aware front door over N inference replicas.
+
+The round-13 subsystem (design in docs/PERFORMANCE.md §7h): one router
+process fronts N independent :class:`InferenceServer` replicas on the
+same native transport clients already speak — an ``InferenceClient``
+pointed at the router works unchanged, and the router forwards
+``generate`` / ``beam`` / ``score`` / ``model_info`` over its own
+``ClientTransport`` per replica.
+
+Three routing planes compose per request:
+
+* **prefix affinity** (``policy="affinity"``, the default): the router
+  hashes the prompt's leading pages with the SAME chain hash the
+  server's prefix map uses (``fleet/prefix_hash.py`` — hoisted, so the
+  two sides cannot drift) and scores each live replica by
+  warmest-prefix depth from a bounded shadow map learned from its own
+  routing history; ties fall back to least load (outstanding forwards,
+  then polled page occupancy). ``"round_robin"`` and ``"least_loaded"``
+  are the bench baselines.
+* **SLO-tiered admission**: requests carry a priority tier (0 =
+  interactive, never shed; higher = sheddable). When the *least* queue
+  depth across live replicas exceeds the tier's threshold the router
+  answers ``{"shed": true}`` instead of forwarding — a structured
+  refusal (a raising handler would reach the client as an opaque
+  ``None`` ack), raised client-side as :class:`RequestShed`.
+  Long decodes prefer ``speculate_k > 0`` replicas whose live accept
+  rate (PR 12's ``serving_spec_accepted_per_step``) clears the floor.
+* **drain/failover**: every forwarded request is stamped with a
+  ``request_id``; the replica dedups on it (bounded LRU + in-flight
+  gating, the PR 1 idempotency pattern applied to serving). A replica
+  that dies mid-request (``ConnectionLost``/``AckTimeout``) or answers
+  ``{"refused": "draining"}`` is excluded and the SAME request_id is
+  resubmitted to a peer — at-most-once compute per replica, exactly
+  one answer at the front door, and greedy/seeded decode makes the
+  replayed result bit-identical.
+
+Metrics (docs/OBSERVABILITY.md §1): ``router_requests_total{tier}``,
+``router_affinity_hits_total``, ``router_shed_total{tier}``,
+``router_failovers_total``, ``router_replicas_live``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distriflow_tpu.comm.transport import (
+    AckTimeout,
+    ClientTransport,
+    ConnectionLost,
+    FaultPlan,
+    ServerTransport,
+)
+from distriflow_tpu.fleet.prefix_hash import page_hashes
+from distriflow_tpu.fleet.registry import ReplicaRegistry, ReplicaState
+from distriflow_tpu.obs import get_telemetry
+from distriflow_tpu.utils.logging import VerboseLogger
+from distriflow_tpu.utils.serialization import deserialize_array, unpack_bytes
+
+#: default per-tier shed thresholds: shed tier t when every live replica's
+#: queue depth exceeds this. Tier 0 (interactive) is never shed.
+DEFAULT_SHED_DEPTH: Dict[int, int] = {1: 32, 2: 8}
+
+#: decodes at least this long prefer speculative replicas (the spec win is
+#: memory-bound long decodes; short ones lose the draft overhead)
+LONG_DECODE_TOKENS = 64
+
+#: minimum live accept rate (accepted_per_step / speculate_k) for a spec
+#: replica to keep its long-decode preference; unknown rate = benefit of
+#: the doubt (a cold replica has no signal yet)
+SPEC_ACCEPT_FLOOR = 0.25
+
+ROUTE_TIMEOUT_S = 600.0  # forwarded generate: replica may be cold-compiling
+STATS_TIMEOUT_S = 5.0
+
+
+class FleetRouter:
+    """Front-door router over N ``InferenceServer`` replicas."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        policy: str = "affinity",
+        shed_depth: Optional[Dict[int, int]] = None,
+        long_decode_tokens: int = LONG_DECODE_TOKENS,
+        spec_accept_floor: float = SPEC_ACCEPT_FLOOR,
+        stats_interval_s: float = 0.5,
+        redial: bool = True,
+        request_timeout: float = ROUTE_TIMEOUT_S,
+        telemetry: Any = None,
+        verbose: Optional[bool] = None,
+    ):
+        if policy not in ("affinity", "round_robin", "least_loaded"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.policy = policy
+        self.shed_depth = dict(DEFAULT_SHED_DEPTH if shed_depth is None
+                               else shed_depth)
+        self.long_decode_tokens = int(long_decode_tokens)
+        self.spec_accept_floor = float(spec_accept_floor)
+        self.stats_interval_s = float(stats_interval_s)
+        self.redial = bool(redial)
+        self.request_timeout = float(request_timeout)
+        self.logger = VerboseLogger("FleetRouter", verbose)
+        self.registry = ReplicaRegistry()
+        self.transport = ServerTransport(host, port)
+        self.transport.on("model_info", self._on_info)
+        self.transport.on("generate", self._on_generate)
+        self.transport.on("beam", self._on_forward_beam)
+        self.transport.on("score", self._on_forward_score)
+        self.transport.on("router_snapshot", self._on_snapshot)
+        self._stopped = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+        self._rr_lock = threading.Lock()
+        self._rr_next = 0  # guarded-by: _rr_lock
+        # per-replica fault plans (chaos: scripted resets on the forward
+        # path), installed at add_replica time and honored across redials
+        self._fault_plans: Dict[str, Optional[FaultPlan]] = {}
+        tel = telemetry if telemetry is not None else get_telemetry()
+        self._tel = tel
+        self._m_requests = {t: tel.counter("router_requests_total",
+                                           tier=str(t)) for t in (0, 1, 2)}
+        self._m_shed = {t: tel.counter("router_shed_total", tier=str(t))
+                        for t in (0, 1, 2)}
+        self._m_affinity = tel.counter("router_affinity_hits_total")
+        self._m_failovers = tel.counter("router_failovers_total")
+        self._m_live = tel.gauge("router_replicas_live")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def add_replica(self, address: str, name: Optional[str] = None,
+                    fault_plan: Optional[FaultPlan] = None) -> str:
+        """Register and dial one replica. ``fault_plan`` (chaos drills)
+        rides THIS replica's forward connection only — per-replica plans
+        keep scripted ``nth`` counts deterministic."""
+        name = name or f"replica-{len(self.registry.all())}"
+        state = self.registry.add(name, address)
+        self._fault_plans[name] = fault_plan
+        self._dial(state)
+        self._note_live()
+        return name
+
+    def _dial(self, state: ReplicaState) -> bool:
+        conn = ClientTransport(state.address,
+                               fault_plan=self._fault_plans.get(state.name))
+        conn.on_server_lost = lambda n=state.name: self._on_replica_lost(n)
+        try:
+            conn.connect()
+        except Exception as e:
+            self.logger.log(f"dial {state.name} ({state.address}): {e!r}")
+            self.registry.mark_dead(state.name)
+            return False
+        old, state.conn = state.conn, conn
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                pass
+        self.registry.mark_live(state.name)
+        return True
+
+    def setup(self) -> "FleetRouter":
+        self._stopped.clear()
+        self.transport.start()
+        self.refresh_stats()
+        if self.stats_interval_s > 0:
+            self._poller = threading.Thread(
+                target=self._poll_loop, daemon=True, name="router-stats")
+            self._poller.start()
+        self.logger.log(f"routing on {self.address} "
+                        f"({len(self.registry.all())} replicas, "
+                        f"policy={self.policy})")
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._poller is not None:
+            self._poller.join(timeout=5.0)
+            self._poller = None
+        self.transport.stop()
+        for state in self.registry.all():
+            if state.conn is not None:
+                try:
+                    state.conn.close()
+                except Exception:
+                    pass
+
+    @property
+    def address(self) -> str:
+        return self.transport.address
+
+    # -- stats plane -------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stopped.wait(self.stats_interval_s):
+            self.refresh_stats()
+
+    def refresh_stats(self) -> None:
+        """Poll every replica's ``fleet_stats`` once; a dead replica is
+        re-dialed first when ``redial`` is on (self-healing after a torn
+        connection to a still-running server)."""
+        for state in self.registry.all():
+            if not state.alive:
+                if not (self.redial and self._dial(state)):
+                    continue
+            conn = state.conn
+            if conn is None:
+                continue
+            try:
+                stats = conn.request("fleet_stats", {},
+                                     timeout=STATS_TIMEOUT_S)
+            except (ConnectionLost, AckTimeout) as e:
+                self.logger.log(f"stats poll {state.name}: {e!r}")
+                self.registry.mark_dead(state.name)
+                continue
+            if isinstance(stats, dict):
+                self.registry.update_stats(state.name, stats)
+        self._note_live()
+
+    def _on_replica_lost(self, name: str) -> None:
+        self.registry.mark_dead(name)
+        self._note_live()
+        self.logger.log(f"replica {name} lost")
+
+    def _note_live(self) -> None:
+        self._m_live.set(self.registry.live_count())
+
+    def drain_replica(self, name: str) -> bool:
+        """Ask one replica to drain (refuse new generates; in-flight work
+        completes). Returns True when the replica acknowledged."""
+        state = self.registry.get(name)
+        if state is None or state.conn is None:
+            return False
+        try:
+            ack = state.conn.request("drain", {"enable": True},
+                                     timeout=STATS_TIMEOUT_S)
+        except (ConnectionLost, AckTimeout):
+            self.registry.mark_dead(name)
+            return False
+        self.registry.mark_draining(name, True)
+        return bool(ack)
+
+    # -- routing -----------------------------------------------------------
+
+    def _candidates(self, exclude: Any) -> List[ReplicaState]:
+        return [r for r in self.registry.live() if r.name not in exclude]
+
+    def _pick(self, hashes: List[bytes], n_tokens: int,
+              exclude: Any = ()) -> Optional[Tuple[ReplicaState, int]]:
+        """(replica, affinity_depth) for one request, or None when no
+        live replica remains. Affinity depth is reported even under the
+        baseline policies (it feeds metrics, not their choice)."""
+        cands = self._candidates(exclude)
+        if not cands:
+            return None
+        # speculative preference: long decodes narrow to spec replicas
+        # whose live accept rate clears the floor (unknown = assume ok)
+        if n_tokens >= self.long_decode_tokens:
+            spec = [r for r in cands if r.speculate_k > 0 and (
+                r.spec_accept_per_step is None
+                or r.spec_accept_per_step
+                >= self.spec_accept_floor * r.speculate_k)]
+            if spec:
+                cands = spec
+        depths = {r.name: (self.registry.warmth(r.name, hashes)
+                           if r.prefix_capable else 0)
+                  for r in cands}
+        if self.policy == "round_robin":
+            with self._rr_lock:
+                chosen = cands[self._rr_next % len(cands)]
+                self._rr_next += 1
+            return chosen, depths[chosen.name]
+        if self.policy == "least_loaded" or not any(depths.values()):
+            chosen = min(cands, key=lambda r: (
+                r.outstanding, r.page_occupancy, r.queue_depth, r.rr_seq))
+            return chosen, depths[chosen.name]
+        chosen = min(cands, key=lambda r: (
+            -depths[r.name], r.outstanding, r.page_occupancy, r.rr_seq))
+        return chosen, depths[chosen.name]
+
+    def _should_shed(self, tier: int) -> Optional[int]:
+        """Queue depth justifying a shed of ``tier``, else None."""
+        limit = self.shed_depth.get(tier)
+        if limit is None:
+            return None
+        live = self.registry.live()
+        if not live:
+            return None  # no-replica failures are loud, not silent sheds
+        depth = min(r.queue_depth for r in live)
+        return depth if depth > limit else None
+
+    # -- handlers (transport executor threads) -----------------------------
+
+    def _on_info(self, client_id: str, payload: Any) -> Dict[str, Any]:
+        ack, state, _, _ = self._submit("model_info", {}, [], 0, set())
+        return ack
+
+    def _on_snapshot(self, client_id: str, payload: Any) -> Dict[str, Any]:
+        return {"policy": self.policy, "replicas": self.registry.snapshot()}
+
+    def _on_forward_beam(self, client_id: str, payload: Any) -> Dict[str, Any]:
+        ack, _, _, _ = self._submit("beam", payload, [], 0, set())
+        return ack
+
+    def _on_forward_score(self, client_id: str, payload: Any) -> Dict[str, Any]:
+        ack, _, _, _ = self._submit("score", payload, [], 0, set())
+        return ack
+
+    def _on_generate(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        tier = min(max(int(payload.get("tier", 1)), 0), 2)
+        if payload.get("request_id") is None:
+            # the idempotency key failover replays ride on; client-supplied
+            # ids pass through untouched (end-to-end retries dedup too)
+            payload["request_id"] = f"rt-{uuid.uuid4().hex[:16]}"
+        depth = self._should_shed(tier)
+        if depth is not None:
+            self._m_shed[tier].inc()
+            return {"shed": True, "tier": tier, "queue_depth": depth}
+        hashes = self._prompt_hashes(payload)
+        n_tokens = int(payload.get("n_tokens", 0))
+        ack, state, aff_depth, failovers = self._submit(
+            "generate", payload, hashes, n_tokens, set())
+        if state is None:
+            return ack  # whole-fleet drain refusal: not an accepted request
+        self._m_requests[tier].inc()
+        if aff_depth > 0:
+            self._m_affinity.inc()
+        serving = ack.get("serving")
+        if isinstance(serving, dict):
+            if serving.get("path") == "slots" and state.prefix_capable:
+                self.registry.learn(state.name, hashes)
+            serving["router"] = {"replica": state.name,
+                                 "affinity_depth": aff_depth,
+                                 "failovers": failovers, "tier": tier}
+        return ack
+
+    def _prompt_hashes(self, payload: Dict[str, Any]) -> List[bytes]:
+        """Chain hashes of row 0 of the prompt (multi-row prompts route by
+        their first row). Needs a page size — taken from any live
+        prefix-capable replica's stats; a uniform fleet is assumed
+        (mixed page sizes would make affinity hints meaningless)."""
+        ps = None
+        for r in self.registry.live():
+            if r.prefix_capable:
+                ps = int(r.stat("page_size", 0)) or None
+                break
+        if ps is None:
+            return []
+        try:
+            arr = deserialize_array(unpack_bytes(payload["prompt"])["tokens"])
+        except Exception:
+            return []  # malformed prompt: let the replica raise the real error
+        if arr.ndim != 2 or arr.shape[0] < 1:
+            return []
+        return page_hashes(np.asarray(arr[0]), ps)
+
+    def _submit(self, event: str, payload: Dict[str, Any],
+                hashes: List[bytes], n_tokens: int,
+                tried: set) -> Tuple[Dict[str, Any], ReplicaState, int, int]:
+        """Forward with failover: on ConnectionLost/AckTimeout mark the
+        replica dead, on a drain refusal mark it draining, and resubmit
+        the SAME payload (same request_id) to a peer. The replica-side
+        dedup makes the replay at-most-once per replica; determinism
+        makes any recompute bit-identical."""
+        failovers = 0
+        drains = 0
+        while True:
+            pick = self._pick(hashes, n_tokens, exclude=tried)
+            if pick is None:
+                if drains or any(r.alive and r.draining
+                                 for r in self.registry.all()):
+                    # exhaustion because the fleet is rolling over (refusals
+                    # this call, or replicas already registered as draining):
+                    # pass the structured refusal through so the client sees
+                    # RequestRefused (retryable), not an opaque handler error
+                    return {"refused": "draining"}, None, 0, failovers
+                raise RuntimeError(
+                    f"no live replica for {event!r} "
+                    f"({len(tried)} tried, {failovers} failovers)")
+            state, depth = pick
+            self.registry.note_submit(state.name)
+            try:
+                ack = state.conn.request(event, payload,
+                                         timeout=self.request_timeout)
+            except (ConnectionLost, AckTimeout) as e:
+                self.logger.log(f"{event} on {state.name} failed: {e!r}")
+                self.registry.mark_dead(state.name)
+                self._note_live()
+                tried.add(state.name)
+                failovers += 1
+                self._m_failovers.inc()
+                continue
+            finally:
+                self.registry.note_done(state.name)
+            if ack is None:
+                # the replica handler raised — a stopping server and a bad
+                # request look identical here, so try each peer once; a
+                # truly bad request fails everywhere and surfaces loudly
+                tried.add(state.name)
+                failovers += 1
+                self._m_failovers.inc()
+                continue
+            if isinstance(ack, dict) and ack.get("refused") == "draining":
+                self.registry.mark_draining(state.name, True)
+                tried.add(state.name)
+                drains += 1
+                failovers += 1
+                self._m_failovers.inc()
+                continue
+            return ack, state, depth, failovers

@@ -56,7 +56,8 @@ def default_bands(*, mfu_floor: Optional[float] = None,
                   ack_p99_ms: Optional[float] = None,
                   apply_queue_max: Optional[float] = None,
                   slots_max: Optional[float] = None,
-                  page_occupancy_max: Optional[float] = None) -> List[SLOBand]:
+                  page_occupancy_max: Optional[float] = None,
+                  router_min_replicas: Optional[float] = None) -> List[SLOBand]:
     """The stock bands from docs/OBSERVABILITY.md §6; pass only the
     thresholds you want enforced."""
     bands: List[SLOBand] = []
@@ -80,6 +81,12 @@ def default_bands(*, mfu_floor: Optional[float] = None,
         # breach dumps a flight bundle like every other band
         bands.append(SLOBand("page_pool_pressure", "serving_page_occupancy",
                              "value", {}, upper=page_occupancy_max))
+    if router_min_replicas is not None:
+        # fleet-router capacity floor: live replicas (the router's own
+        # gauge) dropping below N means failover headroom is gone —
+        # the next replica loss takes requests with it
+        bands.append(SLOBand("router_capacity", "router_replicas_live",
+                             "value", {}, lower=router_min_replicas))
     return bands
 
 

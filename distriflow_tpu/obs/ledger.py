@@ -75,6 +75,20 @@ BANDS: Dict[str, Dict[str, Dict[str, float]]] = {
         "accept_rate_16k": {"warn_pct": 1e9, "regress_pct": 1e9},
         "distill_secs": {"warn_pct": 1e9, "regress_pct": 1e9},
     },
+    "serving_fleet": {
+        # round-13 fleet-router row (docs/PERFORMANCE.md §7h): "value" is
+        # the affinity-vs-round-robin aggregate tok/s/user speedup on
+        # shared-prefix traffic under pool pressure and guards the router
+        # win itself; the per-leg throughputs get CI-host slack. The hit
+        # rates are structural (which replica admitted which group) so
+        # they move only when routing logic changes; the round-robin leg's
+        # numbers are the baseline diagnostics.
+        "value": {"warn_pct": 10.0, "regress_pct": 25.0},
+        "affinity_tok_s_user": {"warn_pct": 15.0, "regress_pct": 40.0},
+        "rr_tok_s_user": {"warn_pct": 15.0, "regress_pct": 40.0},
+        "affinity_hit_rate": {"warn_pct": 10.0, "regress_pct": 25.0},
+        "rr_hit_rate": {"warn_pct": 1e9, "regress_pct": 1e9},
+    },
     "transformer_moe_flagship": {
         # round-12 phase attribution (router/dispatch/expert/combine via
         # the exact-FLOP tally): shares of a jittery step_ms, so they get

@@ -69,7 +69,6 @@ shardings (heads-sharded KV cache, psum'd o_proj; see
 
 from __future__ import annotations
 
-import hashlib
 import queue as queue_mod
 import threading
 import time as time_mod
@@ -80,6 +79,7 @@ import jax
 import numpy as np
 
 from distriflow_tpu.comm.transport import ServerTransport
+from distriflow_tpu.fleet.prefix_hash import page_hashes
 from distriflow_tpu.models.generate import (
     _build_paged_fns,
     _build_prefill,
@@ -241,7 +241,31 @@ class InferenceServer:
         self.transport.on("generate", self._on_generate)
         self.transport.on("beam", self._on_beam)
         self.transport.on("score", self._on_score)
+        self.transport.on("fleet_stats", self._on_fleet_stats)
+        self.transport.on("drain", self._on_drain)
         self.transport.on_disconnect = self._on_client_disconnect
+        # fleet-router plane (round 13; docs/PERFORMANCE.md §7h):
+        # draining refuses NEW generates with a structured ack (in-flight
+        # work completes; the router fails refused requests over to a
+        # peer); request-id dedup is the PR 1 idempotency pattern applied
+        # to serving — a replayed id returns the cached ack (bounded LRU)
+        # and a duplicate of an IN-FLIGHT id rides the original compute
+        self._draining = False
+        self._dedup_lock = threading.Lock()
+        self._req_results: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()  # guarded-by: _dedup_lock
+        self._req_live: Dict[str, threading.Event] = {}  # guarded-by: _dedup_lock
+        self._dedup_cap = 256
+        # prefix hashes evicted from _prefix_map since the last stats
+        # poll, shipped (hex) in the fleet_stats ack so the router's
+        # shadow map forgets them too. Bounded deque; single-consumer
+        # (one router) — appends on the scheduler thread, drains on a
+        # handler thread, both ends atomic on a deque.
+        self._evicted_prefixes: Deque[bytes] = deque(maxlen=512)
+        # per-server plain stat fields for the stats ack: the obs
+        # registry may be process-shared across in-process replicas
+        # (tests/bench), so fleet routing signals must not read it
+        self.prefix_hits = 0  # single-writer: scheduler thread
+        self.spec_accept_per_step = 0.0  # single-writer: scheduler thread
         # continuous-batching engine (module docstring): queue + one
         # scheduler thread; plain-int counters kept for tests/soaks that
         # read them directly, mirrored into the obs registry below
@@ -430,7 +454,115 @@ class InferenceServer:
                 req.cancelled = True
         self.fleet.disconnect(client_id)
 
+    # -- fleet-router plane (round 13) -------------------------------------
+
+    def begin_drain(self) -> None:
+        """Refuse NEW generates with ``{"refused": "draining"}`` while
+        in-flight work completes. The fleet router reads the flag from
+        ``fleet_stats`` (and from the refusal itself) and fails new
+        traffic over to peers; ``end_drain`` re-admits."""
+        self._draining = True
+        self.logger.log("draining: refusing new generates")
+
+    def end_drain(self) -> None:
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _on_drain(self, client_id: str, payload: Any) -> Dict[str, Any]:
+        enable = bool((payload or {}).get("enable", True))
+        if enable:
+            self.begin_drain()
+        else:
+            self.end_drain()
+        return {"draining": self._draining}
+
+    def _on_fleet_stats(self, client_id: str, payload: Any) -> Dict[str, Any]:
+        """Routing signals for the fleet router, served as a direct ack
+        on the same transport the heartbeat plane rides. Values are
+        advisory snapshots (racy reads of scheduler-thread state are
+        fine); ``evicted_prefixes`` is a drain — each evicted chain hash
+        is shipped exactly once, to the single router this server
+        assumes (satellite 2: the router forgets what the replica
+        evicted, so affinity never chases cold pages)."""
+        evicted: List[str] = []
+        while True:
+            try:
+                evicted.append(self._evicted_prefixes.popleft().hex())
+            except IndexError:
+                break
+        paged = self._paged
+        return {
+            "queue_depth": self._queue.qsize() + len(self._backlog),
+            "slots_active": sum(
+                1 for r in self._slot_req if r is not None),
+            "max_slots": self.serving.max_slots,
+            "draining": self._draining,
+            "page_size": self.serving.page_size,
+            "prefix_sharing": bool(paged and self.serving.prefix_sharing),
+            "page_occupancy": (
+                self._pool.used_pages / self._n_pages) if paged else 0.0,
+            "free_pages": self._pool.free_pages if paged else -1,
+            "prefix_hits": self.prefix_hits,
+            "speculate_k": self._spec_k,
+            "spec_accept_per_step": self.spec_accept_per_step,
+            "evicted_prefixes": evicted,
+        }
+
     def _on_generate(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Generate front: drain refusal + request-id idempotency around
+        :meth:`_generate_ack` (the actual decode).
+
+        With a ``request_id`` (stamped by the fleet router, or by any
+        client wanting end-to-end retry safety): a completed id returns
+        its cached ack without touching the engine; an id currently
+        computing parks this duplicate on the original's event and both
+        answer from one compute (in-flight gating); only a novel id runs.
+        The cache is a bounded LRU — far deeper than the router's
+        failover window needs — and drain refusals are structured acks,
+        never exceptions, because a raising handler reaches the client
+        as an opaque ``None`` ack."""
+        rid = payload.get("request_id")
+        if rid is None:
+            if self._draining:
+                return {"refused": "draining"}
+            return self._generate_ack(client_id, payload)
+        rid = str(rid)
+        with self._dedup_lock:
+            cached = self._req_results.get(rid)
+            if cached is not None:
+                self._req_results.move_to_end(rid)
+                return cached
+            gate = self._req_live.get(rid)
+            if gate is None and not self._draining:
+                self._req_live[rid] = threading.Event()
+        if gate is not None:
+            # duplicate of an in-flight request: ride the original
+            gate.wait(timeout=600.0)
+            with self._dedup_lock:
+                cached = self._req_results.get(rid)
+            if cached is not None:
+                return cached
+            # the original errored — fall through and compute fresh
+            # (deterministic decode: same bits either way)
+        if self._draining:
+            return {"refused": "draining"}
+        try:
+            ack = self._generate_ack(client_id, payload)
+            with self._dedup_lock:
+                self._req_results[rid] = ack
+                while len(self._req_results) > self._dedup_cap:
+                    self._req_results.popitem(last=False)
+            return ack
+        finally:
+            with self._dedup_lock:
+                evt = self._req_live.pop(rid, None)
+            if evt is not None:
+                evt.set()
+
+    def _generate_ack(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         prompt = _prompt_from(payload, self._prompt_cap())
         n_tokens = int(payload["n_tokens"])
         temperature = float(payload.get("temperature", 0.0))
@@ -588,19 +720,14 @@ class InferenceServer:
     def _row_plan(self, tokens: np.ndarray) -> Tuple[List[int], List[bytes]]:
         """(shared leading pages, per-page chain hashes) for one prompt
         row. Hash j covers pages 0..j, so a hit guarantees the whole
-        prefix matches, not just page j. Shareable pages cap at
-        ``(plen - 1) // page_size``: at least one suffix token must run
-        through prefill/extend to produce the first-token logits."""
-        ps = self.serving.page_size
-        hashes: List[bytes] = []
+        prefix matches, not just page j. The chain itself lives in
+        ``fleet/prefix_hash.py`` — ONE implementation for this map and
+        the fleet router's affinity scoring, so the two can never drift
+        (the golden-hash test pins the chain)."""
         shared: List[int] = []
         if not self.serving.prefix_sharing:
-            return shared, hashes
-        h = b""
-        for j in range((len(tokens) - 1) // ps):
-            h = hashlib.sha1(
-                h + tokens[j * ps:(j + 1) * ps].tobytes()).digest()
-            hashes.append(h)
+            return shared, []
+        hashes = page_hashes(tokens, self.serving.page_size)
         for hj in hashes:
             pg = self._prefix_map.get(hj)
             if pg is None:
@@ -616,6 +743,7 @@ class InferenceServer:
         page — it stops being discoverable, nothing more."""
         while shortfall > 0 and self._prefix_map:
             _h, pg = self._prefix_map.popitem(last=False)
+            self._evicted_prefixes.append(_h)
             shortfall -= self._pool.unref([pg])
 
     def _reserve(self, req: _Request) -> bool:
@@ -649,6 +777,7 @@ class InferenceServer:
             plan["owned"] = self._pool.alloc(need - len(plan["shared"]))
             plan["draft"] = self._pool.alloc(dneed)
             if plan["shared"]:
+                self.prefix_hits += 1
                 self._m_prefix_hits.inc()
                 self._m_prefix_tokens.inc(
                     len(plan["shared"]) * self.serving.page_size)
@@ -1087,7 +1216,8 @@ class InferenceServer:
         self._m_tokens.inc(emitted_now)
         self._m_spec_proposed.inc(k * len(active))
         self._m_spec_accepted.inc(accepted_now)
-        self._m_spec_rate.set(accepted_now / len(active))
+        self.spec_accept_per_step = accepted_now / len(active)
+        self._m_spec_rate.set(self.spec_accept_per_step)
         self._m_tpot.observe(elapsed_ms * len(active) / max(emitted_now, 1))
         self._m_slots.set(sum(1 for r in self._slot_req if r is not None))
 
@@ -1164,6 +1294,7 @@ class InferenceServer:
         if self._paged:
             while self._prefix_map:
                 _h, pg = self._prefix_map.popitem(last=False)
+                self._evicted_prefixes.append(_h)
                 freed += self._pool.unref([pg])
             self._note_occupancy()
         return freed

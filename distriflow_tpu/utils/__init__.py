@@ -7,6 +7,7 @@ from distriflow_tpu.utils.config import (
     MeshConfig,
     RetryPolicy,
     ServerHyperparams,
+    ServingConfig,
     UnknownConfigKeyError,
     asdict,
     client_hyperparams,
@@ -48,6 +49,7 @@ __all__ = [
     "MeshConfig",
     "RetryPolicy",
     "ServerHyperparams",
+    "ServingConfig",
     "UnknownConfigKeyError",
     "asdict",
     "client_hyperparams",
