@@ -29,21 +29,30 @@ from distriflow_tpu.analysis.core import (  # noqa: F401
 from distriflow_tpu.analysis.witness import (  # noqa: F401
     LockOrderViolation,
     OrderedLock,
+    PoolConservationViolation,
+    PoolWitness,
     ordered_lock,
+    pool_witness_enabled,
     reset_witness,
     witness_enabled,
 )
+
+#: every check family the runner knows; ``--check`` and the default set
+ALL_FAMILIES = ("lock", "tracing", "obs", "wire", "resource")
 
 
 def run_checks(paths, checks=None):
     """Run the selected check families over ``paths``; returns findings
     sorted by (path, line).  ``checks`` is an iterable of family names
-    (``lock``, ``tracing``, ``obs``); None runs all three."""
+    (``lock``, ``tracing``, ``obs``, ``wire``, ``resource``); None runs
+    all of them."""
     from distriflow_tpu.analysis.lock_check import check_locks
     from distriflow_tpu.analysis.obs_check import check_obs
+    from distriflow_tpu.analysis.resource_check import check_resource
     from distriflow_tpu.analysis.tracing_check import check_tracing
+    from distriflow_tpu.analysis.wire_check import check_wire
 
-    fams = set(checks) if checks else {"lock", "tracing", "obs"}
+    fams = set(checks) if checks else set(ALL_FAMILIES)
     modules = load_modules(paths)
     findings = []
     if "lock" in fams:
@@ -52,5 +61,9 @@ def run_checks(paths, checks=None):
         findings.extend(check_tracing(modules))
     if "obs" in fams:
         findings.extend(check_obs(modules))
+    if "wire" in fams:
+        findings.extend(check_wire(modules))
+    if "resource" in fams:
+        findings.extend(check_resource(modules))
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
     return findings

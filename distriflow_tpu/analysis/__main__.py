@@ -31,8 +31,9 @@ from distriflow_tpu.analysis.core import (
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m distriflow_tpu.analysis",
-        description="dfcheck: lock-discipline, JAX tracing-safety, and "
-        "observability-contract static analysis",
+        description="dfcheck: lock-discipline, JAX tracing-safety, "
+        "observability-contract, wire-schema, and resource-lifecycle "
+        "static analysis",
     )
     ap.add_argument(
         "paths", nargs="*", default=None,
@@ -48,7 +49,8 @@ def main(argv=None) -> int:
         help="alternate baseline file",
     )
     ap.add_argument(
-        "--check", action="append", choices=["lock", "tracing", "obs"],
+        "--check", action="append",
+        choices=["lock", "tracing", "obs", "wire", "resource"],
         help="restrict to one or more check families (default: all)",
     )
     ap.add_argument(

@@ -19,6 +19,15 @@ invariants.  This module holds the pieces every check family shares:
     held, so its body is analyzed as if the lock were taken at entry.
   - ``# dfcheck: ignore[check-name]`` on a line suppresses findings of that
     check on that line (``ignore[*]`` suppresses all checks).
+  - ``# dfcheck: pairs acquire=X release=Y[|Z] [counter=attr] [mode=state]``
+    on (or above) a ``def`` declares an acquire/release resource pair
+    verified by :mod:`.resource_check` (page pools, leases, slots,
+    refcounts).
+  - ``# dfcheck: payload [param=schema, ...] [-> schema]`` on (or above) a
+    ``def`` binds named parameters (and returned dict literals) to a wire
+    payload schema from :mod:`distriflow_tpu.comm.schema`; the single-name
+    form trailing an assignment (``x = ...  # dfcheck: payload name``)
+    binds the assigned variable.  Consumed by :mod:`.wire_check`.
 
 * :func:`load_baseline` / :func:`match_baseline` — the triaged-suppression
   workflow.  ``analysis/baseline.json`` is a checked-in list of
@@ -45,6 +54,63 @@ BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
 _GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 _HOLDS_RE = re.compile(r"#\s*dfcheck:\s*holds\s+([A-Za-z_][A-Za-z0-9_]*)")
 _IGNORE_RE = re.compile(r"#\s*dfcheck:\s*ignore\[([A-Za-z0-9_*,\- ]+)\]")
+_PAIRS_RE = re.compile(
+    r"#\s*dfcheck:\s*pairs\s+acquire=([A-Za-z_][A-Za-z0-9_]*)"
+    r"\s+release=([A-Za-z_][A-Za-z0-9_|]*)"
+    r"(?:\s+counter=([A-Za-z_][A-Za-z0-9_]*))?"
+    r"(?:\s+mode=(value|state))?"
+)
+_PAYLOAD_RE = re.compile(r"#\s*dfcheck:\s*payload\s+([A-Za-z0-9_=,>\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class PairSpec:
+    """One ``# dfcheck: pairs`` annotation: an acquire def plus the names of
+    the defs that release what it acquires.  ``mode="value"`` means the
+    acquire *returns* the resource (the value must not be dropped);
+    ``mode="state"`` means acquire/release mutate shared state and the
+    check only proves release liveness + counter pairing."""
+
+    acquire: str
+    releases: Tuple[str, ...]
+    counter: Optional[str] = None
+    mode: str = "value"
+
+
+@dataclasses.dataclass(frozen=True)
+class PayloadSpec:
+    """One ``# dfcheck: payload`` annotation.
+
+    ``params`` maps parameter names to schema names (def form); ``returns``
+    names the schema the function's returned dict literals must satisfy;
+    ``bare`` is the single-name assignment form binding the assigned
+    variable."""
+
+    params: Tuple[Tuple[str, str], ...] = ()
+    returns: Optional[str] = None
+    bare: Optional[str] = None
+
+
+def _parse_payload_spec(spec: str) -> Optional[PayloadSpec]:
+    returns = None
+    if "->" in spec:
+        left, _, right = spec.partition("->")
+        returns = right.strip() or None
+        spec = left
+    params: List[Tuple[str, str]] = []
+    bare = None
+    for tok in re.split(r"[,\s]+", spec.strip()):
+        if not tok:
+            continue
+        if "=" in tok:
+            k, _, v = tok.partition("=")
+            if k and v:
+                params.append((k, v))
+        else:
+            bare = tok
+    if not params and not returns and not bare:
+        return None
+    return PayloadSpec(params=tuple(params), returns=returns, bare=bare)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,6 +160,8 @@ class SourceModule:
         self.guarded_by: Dict[int, str] = {}
         self.holds: Dict[int, str] = {}
         self.ignores: Dict[int, Set[str]] = {}
+        self.pairs: Dict[int, PairSpec] = {}
+        self.payloads: Dict[int, PayloadSpec] = {}
         for i, text in enumerate(self.lines, start=1):
             if "#" not in text:
                 continue
@@ -108,6 +176,21 @@ class SourceModule:
                 self.ignores[i] = {
                     tok.strip() for tok in m.group(1).split(",") if tok.strip()
                 }
+            m = _PAIRS_RE.search(text)
+            if m:
+                self.pairs[i] = PairSpec(
+                    acquire=m.group(1),
+                    releases=tuple(
+                        r for r in m.group(2).split("|") if r
+                    ),
+                    counter=m.group(3),
+                    mode=m.group(4) or "value",
+                )
+            m = _PAYLOAD_RE.search(text)
+            if m:
+                spec = _parse_payload_spec(m.group(1))
+                if spec is not None:
+                    self.payloads[i] = spec
 
     def ignored(self, line: int, check: str) -> bool:
         """True when ``# dfcheck: ignore[...]`` on ``line`` covers ``check``."""
@@ -126,6 +209,26 @@ class SourceModule:
         for ln in (node.lineno, first - 1):
             if ln in self.holds:
                 return self.holds[ln]
+        return None
+
+    def pairs_for_def(self, node: ast.AST) -> Optional[PairSpec]:
+        """``pairs`` annotation on a ``def`` line or the line above it."""
+        first = min(
+            [node.lineno] + [d.lineno for d in getattr(node, "decorator_list", [])]
+        )
+        for ln in (node.lineno, first - 1):
+            if ln in self.pairs:
+                return self.pairs[ln]
+        return None
+
+    def payload_for_def(self, node: ast.AST) -> Optional[PayloadSpec]:
+        """``payload`` annotation on a ``def`` line or the line above it."""
+        first = min(
+            [node.lineno] + [d.lineno for d in getattr(node, "decorator_list", [])]
+        )
+        for ln in (node.lineno, first - 1):
+            if ln in self.payloads:
+                return self.payloads[ln]
         return None
 
 

@@ -23,12 +23,24 @@ body must be dominated by ``with self._lock:``.  Exemptions, in order:
 **lock-order** — a static acquisition graph: while lock A is held
 (lexically, or via a ``holds`` annotation), acquiring lock B adds the
 edge ``A -> B``; calls to same-class methods made while holding A
-propagate the callee's acquisitions one level.  Lock identity is
+propagate the callee's **transitive** acquisition set (a per-class
+fixpoint over the same-class call graph — v1 stopped at one level, so a
+``with self._lb`` two calls deep was invisible).  Lock identity is
 ``RootClass.attr`` where RootClass is the topmost base among the
 analyzed classes, so ``AsynchronousSGDServer`` and ``FederatedServer``
 share their inherited ``AbstractServer`` locks.  Any cycle in the graph
 is a potential deadlock and is reported once, on each participating
 acquisition edge's first site.
+
+**holds-at-callsite inference** (v2) — a private (``_``-prefixed)
+method with no ``holds`` annotation whose every recorded same-class
+callsite runs with a common lock held is analyzed as if that lock were
+held at entry, instead of with held=∅.  Callsites are recorded with the
+exact held set at the call expression (callsites inside nested
+functions/lambdas record ∅, soundly blocking inference — a closure can
+run after the lock is dropped).  Inference iterates to a fixpoint so a
+locked wrapper chain propagates depth-first; public methods and
+constructors are never inferred (anyone may call them unlocked).
 """
 
 from __future__ import annotations
@@ -100,6 +112,38 @@ def _collect_acquisitions(fn: ast.AST) -> Set[str]:
     return out
 
 
+def _self_callees(fn: ast.AST) -> Set[str]:
+    """Every ``self.X(...)`` callee name in a function body, at any depth."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = _self_attr(node.func)
+            if callee is not None:
+                out.add(callee)
+    return out
+
+
+def _transitive_acquisitions(cls: "_ClassInfo") -> Dict[str, Set[str]]:
+    """Per-method fixpoint ``acq*(m) = lexical(m) ∪ ⋃ acq*(same-class
+    callees of m)`` — the full same-module call-graph propagation that
+    replaced v1's one-level lookup."""
+    lexical = {n: _collect_acquisitions(fn) for n, fn in cls.methods.items()}
+    callees = {
+        n: {c for c in _self_callees(fn) if c in cls.methods}
+        for n, fn in cls.methods.items()
+    }
+    acq = {n: set(s) for n, s in lexical.items()}
+    changed = True
+    while changed:
+        changed = False
+        for n in acq:
+            for c in callees[n]:
+                if not acq[c] <= acq[n]:
+                    acq[n] |= acq[c]
+                    changed = True
+    return acq
+
+
 class _MethodChecker:
     """Walk one method with an explicit held-lock set.
 
@@ -118,7 +162,9 @@ class _MethodChecker:
         findings: List[Finding],
         edges: Dict[Tuple[str, str], Tuple[str, int]],
         lock_id,  # (attr) -> qualified lock id string
-        entry_holds: Optional[str],
+        entry_holds,  # str | Iterable[str] | None — locks held at entry
+        acq_star: Optional[Dict[str, Set[str]]] = None,
+        on_call=None,  # callback(callee_name, frozenset(held)) per callsite
     ):
         self.cls = cls
         self.mod = cls.module
@@ -127,10 +173,14 @@ class _MethodChecker:
         self.findings = findings
         self.edges = edges
         self.lock_id = lock_id
+        self.acq_star = acq_star
+        self.on_call = on_call
         self.symbol = f"{cls.name}.{method_name}"
         held: List[str] = []
-        if entry_holds:
+        if isinstance(entry_holds, str):
             held.append(entry_holds)
+        elif entry_holds:
+            held.extend(sorted(entry_holds))
         self._visit_body(getattr(method, "body", []), held)
 
     # -- helpers ----------------------------------------------------------
@@ -173,6 +223,10 @@ class _MethodChecker:
                 lock = self.guarded[attr]
                 if lock not in held:
                     self._flag(sub, attr, lock)
+            if self.on_call is not None and isinstance(sub, ast.Call):
+                callee = _self_attr(sub.func)
+                if callee is not None and callee in self.cls.methods:
+                    self.on_call(callee, frozenset(held))
             stack.extend(ast.iter_child_nodes(sub))
 
     # -- traversal --------------------------------------------------------
@@ -198,13 +252,18 @@ class _MethodChecker:
             self._visit_body(stmt.body, held + locks)
             return
         # same-class call made while holding a lock: propagate the callee's
-        # acquisitions one level into the order graph
+        # transitive acquisition set into the order graph
         if held:
             for sub in ast.walk(stmt):
                 if isinstance(sub, ast.Call):
                     callee = _self_attr(sub.func)
                     if callee and callee in self.cls.methods:
-                        for inner in _collect_acquisitions(self.cls.methods[callee]):
+                        if self.acq_star is not None:
+                            inner_set = self.acq_star.get(callee, set())
+                        else:
+                            inner_set = _collect_acquisitions(
+                                self.cls.methods[callee])
+                        for inner in inner_set:
                             for outer in held:
                                 self._record_edge(outer, inner, sub.lineno)
         # generic statements: check every expression field with the current
@@ -297,19 +356,66 @@ def check_locks(modules: List[SourceModule]) -> List[Finding]:
     for cls in classes.values():
         guarded = _inherited_guarded(cls, classes)
         root = _root_class(cls.name, classes)
+        acq_star = _transitive_acquisitions(cls)
 
         def lock_id(attr: str, _root=root) -> str:
             return f"{_root}.{attr}"
 
+        def entry_for(name: str, method: ast.AST,
+                      inferred: Dict[str, Set[str]]) -> Set[str]:
+            holds: Set[str] = set(inferred.get(name, set()))
+            ann = cls.module.holds_for_def(method)
+            if ann:
+                holds.add(ann)
+            return holds
+
+        # -- holds-at-callsite inference fixpoint ---------------------------
+        # dry passes record (callee, held-at-callsite) pairs; a private
+        # unannotated method whose every callsite holds a common lock is
+        # then analyzed with that lock held at entry.  Re-running lets a
+        # chain of locked private wrappers propagate (bounded: held sets
+        # only grow from annotations + with-statements, so ~4 rounds).
+        inferred: Dict[str, Set[str]] = {}
+        for _ in range(4):
+            callsites: Dict[str, List[frozenset]] = {}
+
+            def on_call(callee: str, held: frozenset) -> None:
+                callsites.setdefault(callee, []).append(held)
+
+            for name, method in cls.methods.items():
+                if name in _CONSTRUCTORS or name.endswith("_locked"):
+                    continue
+                _MethodChecker(
+                    cls, method, name, guarded, [], {}, lock_id,
+                    entry_for(name, method, inferred),
+                    acq_star=acq_star, on_call=on_call,
+                )
+            new_inferred: Dict[str, Set[str]] = {}
+            for name, method in cls.methods.items():
+                if (not name.startswith("_") or name in _CONSTRUCTORS
+                        or name.startswith("__") or name.endswith("_locked")):
+                    continue
+                if cls.module.holds_for_def(method):
+                    continue  # annotation wins over inference
+                sites = callsites.get(name)
+                if not sites:
+                    continue
+                common = set(sites[0])
+                for s in sites[1:]:
+                    common &= s
+                if common:
+                    new_inferred[name] = common
+            if new_inferred == inferred:
+                break
+            inferred = new_inferred
+
+        # -- final pass: real findings + order edges ------------------------
         for name, method in cls.methods.items():
             if name in _CONSTRUCTORS or name.endswith("_locked"):
                 continue
-            entry_holds = cls.module.holds_for_def(method)
-            if not guarded and entry_holds is None:
-                # still need order edges from unannotated classes
-                pass
             _MethodChecker(
-                cls, method, name, guarded, findings, edges, lock_id, entry_holds
+                cls, method, name, guarded, findings, edges, lock_id,
+                entry_for(name, method, inferred), acq_star=acq_star,
             )
 
     for cycle in _find_cycles(edges):

@@ -148,3 +148,60 @@ def ordered_lock(
     if enabled:
         return OrderedLock(name)
     return threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# pool-conservation witness
+# ---------------------------------------------------------------------------
+
+POOL_ENV_VAR = "DISTRIFLOW_POOL_WITNESS"
+
+
+class PoolConservationViolation(AssertionError):
+    """Raised when free + referenced + shared pages != pool size: pages were
+    leaked (never released) or double-released somewhere in the serving
+    engine.  Subclasses AssertionError so an enabled witness fails tests
+    loudly rather than logging."""
+
+
+def pool_witness_enabled() -> bool:
+    return os.environ.get(POOL_ENV_VAR, "").strip() not in (
+        "", "0", "false", "off")
+
+
+class PoolWitness:
+    """Runtime counterpart of the resource family's static page-pool proofs.
+
+    At quiescence points (idle scheduler tick, ``stop()``, prefix-cache
+    flush) the serving engine reports its page accounting and the witness
+    asserts the conservation identity::
+
+        free + referenced + shared == pool size
+
+    where *shared* counts pages held only by the prefix cache and
+    *referenced* counts pages held by live slots (a page both slot-held and
+    prefix-shared counts once, as referenced).  With the witness disabled
+    (the default) ``verify`` is a no-op, so production pays one branch.
+    """
+
+    def __init__(self, n_pages: int, enabled: Optional[bool] = None):
+        self.n_pages = int(n_pages)
+        self.enabled = pool_witness_enabled() if enabled is None else enabled
+        self.checks = 0
+        self.trips = 0
+
+    def verify(self, free: int, referenced: int, shared: int,
+               context: str = "") -> None:
+        if not self.enabled:
+            return
+        self.checks += 1
+        total = free + referenced + shared
+        if total != self.n_pages:
+            self.trips += 1
+            where = f" at {context}" if context else ""
+            raise PoolConservationViolation(
+                f"page-pool conservation violated{where}: "
+                f"free={free} + referenced={referenced} + shared={shared} "
+                f"= {total}, pool size {self.n_pages} "
+                f"({'leaked' if total < self.n_pages else 'double-counted'} "
+                f"{abs(self.n_pages - total)} page(s))")

@@ -127,7 +127,7 @@ class InferenceClient:
         cached result instead of recomputing. Raises
         :class:`RequestShed` on a router shed and
         :class:`RequestRefused` on a draining replica's refusal."""
-        payload = self._prompt_payload(prompt)
+        payload = self._prompt_payload(prompt)  # dfcheck: payload generate_request
         payload.update(
             n_tokens=int(n_tokens), temperature=float(temperature),
             top_k=top_k, top_p=top_p, eos_id=eos_id, seed=int(seed),
@@ -136,7 +136,7 @@ class InferenceClient:
             payload["tier"] = int(tier)
         if request_id is not None:
             payload["request_id"] = str(request_id)
-        ack = self._request("generate", payload)
+        ack = self._request("generate", payload)  # dfcheck: payload generate_ack
         self.last_serving_meta = ack.get("serving")
         if "result" not in ack:
             if ack.get("shed"):
@@ -156,7 +156,7 @@ class InferenceClient:
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Remote :func:`distriflow_tpu.models.beam_search`; returns
         ``(tokens [B, P + n_tokens], scores [B])``."""
-        payload = self._prompt_payload(prompt)
+        payload = self._prompt_payload(prompt)  # dfcheck: payload beam_request
         payload.update(
             n_tokens=int(n_tokens), beam_size=int(beam_size),
             length_penalty=float(length_penalty), eos_id=eos_id,
@@ -167,7 +167,7 @@ class InferenceClient:
     def score(self, tokens: np.ndarray, from_pos: int = 1) -> np.ndarray:
         """Remote :func:`distriflow_tpu.models.sequence_logprob`: teacher-
         forced ``log P(tokens[:, from_pos:] | prefix)`` per row."""
-        payload = self._prompt_payload(tokens)
+        payload = self._prompt_payload(tokens)  # dfcheck: payload score_request
         payload["from_pos"] = int(from_pos)
         result = unpack_bytes(self._request("score", payload)["result"])
         return deserialize_array(result["scores"])

@@ -104,6 +104,7 @@ class ReportBuilder:
         be fresh (restart) or may have missed in-flight deltas."""
         self._full_next = True
 
+    # dfcheck: payload -> report
     def build(self) -> Dict[str, Any]:
         """One report: everything changed since the last build (or
         everything, when full). Values are cumulative — see module doc."""
@@ -202,6 +203,7 @@ class TelemetryCollector:
 
     # -- ingest -------------------------------------------------------------
 
+    # dfcheck: payload report=report
     def ingest(self, client_id: str, report: Any) -> bool:
         """Merge one shipped report; returns True when it was applied
         (False: wrong version / stale seq — both counted, never raised:

@@ -174,10 +174,7 @@ class AsynchronousSGDServer(AbstractServer):
             self._client_batches.setdefault(client_id, []).append(batch.batch)
             dispatch_version = self.version_counter
             self._client_versions[client_id] = dispatch_version
-            if self.config.batch_lease_s > 0:
-                self._lease_deadlines[(client_id, batch.batch)] = (
-                    time.monotonic() + self.config.batch_lease_s
-                )
+            self._grant_lease(client_id, batch.batch)
             self._waiting.discard(client_id)
         # the dispatch opens the update's trace: its trace_id rides the
         # download header, the client copies it into the resulting upload,
@@ -214,7 +211,7 @@ class AsynchronousSGDServer(AbstractServer):
                         if not held:
                             self._client_batches.pop(client_id, None)
                     self._client_versions.pop(client_id, None)
-                    self._lease_deadlines.pop((client_id, batch.batch), None)
+                    self._revoke_lease(client_id, batch.batch)
                     self._waiting.discard(client_id)
                 if owned:
                     self.dataset.requeue(batch.batch)
@@ -249,9 +246,23 @@ class AsynchronousSGDServer(AbstractServer):
             outstanding = self._client_batches.pop(client_id, [])
             self._client_versions.pop(client_id, None)
             for b in outstanding:
-                self._lease_deadlines.pop((client_id, b), None)
+                self._revoke_lease(client_id, b)
             self._waiting.discard(client_id)
         return outstanding
+
+    # dfcheck: pairs acquire=_grant_lease release=_revoke_lease mode=state
+    def _grant_lease(self, client_id: str, batch: int) -> None:  # dfcheck: holds _lock
+        """Arm the straggler lease for one dispatched batch; no-op when
+        leases are disabled (``config.batch_lease_s <= 0``)."""
+        if self.config.batch_lease_s > 0:
+            self._lease_deadlines[(client_id, batch)] = (
+                time.monotonic() + self.config.batch_lease_s
+            )
+
+    def _revoke_lease(self, client_id: str, batch: int) -> None:  # dfcheck: holds _lock
+        """Retire one batch lease (idempotent: expiry, completion,
+        disconnection, and reclaim may race; last one wins harmlessly)."""
+        self._lease_deadlines.pop((client_id, batch), None)
 
     def handle_connection(self, client_id: str) -> None:
         # weights + first batch(es) to the new client (reference :59-63);
@@ -295,7 +306,7 @@ class AsynchronousSGDServer(AbstractServer):
                     held.remove(msg.batch)
                     if not held:
                         self._client_batches.pop(client_id, None)
-                self._lease_deadlines.pop((client_id, msg.batch), None)
+                self._revoke_lease(client_id, msg.batch)
         accepted = False
         if msg.gradients is not None:
             if first:
@@ -454,7 +465,7 @@ class AsynchronousSGDServer(AbstractServer):
                         # one expiry per dispatch: the straggler keeps its
                         # dispatch record (its eventual upload still names
                         # the batch), only the lease is retired
-                        self._lease_deadlines.pop((cid, batch))
+                        self._revoke_lease(cid, batch)
                         expired.append((cid, batch))
                 # counted while still under the lock: the manifest snapshot
                 # reads this field holding _lock, and the monitor thread is

@@ -78,6 +78,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from distriflow_tpu.analysis.witness import PoolWitness
 from distriflow_tpu.comm.transport import ServerTransport
 from distriflow_tpu.fleet.prefix_hash import page_hashes
 from distriflow_tpu.models.generate import (
@@ -174,6 +175,7 @@ class _PagePool:
     def refcount(self, page: int) -> int:
         return int(self._refs[page])
 
+    # dfcheck: pairs acquire=alloc release=unref
     def alloc(self, n: int) -> List[int]:
         if n > len(self._free):
             raise RuntimeError(
@@ -183,6 +185,7 @@ class _PagePool:
             self._refs[p] = 1
         return pages
 
+    # dfcheck: pairs acquire=ref release=unref mode=state
     def ref(self, pages: List[int]) -> None:
         for p in pages:
             if self._refs[p] <= 0:
@@ -309,6 +312,11 @@ class InferenceServer:
         self._pp = pages_per_slot(config.max_seq, self.serving.page_size)
         self._n_pages = self.serving.pool_pages(config.max_seq)
         self._pool = _PagePool(self._n_pages) if self._paged else None
+        # pool-conservation witness (docs/ANALYSIS.md §6): with
+        # DISTRIFLOW_POOL_WITNESS=1 every quiescence point asserts
+        # free + referenced + shared == pool size; off, verify() is a no-op
+        self._pool_witness = (
+            PoolWitness(self._n_pages) if self._paged else None)
         self._tables = np.full((s, self._pp + 1), self._n_pages, np.int32)
         self._tables_dirty = False
         self._slot_pages: List[List[int]] = [[] for _ in range(s)]
@@ -403,6 +411,8 @@ class InferenceServer:
         # left to the 600 s backstop
         self._drain_and_error()
         self._tel.unregister_fleet(id(self))
+        # scheduler joined: pool state is quiescent and safe to audit here
+        self.verify_pool_conservation("stop")
 
     @property
     def address(self) -> str:
@@ -479,6 +489,7 @@ class InferenceServer:
             self.end_drain()
         return {"draining": self._draining}
 
+    # dfcheck: payload -> fleet_stats
     def _on_fleet_stats(self, client_id: str, payload: Any) -> Dict[str, Any]:
         """Routing signals for the fleet router, served as a direct ack
         on the same transport the heartbeat plane rides. Values are
@@ -511,6 +522,7 @@ class InferenceServer:
             "evicted_prefixes": evicted,
         }
 
+    # dfcheck: payload payload=generate_request -> generate_ack
     def _on_generate(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         """Generate front: drain refusal + request-id idempotency around
         :meth:`_generate_ack` (the actual decode).
@@ -562,6 +574,7 @@ class InferenceServer:
             if evt is not None:
                 evt.set()
 
+    # dfcheck: payload payload=generate_request -> generate_ack
     def _generate_ack(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         prompt = _prompt_from(payload, self._prompt_cap())
         n_tokens = int(payload["n_tokens"])
@@ -622,7 +635,7 @@ class InferenceServer:
             if item.result is None and item.error is not None:
                 raise item.error
             out = item.result
-            meta = {"path": "slots"}
+            meta = {"path": "slots"}  # dfcheck: payload serving_meta
             if item.admit_t is not None:
                 meta["queue_ms"] = round(
                     (item.admit_t - item.enq_t) * 1000.0, 3)
@@ -642,7 +655,7 @@ class InferenceServer:
                     eos_id=int(eos_id) if eos_id is not None else None,
                     rng=jax.random.PRNGKey(seed),
                 )
-            meta = {"path": "direct"}
+            meta = {"path": "direct"}  # dfcheck: payload serving_meta
         return {"result": pack_bytes({"tokens": serialize_array(out)}),
                 "serving": meta}
 
@@ -671,6 +684,9 @@ class InferenceServer:
         """Queue -> backlog. Returns True on the shutdown sentinel."""
         idle = not self._backlog and all(r is None for r in self._slot_req)
         if idle:
+            # quiescence: no backlog, no live slot, no uncommitted plan —
+            # every pool page must be free, slot-held, or prefix-shared
+            self.verify_pool_conservation("engine idle")
             item = self._queue.get()
             if item is None:
                 return True
@@ -746,6 +762,7 @@ class InferenceServer:
             self._evicted_prefixes.append(_h)
             shortfall -= self._pool.unref([pg])
 
+    # dfcheck: pairs acquire=_reserve release=_release_plan|_retire_slot counter=_m_pages_freed mode=state
     def _reserve(self, req: _Request) -> bool:
         """THE paged admission gate: plan every row's pages (prefix hits
         first, owned pages for the rest of the full horizon) and commit
@@ -1297,7 +1314,30 @@ class InferenceServer:
                 self._evicted_prefixes.append(_h)
                 freed += self._pool.unref([pg])
             self._note_occupancy()
+            self.verify_pool_conservation("release_prefix_cache")
         return freed
+
+    def verify_pool_conservation(self, context: str = "") -> None:
+        """Assert ``free + referenced + shared == pool size`` when the
+        pool witness is enabled (``DISTRIFLOW_POOL_WITNESS=1``), else a
+        no-op.  *referenced* = pages held by live slots (target or draft;
+        a page both slot-held and prefix-shared counts once, here);
+        *shared* = pages held only by the prefix map.  Only meaningful at
+        quiescence points where no uncommitted reservation is in flight —
+        the callers (idle scheduler tick, ``stop`` after the join, the
+        prefix flush) are exactly those points."""
+        if (self._pool is None or self._pool_witness is None
+                or not self._pool_witness.enabled):
+            return
+        held: set = set()
+        for pages in self._slot_pages:
+            held.update(pages)
+        for pages in self._draft_pages:
+            held.update(pages)
+        shared_only = set(self._prefix_map.values()) - held
+        self._pool_witness.verify(
+            self._pool.free_pages, len(held), len(shared_only),
+            context=context)
 
     def _abort_all(self, err: Exception) -> None:
         """Device failure mid-engine: error every waiter (active slots and
@@ -1329,6 +1369,7 @@ class InferenceServer:
 
     # -- direct-path handlers ----------------------------------------------
 
+    # dfcheck: payload payload=beam_request -> direct_ack
     def _on_beam(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         prompt = _prompt_from(payload, self._prompt_cap())
         n_tokens = int(payload["n_tokens"])
@@ -1351,6 +1392,7 @@ class InferenceServer:
             )
         }
 
+    # dfcheck: payload payload=score_request -> direct_ack
     def _on_score(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         tokens = _prompt_from(payload, self._prompt_cap())
         from_pos = int(payload.get("from_pos", 1))

@@ -484,3 +484,489 @@ def test_histogram_concurrent_writers_never_tear():
     assert torn == []
     assert h.count == writers * per_writer
     assert h.summary()["count"] == writers * per_writer
+
+
+# ---------------------------------------------------------------------------
+# wire-contract fixtures
+# ---------------------------------------------------------------------------
+
+
+WIRE_OK_SRC = '''
+def handle(payload):  # dfcheck: payload payload=generate_request
+    prompt = payload["prompt"]        # required: bare subscript is fine
+    temp = payload.get("temperature")  # optional via .get is fine
+    if "tier" in payload:
+        tier = payload["tier"]         # optional, membership-proven
+    return prompt, temp
+'''
+
+
+def test_wire_bound_payload_hit_is_silent(tmp_path):
+    assert _findings(tmp_path, WIRE_OK_SRC, ["wire"]) == []
+
+
+def test_wire_unknown_key_is_flagged(tmp_path):
+    src = WIRE_OK_SRC + '''
+
+def bad(payload):  # dfcheck: payload payload=generate_request
+    return payload["bogus_knob"]
+'''
+    found = _findings(tmp_path, src, ["wire"])
+    assert [f.check for f in found] == ["wire-unknown-key"]
+    assert "bogus_knob" in found[0].message
+    assert found[0].symbol == "bad"
+
+
+def test_wire_unguarded_optional_subscript_is_flagged(tmp_path):
+    src = '''
+def bad(payload):  # dfcheck: payload payload=generate_request
+    return payload["tier"]  # optional field, no guard, no .get
+'''
+    found = _findings(tmp_path, src, ["wire"])
+    assert [f.check for f in found] == ["wire-version"]
+    assert "tier" in found[0].message
+
+
+def test_wire_not_in_early_exit_proves_the_rest(tmp_path):
+    src = '''
+def ok(payload):  # dfcheck: payload payload=generate_request
+    if "tier" not in payload:
+        raise ValueError("tier required here")
+    return payload["tier"]
+'''
+    assert _findings(tmp_path, src, ["wire"]) == []
+
+
+def test_wire_to_wire_unknown_key_is_drift(tmp_path):
+    src = '''
+class UploadMsg:
+    def to_wire(self):
+        return {"client_id": self.client_id, "bogus_extra": 1}
+'''
+    found = _findings(tmp_path, src, ["wire"])
+    assert [f.check for f in found] == ["wire-schema-drift"]
+    assert "bogus_extra" in found[0].message
+
+
+def test_wire_to_wire_missing_required_is_drift(tmp_path):
+    src = '''
+class UploadMsg:
+    def to_wire(self):
+        return {"batch": self.batch}  # client_id (required) not emitted
+'''
+    found = _findings(tmp_path, src, ["wire"])
+    assert found and all(f.check == "wire-schema-drift" for f in found)
+    assert any("client_id" in f.message for f in found)
+
+
+def test_wire_message_attribute_and_ctor_checked(tmp_path):
+    src = '''
+def read(msg: "UploadMsg"):
+    ok = msg.client_id
+    chained = msg.gradients.version  # nested schema followed
+    return msg.bogus_attr
+'''
+    found = _findings(tmp_path, src, ["wire"])
+    assert [f.check for f in found] == ["wire-unknown-field"]
+    assert "bogus_attr" in found[0].message
+
+
+def test_wire_registry_version_lint(monkeypatch):
+    from distriflow_tpu.comm.schema import PAYLOADS, WireField, WirePayload
+    from distriflow_tpu.analysis.wire_check import _registry_findings
+
+    assert _registry_findings() == []  # the committed registry is clean
+    bad = WirePayload("dfcheck_fixture_fmt", 1, (
+        WireField("a", required=True),
+        WireField("late", since=2),                  # since > version
+        WireField("late_req", required=True, since=2),
+    ))
+    monkeypatch.setitem(PAYLOADS, "dfcheck_fixture_fmt", bad)
+    details = {f.detail for f in _registry_findings()}
+    assert "dfcheck_fixture_fmt.late:since-gt-version" in details
+    assert "dfcheck_fixture_fmt.late_req:since-gt-version" in details
+    assert "dfcheck_fixture_fmt.late_req:required-late-field" in details
+
+
+def test_check_payload_runtime_companion():
+    from distriflow_tpu.comm.schema import check_payload
+
+    check_payload("generate_request", {"prompt": b"x", "n_tokens": 4})
+    with pytest.raises(ValueError, match="unknown wire keys"):
+        check_payload("generate_request",
+                      {"prompt": b"x", "n_tokens": 4, "bogus": 1})
+    with pytest.raises(ValueError, match="missing required"):
+        check_payload("generate_request", {"prompt": b"x"})
+    with pytest.raises(KeyError):
+        check_payload("no_such_format", {})
+
+
+# ---------------------------------------------------------------------------
+# resource-lifecycle fixtures
+# ---------------------------------------------------------------------------
+
+
+RES_POOL_SRC = '''
+class Pool:
+    # dfcheck: pairs acquire=alloc release=free
+    def alloc(self, n):
+        return list(range(n))
+
+    def free(self, pages):
+        pass
+'''
+
+
+def test_resource_balanced_finally_is_silent(tmp_path):
+    src = RES_POOL_SRC + '''
+
+def use(pool, work):
+    pages = pool.alloc(2)
+    try:
+        work(pages)
+    finally:
+        pool.free(pages)
+'''
+    assert _findings(tmp_path, src, ["resource"]) == []
+
+
+def test_resource_bare_discard_is_a_leak(tmp_path):
+    src = RES_POOL_SRC + '''
+
+def bad(pool):
+    pool.alloc(2)
+'''
+    found = _findings(tmp_path, src, ["resource"])
+    assert [f.check for f in found] == ["resource-leak"]
+    assert found[0].detail.endswith(":discarded")
+
+
+def test_resource_never_released_is_a_leak(tmp_path):
+    src = RES_POOL_SRC + '''
+
+def bad(pool):
+    pages = pool.alloc(2)
+'''
+    found = _findings(tmp_path, src, ["resource"])
+    assert [f.check for f in found] == ["resource-leak"]
+    assert found[0].detail.endswith(":never-released")
+
+
+def test_resource_raise_between_acquire_and_release_leaks(tmp_path):
+    src = RES_POOL_SRC + '''
+
+def bad(pool, work):
+    pages = pool.alloc(2)
+    if not work:
+        raise ValueError("no work")
+    pool.free(pages)
+'''
+    found = _findings(tmp_path, src, ["resource"])
+    assert [f.check for f in found] == ["resource-leak"]
+    assert found[0].detail.endswith(":unprotected-exit")
+
+
+def test_resource_acquire_name_mismatch_is_flagged(tmp_path):
+    src = '''
+class Pool:
+    # dfcheck: pairs acquire=allocate release=free
+    def alloc(self, n):
+        return list(range(n))
+
+    def free(self, pages):
+        pass
+'''
+    found = _findings(tmp_path, src, ["resource"])
+    assert [f.check for f in found] == ["resource-pair"]
+    assert found[0].detail.endswith(":acquire-mismatch")
+
+
+def test_resource_missing_release_def_is_flagged(tmp_path):
+    src = '''
+class Pool:
+    # dfcheck: pairs acquire=alloc release=no_such_def
+    def alloc(self, n):
+        return list(range(n))
+'''
+    found = _findings(tmp_path, src, ["resource"])
+    assert [f.check for f in found] == ["resource-pair"]
+    assert found[0].detail.endswith(":release-missing")
+
+
+def test_resource_state_mode_dead_release_is_flagged(tmp_path):
+    src = '''
+class Leases:
+    # dfcheck: pairs acquire=grant release=revoke mode=state
+    def grant(self, k):
+        self.d[k] = 1
+
+    def revoke(self, k):
+        self.d.pop(k, None)
+'''
+    found = _findings(tmp_path, src, ["resource"])
+    assert [f.check for f in found] == ["resource-leak"]
+    assert found[0].detail.endswith(":release-dead")
+    # a single live call site satisfies the liveness proof
+    live = src + '''
+
+def drain(leases, k):
+    leases.revoke(k)
+'''
+    assert _findings(tmp_path, live, ["resource"]) == []
+
+
+def test_resource_counter_unpaired_on_release_path(tmp_path):
+    src = '''
+class Pool:
+    # dfcheck: pairs acquire=alloc release=free counter=_m_freed mode=state
+    def alloc(self, n):
+        return list(range(n))
+
+    def free(self, pages):
+        pass
+
+
+def drain(pool, pages):
+    pool.free(pages)
+'''
+    found = _findings(tmp_path, src, ["resource"])
+    assert [f.check for f in found] == ["counter-unpaired"]
+    assert found[0].detail.endswith(":_m_freed:unbumped")
+    bumped = src.replace("    def free(self, pages):\n        pass",
+                         "    def free(self, pages):\n"
+                         "        self._m_freed.inc(len(pages))")
+    assert bumped != src
+    assert _findings(tmp_path, bumped, ["resource"]) == []
+
+
+# ---------------------------------------------------------------------------
+# lock family v2: transitive propagation + holds-at-callsite inference
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_cycle_through_call_chain_is_flagged(tmp_path):
+    # v1 propagated callee acquisitions one level only, so the A->B edge
+    # hidden two calls deep (_b -> _c -> with B) was invisible
+    src = '''
+import threading
+
+
+class E:
+    def __init__(self):
+        self.la = threading.Lock()
+        self.lb = threading.Lock()
+
+    def one(self):
+        with self.la:
+            self._b()
+
+    def _b(self):
+        self._c()
+
+    def _c(self):
+        with self.lb:
+            pass
+
+    def two(self):
+        with self.lb:
+            with self.la:
+                pass
+'''
+    found = _findings(tmp_path, src, ["lock"])
+    cycles = [f for f in found if f.check == "lock-order"]
+    assert cycles, "transitive A->B plus direct B->A must be a cycle"
+
+
+def test_holds_inference_covers_always_locked_helper(tmp_path):
+    src = '''
+import threading
+
+
+class F:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self._incr()
+
+    def also(self):
+        with self._lock:
+            self._incr()
+
+    def _incr(self):
+        self.n += 1
+'''
+    # every callsite holds _lock, so the unannotated helper is inferred
+    assert _findings(tmp_path, src, ["lock"]) == []
+    # one unlocked callsite breaks the intersection: the helper is
+    # analyzed lock-free again and the guarded access is flagged
+    unlocked = src + '''
+    def sneaky(self):
+        self._incr()
+'''
+    found = _findings(tmp_path, unlocked, ["lock"])
+    assert any(f.check == "lock-discipline" and f.symbol == "F._incr"
+               for f in found)
+
+
+# ---------------------------------------------------------------------------
+# runtime pool-conservation witness
+# ---------------------------------------------------------------------------
+
+
+def test_pool_witness_balanced_is_silent():
+    from distriflow_tpu.analysis.witness import PoolWitness
+
+    w = PoolWitness(24, enabled=True)
+    w.verify(free=24, referenced=0, shared=0)
+    w.verify(free=10, referenced=9, shared=5, context="mid-session")
+    assert w.checks == 2 and w.trips == 0
+
+
+def test_pool_witness_leak_raises_and_names_direction():
+    from distriflow_tpu.analysis.witness import (
+        PoolConservationViolation,
+        PoolWitness,
+    )
+
+    w = PoolWitness(24, enabled=True)
+    with pytest.raises(PoolConservationViolation, match="leaked 2"):
+        w.verify(free=20, referenced=1, shared=1, context="t")
+    with pytest.raises(PoolConservationViolation, match="double-counted 1"):
+        w.verify(free=20, referenced=4, shared=1)
+    assert w.trips == 2 and w.checks == 2
+    # AssertionError subclass: a witness-enabled soak fails loudly
+    assert issubclass(PoolConservationViolation, AssertionError)
+
+
+def test_pool_witness_disabled_is_a_noop():
+    from distriflow_tpu.analysis.witness import PoolWitness
+
+    w = PoolWitness(24, enabled=False)
+    w.verify(free=0, referenced=0, shared=0)  # wildly off, but off
+    assert w.checks == 0 and w.trips == 0
+
+
+def test_pool_witness_env_gate(monkeypatch):
+    from distriflow_tpu.analysis.witness import (
+        POOL_ENV_VAR,
+        PoolWitness,
+        pool_witness_enabled,
+    )
+
+    monkeypatch.delenv(POOL_ENV_VAR, raising=False)
+    assert not pool_witness_enabled()
+    assert not PoolWitness(8).enabled
+    monkeypatch.setenv(POOL_ENV_VAR, "1")
+    assert pool_witness_enabled()
+    assert PoolWitness(8).enabled
+    monkeypatch.setenv(POOL_ENV_VAR, "0")
+    assert not pool_witness_enabled()
+
+
+# ---------------------------------------------------------------------------
+# registry <-> runtime encoder cross-checks
+# ---------------------------------------------------------------------------
+
+
+def test_report_schema_version_matches_runtime():
+    from distriflow_tpu.comm.schema import PAYLOADS
+    from distriflow_tpu.obs.collector import REPORT_VERSION
+
+    assert PAYLOADS["report"].version == REPORT_VERSION
+
+
+def test_dftp_leaf_schema_version_matches_runtime():
+    from distriflow_tpu.comm.schema import PAYLOADS
+    from distriflow_tpu.utils import serialization
+
+    leaf = PAYLOADS["dftp_leaf"]
+    assert leaf.version == serialization._VERSION_SPARSE
+    v1_names = {f.name for f in leaf.fields if f.since == 1}
+    v2_names = {f.name for f in leaf.fields if f.since == 2}
+    assert serialization._VERSION == 1
+    # the sparse-variant fields are exactly the v2 additions
+    assert v2_names == {"encoding", "index_dtype", "indices_offset",
+                        "indices_nbytes"}
+    assert {"name", "dtype", "shape", "byte_offset", "nbytes"} <= v1_names
+
+
+def test_report_builder_output_satisfies_schema():
+    from distriflow_tpu.comm.schema import check_payload
+    from distriflow_tpu.obs import Telemetry
+    from distriflow_tpu.obs.collector import ReportBuilder
+
+    tel = Telemetry()
+    tel.counter("client_uploads_total").inc()
+    report = ReportBuilder(tel, "c1").build()
+    check_payload("report", report)  # raises on any drift
+
+
+def test_flat_serialize_leaves_satisfy_schema():
+    import numpy as np
+
+    from distriflow_tpu.comm.schema import PAYLOADS, check_payload
+    from distriflow_tpu.utils.serialization import (
+        flat_serialize,
+        serialize_tree,
+    )
+
+    _, meta = flat_serialize(
+        serialize_tree({"w": np.arange(6, dtype=np.float32)}))
+    required = set(PAYLOADS["dftp_leaf"].required_names)
+    for leaf in meta["leaves"]:
+        check_payload("dftp_leaf", leaf)
+        assert required <= set(leaf)
+
+
+# ---------------------------------------------------------------------------
+# CLI family selectors + the extended default set
+# ---------------------------------------------------------------------------
+
+
+def test_all_families_includes_wire_and_resource():
+    from distriflow_tpu.analysis import ALL_FAMILIES
+
+    assert set(ALL_FAMILIES) == {"lock", "tracing", "obs", "wire",
+                                 "resource"}
+
+
+def test_cli_check_wire_selector(tmp_path):
+    (tmp_path / "fixture.py").write_text('''
+def bad(payload):  # dfcheck: payload payload=generate_request
+    return payload["bogus_knob"]
+''')
+    proc = subprocess.run(
+        [sys.executable, "-m", "distriflow_tpu.analysis", "--json",
+         "--no-baseline", "--check", "wire", str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert [f["check"] for f in payload["findings"]] == ["wire-unknown-key"]
+
+
+def test_cli_check_resource_selector(tmp_path):
+    (tmp_path / "fixture.py").write_text(RES_POOL_SRC + '''
+
+def bad(pool):
+    pool.alloc(2)
+''')
+    proc = subprocess.run(
+        [sys.executable, "-m", "distriflow_tpu.analysis", "--json",
+         "--no-baseline", "--check", "resource", str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert [f["check"] for f in payload["findings"]] == ["resource-leak"]
+    # the selector really restricts: the same fixture under --check lock
+    # is silent
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "distriflow_tpu.analysis", "--json",
+         "--no-baseline", "--check", "lock", str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr

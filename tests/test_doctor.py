@@ -21,5 +21,7 @@ def test_doctor_passes_on_cpu():
     for name in ("backend/devices", "mesh construction", "allreduce",
                  "train step", "wire transport", "chaos self-test",
                  "telemetry reconciliation", "kill-and-resume recovery drill",
-                 "straggler drill", "sparse-wire drill", "checkpoint store"):
+                 "straggler drill", "sparse-wire drill",
+                 "lock-order witness drill",
+                 "pool-conservation witness drill", "checkpoint store"):
         assert f"ok   {name}" in out.stdout, (name, out.stdout)
