@@ -1539,6 +1539,146 @@ def bench_serving_fleet(ctx=1024, n_tokens=64, n_groups=6, warm_waves=2):
     }
 
 
+def bench_serving_slo(ctx=512, n_tokens=32, n_users=6, warm_waves=2):
+    """Round-15 row (docs/OBSERVABILITY.md §11): mixed-tier serving SLOs
+    over TWO replicas behind the fleet router, plus the cost of the
+    request-trace plane itself.
+
+    Traffic is ``n_users`` concurrent users pinned to tiers 0/1/2 (two
+    each), one request per wave. The traced leg shares ONE Telemetry
+    across clients, router, and both replicas, so every request leaves a
+    full client-root -> route -> replica-engine span set; per-tier
+    TTFT/TPOT p50/p99 come from assembling those spans — the SAME
+    numbers ``dump --requests`` prints from the router's run dir. The
+    untraced leg replays identical traffic with telemetry disabled;
+    ``trace_overhead_ms`` is the per-wave wall delta, absolute-guarded
+    in the ledger like the obs_overhead row. Headline ``value`` is fleet
+    goodput (answered / accepted) on the traced leg."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distriflow_tpu.client import InferenceClient
+    from distriflow_tpu.fleet import FleetRouter
+    from distriflow_tpu.models.transformer import (
+        TransformerConfig,
+        transformer_lm,
+    )
+    from distriflow_tpu.obs.telemetry import Telemetry
+    from distriflow_tpu.obs.trace_assembler import assemble
+    from distriflow_tpu.server import InferenceServer
+    from distriflow_tpu.utils.config import ServingConfig
+
+    if SLOW or FAST or time_left() < 150:
+        ctx = ctx // 4
+
+    rng = np.random.RandomState(0)
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=256, n_heads=4, n_layers=4, d_ff=1024,
+        max_seq=ctx + n_tokens, dtype=jnp.bfloat16)
+    params = transformer_lm(cfg, example_seq=128).init(jax.random.PRNGKey(0))
+    prompts = [rng.randint(0, 32000, (1, ctx)).astype(np.int32)
+               for _ in range(n_users)]
+    tiers = [i % 3 for i in range(n_users)]
+
+    def run_leg(traced):
+        tel = Telemetry(enabled=traced)
+        replicas = [InferenceServer(
+            cfg, params, port=0, telemetry=tel,
+            serving=ServingConfig(max_slots=n_users, decode_chunk=8,
+                                  batch_window_s=0.05))
+            for _ in range(2)]
+        for server in replicas:
+            server.transport.heartbeat_timeout = 0  # see _serving_client
+            server.setup()
+        router = FleetRouter(port=0, policy="least_loaded", telemetry=tel)
+        for i, server in enumerate(replicas):
+            router.add_replica(server.address, name=f"replica-{i}")
+        router.setup()
+        try:
+            clients = []
+            for _ in range(n_users):
+                c = InferenceClient(router.address, timeout=600.0,
+                                    telemetry=tel)
+                c.transport.heartbeat_timeout = 0
+                clients.append(c.setup())
+            try:
+                def one_wave():
+                    barrier = threading.Barrier(n_users)
+
+                    def call(i):
+                        barrier.wait()
+                        clients[i].generate(prompts[i], n_tokens=n_tokens,
+                                            tier=tiers[i])
+
+                    threads = [threading.Thread(target=call, args=(i,))
+                               for i in range(n_users)]
+                    start = time.perf_counter()
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    return time.perf_counter() - start
+
+                one_wave()  # cold: compiles + first prefills serialize it
+                # SLO quantiles cover WARM waves only — the cold wave's
+                # TTFT is XLA compile seconds, not a serving surface
+                cold = {r["trace_id"] for r in tel.tracer.finished()}
+                wall = sum(one_wave() for _ in range(warm_waves))
+            finally:
+                for c in clients:
+                    c.close()
+            wave_ms = wall / warm_waves * 1e3
+            if not traced:
+                return wave_ms, None, None
+            accepted = sum(
+                tel.counter_value("router_requests_total", tier=str(t))
+                for t in (0, 1, 2))
+            answered = sum(
+                tel.counter_value("router_goodput_total", tier=str(t))
+                for t in (0, 1, 2))
+            goodput = answered / accepted if accepted else 0.0
+            warm_rows = [r for r in tel.tracer.finished()
+                         if r["trace_id"] not in cold]
+            agg = assemble(warm_rows).request_attribution()
+            return wave_ms, goodput, agg
+        finally:
+            router.stop()
+            for server in replicas:
+                server.stop()
+
+    trace_on_ms, goodput, agg = run_leg(True)
+    trace_off_ms, _, _ = run_leg(False)
+    overhead_ms = trace_on_ms - trace_off_ms
+    log(f"serving_slo: goodput {goodput:.3f} over {agg['requests']} "
+        f"requests ({agg['committed']} committed, {agg['orphans']} "
+        f"orphans), wave {trace_on_ms:.1f}ms traced vs "
+        f"{trace_off_ms:.1f}ms untraced ({overhead_ms:+.1f}ms)")
+    row = {
+        "config": "serving_slo",
+        "metric": "fleet goodput (answered/accepted, traced leg)",
+        "value": round(goodput, 3),
+        "requests": agg["requests"],
+        "shed": sum(t["shed"] for t in agg["tiers"].values()),
+        "failovers": sum(t["failovers"] for t in agg["tiers"].values()),
+        "trace_on_ms": round(trace_on_ms, 2),
+        "trace_off_ms": round(trace_off_ms, 2),
+        "trace_overhead_ms": round(overhead_ms, 2),
+        "traffic": (f"{n_users} users over tiers 0/1/2 x "
+                    f"{warm_waves} warm waves, ctx {ctx} +{n_tokens} tok, "
+                    f"2 replicas"),
+    }
+    for t, stats in agg["tiers"].items():
+        for k in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                  "tpot_p99_ms"):
+            v = stats.get(k)
+            if v is not None:
+                row[f"{k}_tier{t}"] = v
+    return row
+
+
 # -- long context: 16k/32k chunked prefill + decode latency ----------------
 
 
@@ -2154,6 +2294,7 @@ def main() -> None:
         run(bench_serving_paged_mixed)
         run(bench_serving_speculative)
         run(bench_serving_fleet)
+        run(bench_serving_slo)
         run(bench_decode, n_chips)
         run(bench_long_context)
     run(bench_mnist_sync, n_chips)

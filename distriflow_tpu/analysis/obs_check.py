@@ -21,6 +21,15 @@ Three contracts between code and the obs plane:
   (server-side re-aggregation of client reports) and must never be shipped
   by a client: registering one outside ``obs/collector.py`` would loop
   fleet sums back into the fleet, double-counting every report cycle.
+* **phase-undocumented / phase-unknown** — every span/phase name emitted in
+  code (literal first argument of ``tracer.span()`` / ``prof.phase()`` /
+  ``tracer.emit()``, plus the name argument of the ``_phase``/``_req_span``
+  emission helpers) must appear in a docs/OBSERVABILITY.md *taxonomy table*
+  (any table whose header has a ``phase`` or ``span`` column); conversely
+  every name a taxonomy table documents must still be emitted somewhere in
+  code. The assembler's sweep and ``dump`` renderings key on these names,
+  so an undocumented phase is invisible to operators and a stale doc row
+  describes attribution that no longer happens.
 """
 
 from __future__ import annotations
@@ -218,6 +227,120 @@ def _check_metrics(modules: List[SourceModule], findings: List[Finding]) -> None
 
 
 # ---------------------------------------------------------------------------
+# phase taxonomy (code span/phase names <-> doc taxonomy tables)
+# ---------------------------------------------------------------------------
+
+#: emission helpers whose name argument is positional, not the receiver's
+#: attr: ``AsyncSGD._phase(name, t0, ...)`` and
+#: ``InferenceServer._req_span(req, name, ...)``
+_PHASE_HELPERS = {"_phase": 0, "_req_span": 1}
+#: receiver substrings that mark a call as span/phase emission per attr
+_PHASE_RECEIVERS = {
+    "span": ("tracer", "telemetry"),
+    "phase": ("prof", "profiler"),
+    "emit": ("tracer",),
+}
+
+
+def collect_code_phases(
+    modules: List[SourceModule],
+) -> List[Tuple[SourceModule, ast.Call, str]]:
+    """(module, call, name) for every statically-resolvable span/phase
+    emission site — the code side of the §5/§11 taxonomy contract."""
+    out = []
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            attr = node.func.attr
+            idx = None
+            if attr in _PHASE_RECEIVERS:
+                recv = ast.unparse(node.func.value).lower()
+                if any(tok in recv for tok in _PHASE_RECEIVERS[attr]):
+                    idx = 0
+            elif attr in _PHASE_HELPERS:
+                idx = _PHASE_HELPERS[attr]
+            if idx is None or len(node.args) <= idx:
+                continue
+            name = _literal_str(node.args[idx])
+            if name is not None:
+                out.append((mod, node, name))
+    return out
+
+
+def collect_doc_phases(doc_path: Path = _DOC_PATH) -> Set[str]:
+    """Every backticked name in the phase/span column of any
+    docs/OBSERVABILITY.md table whose header declares one — a cell may
+    carry several (```stage`/`snapshot`/...``); all count."""
+    names: Set[str] = set()
+    if not doc_path.exists():
+        return names
+    phase_col: Optional[int] = None
+    for line in doc_path.read_text().splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            phase_col = None
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        lowered = [c.lower() for c in cells]
+        if "phase" in lowered or "span" in lowered:
+            phase_col = (lowered.index("phase") if "phase" in lowered
+                         else lowered.index("span"))
+            continue
+        if phase_col is None or phase_col >= len(cells):
+            continue
+        cell = cells[phase_col]
+        if set(cell) <= {"-", ":", " "}:
+            continue  # the |---|---| separator row
+        for m in _BACKTICK_RE.finditer(cell):
+            names.add(_base_ident(m.group(1)))
+    return names
+
+
+def _check_phases(modules: List[SourceModule], findings: List[Finding]) -> None:
+    doc_names = collect_doc_phases()
+    code_names: Set[str] = set()
+    for mod, call, name in collect_code_phases(modules):
+        if mod.relpath.startswith("tests/") or "/fixtures/" in mod.relpath:
+            continue
+        code_names.add(name)
+        if name not in doc_names:
+            if not mod.ignored(call.lineno, "phase-undocumented"):
+                findings.append(
+                    Finding(
+                        check="phase-undocumented",
+                        path=mod.relpath,
+                        line=call.lineno,
+                        symbol="<phases>",
+                        message=(
+                            f"span/phase {name!r} is emitted here but absent "
+                            "from every docs/OBSERVABILITY.md taxonomy table"
+                        ),
+                        detail=name,
+                    )
+                )
+    # doc -> code needs the whole package, same as metric-unknown
+    if not any(m.relpath == "distriflow_tpu/__init__.py" for m in modules):
+        return
+    for name in sorted(doc_names - code_names):
+        findings.append(
+            Finding(
+                check="phase-unknown",
+                path="docs/OBSERVABILITY.md",
+                line=0,
+                symbol="<phases>",
+                message=(
+                    f"taxonomy table documents phase {name!r} but no literal "
+                    "emission site exists in code"
+                ),
+                detail=name,
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
 # span balance
 # ---------------------------------------------------------------------------
 
@@ -338,5 +461,6 @@ def _check_spans(modules: List[SourceModule], findings: List[Finding]) -> None:
 def check_obs(modules: List[SourceModule]) -> List[Finding]:
     findings: List[Finding] = []
     _check_metrics(modules, findings)
+    _check_phases(modules, findings)
     _check_spans(modules, findings)
     return findings

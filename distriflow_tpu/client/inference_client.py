@@ -136,15 +136,24 @@ class InferenceClient:
             payload["tier"] = int(tier)
         if request_id is not None:
             payload["request_id"] = str(request_id)
-        ack = self._request("generate", payload)  # dfcheck: payload generate_ack
-        self.last_serving_meta = ack.get("serving")
-        if "result" not in ack:
-            if ack.get("shed"):
-                raise RequestShed(int(ack.get("tier", -1)),
-                                  int(ack.get("queue_depth", -1)))
-            raise RequestRefused(str(ack.get("refused", ack)))
-        result = unpack_bytes(ack["result"])
-        return deserialize_array(result["tokens"])
+        # the client originates the request trace: a root ``request`` span
+        # whose ids ride the wire (docs/OBSERVABILITY.md §11); NOOP_SPAN ids
+        # are empty strings, so disabled telemetry never stamps headers
+        with self.telemetry.tracer.span(
+                "request", op="generate",
+                tier=int(tier) if tier is not None else 0) as sp:
+            if sp.trace_id:
+                payload["trace_id"] = sp.trace_id
+                payload["span_id"] = sp.span_id
+            ack = self._request("generate", payload)  # dfcheck: payload generate_ack
+            self.last_serving_meta = ack.get("serving")
+            if "result" not in ack:
+                if ack.get("shed"):
+                    raise RequestShed(int(ack.get("tier", -1)),
+                                      int(ack.get("queue_depth", -1)))
+                raise RequestRefused(str(ack.get("refused", ack)))
+            result = unpack_bytes(ack["result"])
+            return deserialize_array(result["tokens"])
 
     def beam_search(
         self,
@@ -161,7 +170,11 @@ class InferenceClient:
             n_tokens=int(n_tokens), beam_size=int(beam_size),
             length_penalty=float(length_penalty), eos_id=eos_id,
         )
-        result = unpack_bytes(self._request("beam", payload)["result"])
+        with self.telemetry.tracer.span("request", op="beam") as sp:
+            if sp.trace_id:
+                payload["trace_id"] = sp.trace_id
+                payload["span_id"] = sp.span_id
+            result = unpack_bytes(self._request("beam", payload)["result"])
         return deserialize_array(result["tokens"]), deserialize_array(result["scores"])
 
     def score(self, tokens: np.ndarray, from_pos: int = 1) -> np.ndarray:
@@ -169,7 +182,11 @@ class InferenceClient:
         forced ``log P(tokens[:, from_pos:] | prefix)`` per row."""
         payload = self._prompt_payload(tokens)  # dfcheck: payload score_request
         payload["from_pos"] = int(from_pos)
-        result = unpack_bytes(self._request("score", payload)["result"])
+        with self.telemetry.tracer.span("request", op="score") as sp:
+            if sp.trace_id:
+                payload["trace_id"] = sp.trace_id
+                payload["span_id"] = sp.span_id
+            result = unpack_bytes(self._request("score", payload)["result"])
         return deserialize_array(result["scores"])
 
     # -- internals ---------------------------------------------------------

@@ -231,6 +231,8 @@ PAYLOADS["generate_request"] = _payload(
     WireField("seed"),
     WireField("tier"),
     WireField("request_id"),
+    WireField("trace_id"),
+    WireField("span_id"),
 )
 
 #: generate ack — exactly one of {result, refused, shed} shapes; every key
@@ -243,6 +245,7 @@ PAYLOADS["generate_ack"] = _payload(
     WireField("shed"),
     WireField("tier"),
     WireField("queue_depth"),
+    WireField("trace_id"),
 )
 
 #: scheduling metadata riding a successful generate ack
@@ -251,6 +254,11 @@ PAYLOADS["serving_meta"] = _payload(
     WireField("path", required=True),
     WireField("queue_ms"),
     WireField("prefix_tokens"),
+    WireField("ttft_ms"),
+    WireField("tpot_ms"),
+    # injected by the fleet router on the way back to the caller
+    # ({replica, affinity_depth, failovers, tier}); absent on direct acks
+    WireField("router"),
 )
 
 #: beam-search request payload
@@ -261,6 +269,8 @@ PAYLOADS["beam_request"] = _payload(
     WireField("beam_size"),
     WireField("length_penalty"),
     WireField("eos_id"),
+    WireField("trace_id"),
+    WireField("span_id"),
 )
 
 #: sequence-scoring request payload
@@ -268,12 +278,15 @@ PAYLOADS["score_request"] = _payload(
     "score_request", 1,
     WireField("prompt", required=True),
     WireField("from_pos"),
+    WireField("trace_id"),
+    WireField("span_id"),
 )
 
 #: direct-path ack for beam/score: always a packed result
 PAYLOADS["direct_ack"] = _payload(
     "direct_ack", 1,
     WireField("result", required=True),
+    WireField("trace_id"),
 )
 
 #: dftp-flat per-leaf metadata — version 1 is dense-only; version 2 adds the

@@ -41,20 +41,28 @@ silent socket.io hang). Checks, in order:
 12. health-sentinel drill: a scripted 0.4 s ack delay must trip the
     ack-latency SLO band exactly once (edge-triggered) and dump exactly
     one flight bundle; a clean run must trip nothing;
-13. critical-path drill: assembled round traces must attribute a clean
+13. request-trace drill: a clean two-replica routed serving run must
+    assemble every request into exactly one APPLIED round with zero
+    orphan spans — and ``dump --requests`` must agree from the run dir
+    alone — while the tier-0 TTFT band stays silent; a scripted 0.4 s
+    prefill delay on one tier-0 request must then trip
+    ``ttft_p99_tier0`` exactly once (edge-triggered) with the flight
+    bundle's ``ttft_high`` watermark naming the offending request
+    (see ``docs/OBSERVABILITY.md`` §11);
+14. critical-path drill: assembled round traces must attribute a clean
     run to its dominant compute phase, attribute a PIPELINED clean run
     (``inflight_window=2``) to ``fit`` with the upload tail hidden on
     the comm thread, and shift ``bound_by`` to ``submit`` under a
     scripted 0.3 s upload delay (and only then); the bench ledger must
     flag a synthetically slowed row as ``regress`` on exactly one
     metric (see ``docs/OBSERVABILITY.md`` §9);
-14. lock-order witness drill: a scripted A->B / B->A inversion on
+15. lock-order witness drill: a scripted A->B / B->A inversion on
     witnessed locks (``analysis/witness.py``) must raise
     ``LockOrderViolation`` exactly once, a clean same-order run must
     raise nothing, and the disabled factory must hand back a plain
     ``threading.Lock`` (the zero-cost-off contract);
-15. native C++ host library presence (optional — numpy fallback is fine);
-16. checkpoint write/read round trip in a temp dir.
+16. native C++ host library presence (optional — numpy fallback is fine);
+17. checkpoint write/read round trip in a temp dir.
 
 Exit code 0 when every mandatory check passes; each check prints
 ``ok``/``FAIL`` with a one-line detail, so CI and humans read the same
@@ -1055,6 +1063,147 @@ def main() -> int:
                 "1 flight bundle, edge-triggered)")
 
     ok &= _check("health-sentinel drill (SLO breach + flight dump)", sentinel)
+
+    def request_trace():
+        """Request-trace drill (docs/OBSERVABILITY.md §11), both ways:
+        a clean two-replica routed serving run must assemble every
+        request into exactly one APPLIED round with zero orphan spans —
+        and ``dump --requests`` must say so from the run dir alone —
+        while the tier-0 TTFT band stays silent; then a scripted 0.4 s
+        prefill delay on one tier-0 request must trip
+        ``ttft_p99_tier0`` exactly once (edge-triggered) with the
+        flight bundle's ``ttft_high`` watermark naming the offending
+        request. Warm-up requests ride tier 1 so cold-compile seconds
+        land outside the tier-0 histogram the band watches."""
+        import os
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from distriflow_tpu.client import InferenceClient
+        from distriflow_tpu.fleet import FleetRouter, RouterClient
+        from distriflow_tpu.models.transformer import (
+            TransformerConfig,
+            transformer_lm,
+        )
+        from distriflow_tpu.obs import Telemetry
+        from distriflow_tpu.obs.dump import summarize_requests
+        from distriflow_tpu.obs.flight_recorder import read_bundles
+        from distriflow_tpu.obs.health import HealthSentinel, default_bands
+        from distriflow_tpu.obs.trace_assembler import assemble
+        from distriflow_tpu.server import InferenceServer
+        from distriflow_tpu.utils.config import ServingConfig
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=48, dtype=jnp.float32, use_flash_attention=False)
+        params = transformer_lm(cfg, example_seq=16).init(
+            jax.random.PRNGKey(0))
+        rng = np.random.default_rng(29)
+        prompt = rng.integers(1, 64, size=(1, 9)).astype(np.int32)
+        N_CLEAN = 4
+
+        with tempfile.TemporaryDirectory() as run_dir:
+            dump_dir = os.path.join(run_dir, "slo")
+            tel = Telemetry(save_dir=run_dir)
+
+            def replica():
+                return InferenceServer(
+                    cfg, params, port=0, telemetry=tel,
+                    serving=ServingConfig(batch_window_s=0.05,
+                                          decode_chunk=4,
+                                          max_slots=2)).setup()
+
+            sa, sb = replica(), replica()
+            router = FleetRouter(port=0, policy="least_loaded",
+                                 stats_interval_s=0.0, redial=False,
+                                 telemetry=tel)
+            router.add_replica(sa.address, name="A")
+            router.add_replica(sb.address, name="B")
+            router.setup()
+            try:
+                # warm BOTH replicas directly on tier 1: each server owns
+                # its jit cache, so every cold compile must happen before
+                # the tier-0 clean phase the band is measured against
+                for srv in (sa, sb):
+                    with InferenceClient(srv.address, telemetry=tel) as w:
+                        w.generate(prompt, 4, tier=1)
+                with RouterClient(router.address, telemetry=tel) as c:
+                    for _ in range(N_CLEAN):
+                        c.generate(prompt, 4, tier=0)
+                    asm = assemble(tel.tracer.finished())
+                    reqs = asm.requests()
+                    assert asm.orphans == [], (
+                        f"{len(asm.orphans)} orphan span(s) in a clean run")
+                    assert len(reqs) == N_CLEAN + 2, (
+                        f"{len(reqs)} rounds for {N_CLEAN + 2} requests")
+                    assert all(r.applied for r in reqs), (
+                        "unapplied round in a clean run")
+                    routed = [r for r in reqs if r.apply_spans]
+                    assert len(routed) == N_CLEAN and all(
+                        r.apply_spans == 1 for r in routed), (
+                        "routed requests not exactly-once committed")
+                    body = "\n".join(summarize_requests(run_dir))
+                    assert f"{N_CLEAN + 2} assembled" in body, body
+                    assert "0 orphan span(s)" in body, body
+                    clean_p99 = float(tel.registry.find(
+                        "serving_ttft_ms", tier="0").summary()["p99"])
+                    ceiling = clean_p99 + 200.0
+                    watch = HealthSentinel(
+                        tel, bands=default_bands(ttft_p99_ms={0: ceiling}),
+                        dump_dir=dump_dir)
+                    entered = watch.check()
+                    assert not entered, f"clean run breached: {entered}"
+                    assert not read_bundles(dump_dir), (
+                        "clean run wrote a flight bundle")
+
+                    # scripted fault: 0.4 s admission->prefill delay on
+                    # whichever replica admits the next tier-0 request
+                    def slowed(orig):
+                        def admit(plen, shared_len, members):
+                            time.sleep(0.4)
+                            return orig(plen, shared_len, members)
+                        return admit
+
+                    for srv in (sa, sb):
+                        srv._admit_group = slowed(srv._admit_group)
+                    c.generate(prompt, 4, tier=0, request_id="doctor-slow")
+                entered = watch.check()
+                assert [e["band"] for e in entered] == ["ttft_p99_tier0"], (
+                    f"expected exactly ttft_p99_tier0 to trip: {entered}")
+                observed = entered[0]["observed"]
+                watch.check()  # edge trigger: still breached, no re-fire
+                count = tel.counter_value(
+                    "obs_slo_breach_total", band="ttft_p99_tier0")
+                assert count == 1, (
+                    f"obs_slo_breach_total{{band=ttft_p99_tier0}} = "
+                    f"{count:g}, expected exactly 1 (edge trigger)")
+                bundles = read_bundles(dump_dir)
+                assert len(bundles) == 1, (
+                    f"expected exactly 1 flight bundle, got {len(bundles)}")
+                assert bundles[0]["trigger"] == "slo_ttft_p99_tier0"
+                highs = [e for e in bundles[0]["events"]
+                         if e.get("kind") == "ttft_high"]
+                assert highs and highs[-1].get(
+                    "request_id") == "doctor-slow", (
+                    f"bundle does not name the slow request: {highs}")
+                slow = [r for r in assemble(tel.tracer.finished()).requests()
+                        if r.attrs.get("request_id") == "doctor-slow"]
+                assert len(slow) == 1 and slow[0].applied, (
+                    "slow request did not assemble into one applied round")
+            finally:
+                router.stop()
+                sa.stop()
+                sb.stop()
+        return (f"clean: {N_CLEAN + 2} requests -> {N_CLEAN + 2} applied "
+                f"rounds, 0 orphans, tier-0 TTFT band silent "
+                f"(p99 {clean_p99:.0f} ms); 0.4 s scripted prefill delay: "
+                f"ttft p99 {observed:.0f} ms > {ceiling:.0f} ms tripped "
+                "ttft_p99_tier0 exactly once, bundle names doctor-slow")
+
+    ok &= _check("request-trace drill (lifecycle assembly + tier SLO)",
+                 request_trace)
 
     def critical_path():
         """Critical-path drill (docs/OBSERVABILITY.md §9), three ways: a

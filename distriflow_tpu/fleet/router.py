@@ -36,7 +36,13 @@ Three routing planes compose per request:
 
 Metrics (docs/OBSERVABILITY.md §1): ``router_requests_total{tier}``,
 ``router_affinity_hits_total``, ``router_shed_total{tier}``,
-``router_failovers_total``, ``router_replicas_live``.
+``router_failovers_total``, ``router_replicas_live``,
+``router_goodput_total{tier}``, ``router_hedge_candidates_total``.
+Tracing (docs/OBSERVABILITY.md §11): when the inbound payload carries a
+``trace_id`` header the router emits one ``route`` span per forwarding
+attempt (replica, policy, affinity depth, shed/failover verdict), so
+the request assembler can reconstruct the failover chain from the
+router's run dir alone.
 """
 
 from __future__ import annotations
@@ -129,6 +135,19 @@ class FleetRouter:
         self._m_affinity = tel.counter("router_affinity_hits_total")
         self._m_failovers = tel.counter("router_failovers_total")
         self._m_live = tel.gauge("router_replicas_live")
+        # goodput = generate requests answered with a result (sheds,
+        # drain refusals, and handler errors all miss); hedge candidates
+        # = answered requests that needed >=1 failover, i.e. where a
+        # hedged duplicate fired at first-submit time would have beaten
+        # the failover round trip
+        self._m_goodput = {t: tel.counter("router_goodput_total",
+                                          tier=str(t)) for t in (0, 1, 2)}
+        self._m_hedge = tel.counter("router_hedge_candidates_total")
+        # the router is a fleet citizen too: its own row (plus one row
+        # per replica from the registry view routing actually used)
+        # merges into ``tel.snapshot()["fleet"]`` so ``dump --fleet`` on
+        # the router's run dir shows the front door next to the replicas
+        tel.register_fleet(id(self), self._fleet_rows)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -177,6 +196,7 @@ class FleetRouter:
         return self
 
     def stop(self) -> None:
+        self._tel.unregister_fleet(id(self))
         self._stopped.set()
         if self._poller is not None:
             self._poller.join(timeout=5.0)
@@ -312,6 +332,9 @@ class FleetRouter:
 
     def _on_generate(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         tier = min(max(int(payload.get("tier", 1)), 0), 2)
+        # the clamped tier rides to the replica so its per-tier SLO
+        # labels (serving_ttft_ms{tier=...}) agree with the router's
+        payload["tier"] = tier
         if payload.get("request_id") is None:
             # the idempotency key failover replays ride on; client-supplied
             # ids pass through untouched (end-to-end retries dedup too)
@@ -319,6 +342,7 @@ class FleetRouter:
         depth = self._should_shed(tier)
         if depth is not None:
             self._m_shed[tier].inc()
+            self._route_span(payload, "shed", queue_depth=depth)
             return {"shed": True, "tier": tier, "queue_depth": depth}
         hashes = self._prompt_hashes(payload)
         n_tokens = int(payload.get("n_tokens", 0))
@@ -329,6 +353,8 @@ class FleetRouter:
         self._m_requests[tier].inc()
         if aff_depth > 0:
             self._m_affinity.inc()
+        if failovers > 0:
+            self._m_hedge.inc()
         serving = ack.get("serving")
         if isinstance(serving, dict):
             if serving.get("path") == "slots" and state.prefix_capable:
@@ -336,6 +362,8 @@ class FleetRouter:
             serving["router"] = {"replica": state.name,
                                  "affinity_depth": aff_depth,
                                  "failovers": failovers, "tier": tier}
+        if "result" in ack:
+            self._m_goodput[tier].inc()
         return ack
 
     def _prompt_hashes(self, payload: Dict[str, Any]) -> List[bytes]:
@@ -377,12 +405,14 @@ class FleetRouter:
                     # this call, or replicas already registered as draining):
                     # pass the structured refusal through so the client sees
                     # RequestRefused (retryable), not an opaque handler error
+                    self._route_span(payload, "drain", failovers=failovers)
                     return {"refused": "draining"}, None, 0, failovers
                 raise RuntimeError(
                     f"no live replica for {event!r} "
                     f"({len(tried)} tried, {failovers} failovers)")
             state, depth = pick
             self.registry.note_submit(state.name)
+            a_start, a_mono = time.time(), time.monotonic()
             try:
                 ack = state.conn.request(event, payload,
                                          timeout=self.request_timeout)
@@ -393,6 +423,9 @@ class FleetRouter:
                 tried.add(state.name)
                 failovers += 1
                 self._m_failovers.inc()
+                self._route_span(payload, f"failover:{type(e).__name__}",
+                                 replica=state.name, depth=depth,
+                                 start=a_start, mono=a_mono)
                 continue
             finally:
                 self.registry.note_done(state.name)
@@ -403,6 +436,9 @@ class FleetRouter:
                 tried.add(state.name)
                 failovers += 1
                 self._m_failovers.inc()
+                self._route_span(payload, "failover:handler_error",
+                                 replica=state.name, depth=depth,
+                                 start=a_start, mono=a_mono)
                 continue
             if isinstance(ack, dict) and ack.get("refused") == "draining":
                 self.registry.mark_draining(state.name, True)
@@ -410,5 +446,61 @@ class FleetRouter:
                 drains += 1
                 failovers += 1
                 self._m_failovers.inc()
+                self._route_span(payload, "failover:draining",
+                                 replica=state.name, depth=depth,
+                                 start=a_start, mono=a_mono)
                 continue
+            extra: Dict[str, Any] = {"failovers": failovers}
+            meta = ack.get("serving") if isinstance(ack, dict) else None
+            if isinstance(meta, dict):
+                # echo the replica-measured SLO latencies onto the route
+                # span: dump --requests then attributes per-tier TTFT/
+                # TPOT from the ROUTER's run dir alone (§11)
+                for k in ("ttft_ms", "tpot_ms"):
+                    if meta.get(k) is not None:
+                        extra[k] = meta[k]
+            self._route_span(payload, "forwarded", replica=state.name,
+                             depth=depth, start=a_start, mono=a_mono,
+                             **extra)
             return ack, state, depth, failovers
+
+    def _route_span(self, payload: Dict[str, Any], verdict: str,
+                    replica: Optional[str] = None, depth: int = 0,
+                    start: Optional[float] = None,
+                    mono: Optional[float] = None, **extra: Any) -> None:
+        """One ``route`` span per routing attempt — externally timed via
+        ``tracer.emit`` (the transport round trip IS the span), guarded
+        on the wire header so an untraced request costs one dict get."""
+        tid = payload.get("trace_id")
+        if not tid or not self._tel.tracer.enabled:
+            return
+        dur = 0.0 if mono is None else (time.monotonic() - mono) * 1000.0
+        self._tel.tracer.emit(
+            "route", trace_id=tid, parent_id=payload.get("span_id"),
+            dur_ms=dur, start=start, mono=mono, verdict=verdict,
+            policy=self.policy, replica=replica, affinity_depth=int(depth),
+            tier=payload.get("tier"), request_id=payload.get("request_id"),
+            **extra)
+
+    def _fleet_rows(self) -> Dict[str, Dict[str, Any]]:
+        """Fleet-table rows: the ``router`` row reconciles EXACTLY with
+        the ``router_*`` counters (read from the same handles), and one
+        row per replica mirrors the registry view routing actually
+        used."""
+        rows: Dict[str, Dict[str, Any]] = {
+            "router": {
+                "role": "router",
+                "policy": self.policy,
+                "replicas_live": self.registry.live_count(),
+                "requests": int(sum(c.value
+                                    for c in self._m_requests.values())),
+                "shed": int(sum(c.value for c in self._m_shed.values())),
+                "failovers": int(self._m_failovers.value),
+                "goodput": int(sum(c.value
+                                   for c in self._m_goodput.values())),
+                "affinity_hits": int(self._m_affinity.value),
+            }
+        }
+        for name, snap in self.registry.snapshot().items():
+            rows[name] = {"role": "replica", **snap}
+        return rows

@@ -18,6 +18,10 @@ instead: per-round tables (wall, bound_by, idle, top phases, gaps) plus
 the aggregate critical-path attribution — see ``docs/OBSERVABILITY.md``
 §9 for the taxonomy.
 
+``--requests [--tier N]`` assembles the serving request rounds instead
+(docs/OBSERVABILITY.md §11): per-request timelines with the failover
+attempt chain and the per-SLO-tier TTFT/TPOT attribution table.
+
 ``--flight`` additionally summarizes the postmortem bundles the flight
 recorder wrote under ``<dir>/flight/`` (trigger, event counts, context —
 see ``docs/OBSERVABILITY.md``). ``--watch`` tails the run live instead:
@@ -229,6 +233,22 @@ def summarize_critical_path(run_dir: str, max_rounds: int = 20) -> List[str]:
         assembly, max_rounds=max_rounds)
 
 
+def summarize_requests(run_dir: str, max_rounds: int = 20,
+                       tier: int = None) -> List[str]:
+    """Assemble ``spans.jsonl`` and render the serving request rounds
+    (docs/OBSERVABILITY.md §11): per-request timelines with failover
+    attempt chains plus the per-SLO-tier TTFT/TPOT table."""
+    from distriflow_tpu.obs.trace_assembler import (assemble_dir,
+                                                    render_requests)
+
+    spans_path = os.path.join(run_dir, SPANS_FILENAME)
+    if not os.path.exists(spans_path):
+        return [f"(no {SPANS_FILENAME} in {run_dir} — nothing to assemble)"]
+    assembly = assemble_dir(run_dir)
+    return [f"serving requests ({spans_path}):"] + render_requests(
+        assembly, max_rounds=max_rounds, tier=tier)
+
+
 def watch(run_dir: str, interval: float, iterations: int) -> int:
     """Live mode: poll the latest snapshot row and print counter/gauge
     movement between polls. Returns 0 once a metrics file was seen."""
@@ -284,6 +304,14 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--max-rounds", type=int, default=20,
                         help="cap per-round lines in --critical-path "
                              "output (default 20)")
+    parser.add_argument("--requests", action="store_true",
+                        help="assemble spans.jsonl into serving request "
+                             "rounds and print per-request timelines + "
+                             "the per-tier TTFT/TPOT attribution table")
+    parser.add_argument("--tier", type=int, default=None,
+                        help="with --requests: only list requests of this "
+                             "SLO tier (the aggregate table always covers "
+                             "all tiers)")
     parser.add_argument("--fleet", action="store_true",
                         help="render the fleet telemetry plane (per-client "
                              "table + fleet/* aggregates) from a server "
@@ -307,6 +335,12 @@ def main(argv: List[str] = None) -> int:
 
     if args.watch:
         return watch(args.run_dir, args.interval, args.iterations)
+
+    if args.requests:
+        spans_path = os.path.join(args.run_dir, SPANS_FILENAME)
+        print("\n".join(summarize_requests(
+            args.run_dir, max_rounds=args.max_rounds, tier=args.tier)))
+        return 0 if os.path.exists(spans_path) else 2
 
     if args.critical_path:
         spans_path = os.path.join(args.run_dir, SPANS_FILENAME)

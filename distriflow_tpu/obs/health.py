@@ -57,9 +57,19 @@ def default_bands(*, mfu_floor: Optional[float] = None,
                   apply_queue_max: Optional[float] = None,
                   slots_max: Optional[float] = None,
                   page_occupancy_max: Optional[float] = None,
-                  router_min_replicas: Optional[float] = None) -> List[SLOBand]:
+                  router_min_replicas: Optional[float] = None,
+                  ttft_p99_ms: Optional[Mapping[int, float]] = None,
+                  tpot_p99_ms: Optional[Mapping[int, float]] = None,
+                  slo_min_count: int = 1) -> List[SLOBand]:
     """The stock bands from docs/OBSERVABILITY.md §6; pass only the
-    thresholds you want enforced."""
+    thresholds you want enforced.
+
+    ``ttft_p99_ms`` / ``tpot_p99_ms`` are ``{tier: ceiling_ms}`` maps —
+    one band per tier over the tier-labeled serving histograms
+    (``serving_ttft_ms{tier=N}`` / ``serving_time_per_output_token_ms
+    {tier=N}``, docs/OBSERVABILITY.md §11). A breach dumps a flight
+    bundle whose recent ``ttft_high`` / ``tpot_high`` watermark events
+    name the worst request trace."""
     bands: List[SLOBand] = []
     if mfu_floor is not None:
         bands.append(SLOBand("mfu_floor", "train_mfu", "value",
@@ -87,6 +97,17 @@ def default_bands(*, mfu_floor: Optional[float] = None,
         # the next replica loss takes requests with it
         bands.append(SLOBand("router_capacity", "router_replicas_live",
                              "value", {}, lower=router_min_replicas))
+    for t, ceiling in sorted((ttft_p99_ms or {}).items()):
+        bands.append(SLOBand(f"ttft_p99_tier{int(t)}", "serving_ttft_ms",
+                             "p99", {"tier": str(int(t))},
+                             upper=float(ceiling),
+                             min_count=int(slo_min_count)))
+    for t, ceiling in sorted((tpot_p99_ms or {}).items()):
+        bands.append(SLOBand(f"tpot_p99_tier{int(t)}",
+                             "serving_time_per_output_token_ms",
+                             "p99", {"tier": str(int(t))},
+                             upper=float(ceiling),
+                             min_count=int(slo_min_count)))
     return bands
 
 

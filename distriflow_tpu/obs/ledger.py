@@ -115,6 +115,23 @@ BANDS: Dict[str, Dict[str, Dict[str, float]]] = {
         "value": {"warn_pct": 1e9, "regress_pct": 1e9},
         "reports": {"warn_pct": 1e9, "regress_pct": 1e9},
     },
+    "serving_slo": {
+        # mixed-tier serving SLOs over two replicas behind the router
+        # (docs/OBSERVABILITY.md §11): "value" is fleet goodput — the
+        # guarded headline. Per-tier TTFT/TPOT quantiles and the
+        # trace-on/off legs are absolute loopback wall times on shared
+        # runners, guarded loosely like the obs_overhead rows; the
+        # overhead delta is a difference of two jittery means (often
+        # sub-ms) so its pct-of-reference gate is advisory-only.
+        "ttft_": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "tpot_": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "trace_on_ms": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "trace_off_ms": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "trace_overhead_ms": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "requests": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "shed": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "failovers": {"warn_pct": 1e9, "regress_pct": 1e9},
+    },
     "cifar10_convnet_async_bounded_staleness": {
         # round-6 semantic change: floor_ms/ceiling_sps are now derived
         # from the continuous profiler's phase digests (per-upload

@@ -67,6 +67,17 @@ _PRIORITY = {
     "broadcast": 3,
     "submit": 2,
     "ack_wait": 1,
+    # serving request-round taxonomy (docs/OBSERVABILITY.md §11): engine
+    # work (prefill/decode) owns overlapped instants; the router's route
+    # attempt and the client's request root are the enclosing windows the
+    # replica phases carve their time out of
+    "prefill": 7,
+    "decode_iter": 7,
+    "admission": 5,
+    "retire": 4,
+    "queue_wait": 3,
+    "route": 2,
+    "request": 1,
 }
 
 #: structural span names — everything else is treated as a generic phase
@@ -81,7 +92,7 @@ class Round:
 
     trace_id: str
     update_id: Optional[str]
-    kind: str  # "wire" (cross-role) | "step" (in-process trainer round)
+    kind: str  # "wire" | "step" (trainer) | "request" (serving, §11)
     applied: bool
     wall_ms: float
     phases: Dict[str, float]  # exclusive critical-path ms per phase
@@ -107,6 +118,42 @@ class Assembly:
 
     def applied(self) -> List[Round]:
         return [r for r in self.rounds if r.applied]
+
+    def requests(self) -> List[Round]:
+        """The serving request rounds (kind == "request")."""
+        return [r for r in self.rounds if r.kind == "request"]
+
+    def request_attribution(self) -> Dict[str, Any]:
+        """Per-SLO-tier TTFT/TPOT and goodput over the request rounds —
+        the ``dump --requests`` table (docs/OBSERVABILITY.md §11)."""
+        reqs = self.requests()
+        tiers: Dict[int, Dict[str, Any]] = {}
+        for r in reqs:
+            t = r.attrs.get("tier")
+            row = tiers.setdefault(int(t) if t is not None else -1, {
+                "requests": 0, "committed": 0, "shed": 0, "failovers": 0,
+                "ttft": [], "tpot": []})
+            row["requests"] += 1
+            row["committed"] += 1 if r.applied else 0
+            row["shed"] += 1 if r.attrs.get("verdict") == "shed" else 0
+            row["failovers"] += r.retries
+            for k in ("ttft", "tpot"):
+                v = r.attrs.get(f"{k}_ms")
+                if v is not None:
+                    row[k].append(float(v))
+        out: Dict[int, Dict[str, Any]] = {}
+        for t, row in sorted(tiers.items()):
+            o = {k: row[k] for k in
+                 ("requests", "committed", "shed", "failovers")}
+            for k in ("ttft", "tpot"):
+                vals = sorted(row[k])
+                o[f"{k}_p50_ms"] = _pct(vals, 0.50)
+                o[f"{k}_p99_ms"] = _pct(vals, 0.99)
+            out[t] = o
+        return {"requests": len(reqs),
+                "committed": sum(1 for r in reqs if r.applied),
+                "orphans": len(self.orphans),
+                "tiers": out}
 
     def attribution(self) -> Dict[str, Any]:
         """Aggregate critical-path attribution over the APPLIED rounds."""
@@ -142,6 +189,14 @@ class Assembly:
             "orphans": len(self.orphans),
             "skipped_lines": self.skipped,
         }
+
+
+def _pct(vals: List[float], q: float) -> Optional[float]:
+    """Percentile over a small sorted sample (nearest-rank); None when
+    empty — matching the registry histogram's summary convention."""
+    if not vals:
+        return None
+    return round(vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))], 3)
 
 
 def _f(row: Dict[str, Any], key: str, default: float = 0.0) -> float:
@@ -354,6 +409,120 @@ def _assemble_wire_round(key: str, rows: List[Dict[str, Any]],
     )
 
 
+#: span names that mark a trace as a serving request round (§11); any row
+#: carrying a ``request_id`` attr qualifies too, so replica-only span sets
+#: (no router, no client root) still assemble as request timelines.
+_REQUEST_NAMES = {"request", "route", "queue_wait", "admission", "prefill",
+                  "decode_iter", "retire"}
+
+
+def _is_request_trace(rows: List[Dict[str, Any]]) -> bool:
+    return any(str(r.get("name")) in _REQUEST_NAMES or r.get("request_id")
+               for r in rows)
+
+
+def _assemble_request_round(key: str, rows: List[Dict[str, Any]],
+                            offsets: Dict[Any, float]) -> Round:
+    """One serving request's timeline: the client's ``request`` root, one
+    ``route`` span per router attempt, and the replica engine spans
+    (queue_wait/admission/prefill/decode_iter/spec_*/retire). Failover
+    hops land in the SAME trace (the headers ride the resubmitted
+    payload), so the attempt list carries per-replica segments and the
+    round checks the exactly-once commit: routed requests must show
+    exactly one ``forwarded`` attempt; shed and whole-fleet-drain
+    requests assemble as terminated (unapplied) rounds carrying that
+    verdict."""
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for r in rows:
+        by_name.setdefault(str(r.get("name", "?")), []).append(r)
+
+    routes = sorted(by_name.get("route", ()),
+                    key=lambda r: _interval(r, offsets)[0])
+    attempts: List[Dict[str, Any]] = []
+    for rt in routes:
+        attempts.append({
+            "replica": rt.get("replica"),
+            "verdict": str(rt.get("verdict", "?")),
+            "dur_ms": round(_f(rt, "dur_ms"), 3),
+        })
+    forwarded = [a for a in attempts if a["verdict"] == "forwarded"]
+    failovers = sum(1 for a in attempts
+                    if a["verdict"].startswith("failover"))
+    retires = by_name.get("retire", ())
+    outcomes = sorted({str(r.get("outcome")) for r in retires
+                       if r.get("outcome") is not None})
+
+    segments: List[Tuple[str, float, float, int]] = []
+    for name, group in by_name.items():
+        for r in group:
+            a, b = _interval(r, offsets)
+            segments.append((name, a, b, _PRIORITY.get(name, 0)))
+    phases, idle, gaps, wall = _sweep(segments)
+    busy = sum((s[2] - s[1]) * 1e3 for s in segments)
+    overlap = max(0.0, busy - wall)
+    candidates = dict(phases)
+    candidates["idle"] = idle
+    bound = (max(sorted(candidates), key=lambda k: candidates[k])
+             if candidates else "idle")
+
+    if any(a["verdict"] == "shed" for a in attempts):
+        verdict = "shed"
+    elif forwarded:
+        verdict = "forwarded"
+    elif any(a["verdict"] == "drain" for a in attempts):
+        verdict = "drain"
+    elif "complete" in outcomes:
+        verdict = "complete"
+    elif outcomes:
+        verdict = outcomes[0]
+    else:
+        roots = by_name.get("request", ())
+        status = str(roots[0].get("status", "ok")) if roots else "ok"
+        verdict = "ok" if status == "ok" else status
+    # exactly-once commit: a routed request is applied iff exactly ONE
+    # attempt forwarded; an unrouted (direct) one iff the replica retired
+    # it complete (or, client-side-only traces, the root closed ok)
+    if routes:
+        applied = len(forwarded) == 1
+    elif retires:
+        applied = "complete" in outcomes
+    else:
+        applied = verdict == "ok"
+
+    attrs: Dict[str, Any] = {"verdict": verdict}
+    tier = next((r.get("tier") for r in rows if r.get("tier") is not None),
+                None)
+    if tier is not None:
+        attrs["tier"] = int(tier)
+    rid = next((r.get("request_id") for r in rows if r.get("request_id")),
+               None)
+    if rid is not None:
+        attrs["request_id"] = str(rid)
+    # SLO latencies: the forwarded route echoes the replica's measured
+    # values, so router-run-dir-only assembly still attributes them; a
+    # replica-local span set falls back to the retire span's copies
+    src_rows = ([rt for rt in routes
+                 if str(rt.get("verdict")) == "forwarded"]
+                + [r for r in retires if r.get("outcome") == "complete"])
+    for k in ("ttft_ms", "tpot_ms"):
+        v = next((r.get(k) for r in src_rows if r.get(k) is not None), None)
+        if v is not None:
+            attrs[k] = float(v)
+    if attempts:
+        attrs["attempts"] = attempts
+        replicas = [a["replica"] for a in attempts if a["replica"]]
+        attrs["replicas"] = sorted(set(replicas))
+
+    return Round(
+        trace_id=str(rows[0].get("trace_id", key)) if rows else key,
+        update_id=None, kind="request", applied=applied,
+        wall_ms=wall, phases=phases, bound_by=bound, overlap_ms=overlap,
+        idle_ms=idle, gaps=gaps, retries=failovers,
+        apply_spans=len(forwarded), span_count=len(rows),
+        attrs=attrs,
+    )
+
+
 def assemble(rows: Iterable[Dict[str, Any]], skipped: int = 0) -> Assembly:
     """Stitch span rows (any order, any role mix) into rounds.
 
@@ -373,14 +542,23 @@ def assemble(rows: Iterable[Dict[str, Any]], skipped: int = 0) -> Assembly:
     # redelivered batch rides the original trace; its fresh dispatch does
     # not — both describe the one applied update)
     trace_update: Dict[str, Optional[str]] = {}
+    trace_request: Dict[str, Optional[str]] = {}
     for tid, group in by_trace.items():
         uids = {r.get("update_id") for r in group if r.get("update_id")}
         trace_update[tid] = sorted(uids)[0] if len(uids) == 1 else None
+        rids = {r.get("request_id") for r in group if r.get("request_id")}
+        trace_request[tid] = sorted(rids)[0] if len(rids) == 1 else None
 
     merged: Dict[str, List[Dict[str, Any]]] = {}
     for tid, group in sorted(by_trace.items()):
         uid = trace_update[tid]
-        key = f"u:{uid}" if uid else f"t:{tid}"
+        # request rounds merge on the idempotency key (§11): a client
+        # retry that re-sends the same request_id under a fresh trace
+        # still describes the one answered request, exactly like the
+        # update_id merge above
+        rid = trace_request[tid] if _is_request_trace(group) else None
+        key = (f"u:{uid}" if uid
+               else f"r:{rid}" if rid else f"t:{tid}")
         merged.setdefault(key, []).extend(group)
 
     rounds: List[Round] = []
@@ -394,6 +572,8 @@ def assemble(rows: Iterable[Dict[str, Any]], skipped: int = 0) -> Assembly:
                 rounds.append(_assemble_step_round(
                     tid, [r for r in group if r.get("trace_id") == tid],
                     offsets))
+        elif _is_request_trace(group):
+            rounds.append(_assemble_request_round(key, group, offsets))
         else:
             rounds.append(_assemble_wire_round(key, group, offsets))
     return Assembly(rounds=rounds, orphans=orphans, skipped=skipped)
@@ -410,6 +590,56 @@ def assemble_dir(run_dir: str) -> Assembly:
         return Assembly(rounds=[], orphans=[], skipped=0)
     rows, skipped = read_metrics_counted(path)
     return assemble(rows, skipped=skipped)
+
+
+def render_requests(assembly: Assembly, max_rounds: int = 20,
+                    tier: Optional[int] = None) -> List[str]:
+    """Request-round timelines + per-tier attribution table for
+    ``dump --requests [--tier N]`` (docs/OBSERVABILITY.md §11)."""
+    lines: List[str] = []
+    reqs = assembly.requests()
+    if tier is not None:
+        reqs = [r for r in reqs if r.attrs.get("tier") == tier]
+    agg = assembly.request_attribution()
+    lines.append(
+        f"requests: {agg['requests']} assembled, {agg['committed']} "
+        f"committed, {agg['orphans']} orphan span(s)"
+        + (f" (showing tier {tier}: {len(reqs)})" if tier is not None
+           else ""))
+    for r in reqs[:max_rounds]:
+        rid = str(r.attrs.get("request_id", "-"))[:12]
+        t = r.attrs.get("tier", "-")
+        hops = " -> ".join(
+            f"{a['replica'] or '?'}[{a['verdict']}]"
+            for a in r.attrs.get("attempts", ())) or "(direct)"
+        slo = ""
+        if r.attrs.get("ttft_ms") is not None:
+            slo = f" ttft={r.attrs['ttft_ms']:.1f}ms"
+        if r.attrs.get("tpot_ms") is not None:
+            slo += f" tpot={r.attrs['tpot_ms']:.2f}ms"
+        top = sorted(r.phases.items(), key=lambda kv: -kv[1])[:3]
+        top_s = " ".join(f"{k}={v:.1f}ms" for k, v in top)
+        lines.append(
+            f"  {r.trace_id[:8]}/{rid} tier={t} {r.attrs['verdict']} "
+            f"wall={r.wall_ms:.1f}ms{slo} bound_by={r.bound_by} {top_s}")
+        lines.append(f"    attempts: {hops}")
+    if len(reqs) > max_rounds:
+        lines.append(f"  (+{len(reqs) - max_rounds} more requests)")
+    if agg["tiers"]:
+        lines.append("per-tier SLO attribution:")
+        lines.append("  tier  reqs  commit  shed  failover  "
+                     "ttft_p50/p99 ms   tpot_p50/p99 ms")
+        for t, row in agg["tiers"].items():
+            def _fmt(a: Optional[float], b: Optional[float]) -> str:
+                if a is None:
+                    return "-/-"
+                return f"{a:.1f}/{b:.1f}"
+            lines.append(
+                f"  {t:>4}  {row['requests']:>4}  {row['committed']:>6}  "
+                f"{row['shed']:>4}  {row['failovers']:>8}  "
+                f"{_fmt(row['ttft_p50_ms'], row['ttft_p99_ms']):>15}  "
+                f"{_fmt(row['tpot_p50_ms'], row['tpot_p99_ms']):>15}")
+    return lines
 
 
 def render(assembly: Assembly, max_rounds: int = 20) -> List[str]:

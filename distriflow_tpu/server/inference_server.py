@@ -121,6 +121,8 @@ class _Request:
         "prompt", "n_tokens", "temperature", "top_k", "top_p", "eos",
         "seed", "client_id", "enq_t", "admit_t", "rows_out", "rows_left",
         "cancelled", "done", "result", "error", "page_plan",
+        "trace_id", "parent_span", "request_id", "tier", "first_tok_t",
+        "ttft_ms", "tpot_ms",
     )
 
     def __init__(self, prompt: np.ndarray, n_tokens: int, temperature: float,
@@ -147,6 +149,17 @@ class _Request:
         # the request and released either at slot retirement (committed)
         # or by _release_plan (admission failure)
         self.page_plan: Optional[List[Dict[str, Any]]] = None
+        # request-trace plane (docs/OBSERVABILITY.md §11): wire headers
+        # parsed off the payload (empty = untraced, all span emission
+        # short-circuits), plus the SLO anchors the retire span and the
+        # ack's serving_meta report back
+        self.trace_id = ""
+        self.parent_span = ""
+        self.request_id: Optional[str] = None
+        self.tier = 0
+        self.first_tok_t: Optional[float] = None
+        self.ttft_ms: Optional[float] = None
+        self.tpot_ms: Optional[float] = None
 
 
 class _PagePool:
@@ -362,7 +375,25 @@ class InferenceServer:
         self._m_tokens = tel.counter("serving_tokens_generated_total")
         self._m_slots = tel.gauge("serving_slots_active")
         self._m_qwait = tel.histogram("serving_queue_wait_ms")
-        self._m_tpot = tel.histogram("serving_time_per_output_token_ms")
+        # per-tier SLO surfaces (docs/OBSERVABILITY.md §11): TTFT is the
+        # enqueue -> first-token wall per request; TPOT is per-SLOT
+        # decode-interval time per emitted token (satellite 1: the old
+        # single histogram divided one batch dispatch across all active
+        # slots, conflating every co-resident request)
+        self._m_ttft = {t: tel.histogram("serving_ttft_ms", tier=str(t))
+                        for t in (0, 1, 2)}
+        self._m_tpot = {t: tel.histogram(
+            "serving_time_per_output_token_ms", tier=str(t))
+            for t in (0, 1, 2)}
+        # running per-tier worst-request watermarks: a new maximum drops
+        # a ttft_high/tpot_high flight event naming the request, so the
+        # sentinel's breach bundle carries the offending trace (§11)
+        self._ttft_peak = {0: 0.0, 1: 0.0, 2: 0.0}
+        self._tpot_peak = {0: 0.0, 1: 0.0, 2: 0.0}
+        # per-slot clock of the last token-emission event (first token at
+        # admission, then every decode/spec commit) — the denominator
+        # anchor for per-slot TPOT intervals
+        self._slot_emit_t = [0.0] * s
         self._m_pages = tel.gauge("serving_page_occupancy")
         self._m_prefix_hits = tel.counter("serving_prefix_hits_total")
         self._m_prefix_tokens = tel.counter(
@@ -611,6 +642,14 @@ class InferenceServer:
                 int(eos_id) if eos_id is not None else -1,
                 seed, client_id,
             )
+            # trace headers (docs/OBSERVABILITY.md §11): absent on the
+            # wire for untraced callers, so every engine span emission
+            # below short-circuits on the empty trace_id
+            item.trace_id = str(payload.get("trace_id") or "")
+            item.parent_span = str(payload.get("span_id") or "")
+            rid = payload.get("request_id")
+            item.request_id = str(rid) if rid is not None else None
+            item.tier = min(max(int(payload.get("tier", 0) or 0), 0), 2)
             with self._inflight_lock:
                 self._inflight.setdefault(client_id, []).append(item)
             self._queue.put(item)
@@ -643,6 +682,13 @@ class InferenceServer:
                 saved = sum(len(p["shared"]) for p in item.page_plan)
                 if saved:
                     meta["prefix_tokens"] = saved * self.serving.page_size
+            # replica-measured SLO latencies ride the ack so the router's
+            # route span (and dump --requests on the router's run dir)
+            # can attribute them without reading this replica's spans
+            if item.ttft_ms is not None:
+                meta["ttft_ms"] = item.ttft_ms
+            if item.tpot_ms is not None:
+                meta["tpot_ms"] = item.tpot_ms
         else:
             with self._device_lock, self.logger.time(
                 f"generate[{prompt.shape[0]}x{prompt.shape[1]}+{n_tokens}]"
@@ -656,8 +702,12 @@ class InferenceServer:
                     rng=jax.random.PRNGKey(seed),
                 )
             meta = {"path": "direct"}  # dfcheck: payload serving_meta
-        return {"result": pack_bytes({"tokens": serialize_array(out)}),
-                "serving": meta}
+        ack = {"result": pack_bytes({"tokens": serialize_array(out)}),
+               "serving": meta}
+        tid = payload.get("trace_id")
+        if tid:
+            ack["trace_id"] = tid  # echo: the ack joins the request trace
+        return ack
 
     # -- continuous-batching engine ----------------------------------------
 
@@ -838,6 +888,23 @@ class InferenceServer:
             if r is not None and r.client_id == client_id)
         self.fleet.note_pages(client_id, held)
 
+    def _req_span(self, req: _Request, name: str, mono0: float,
+                  dur_ms: float, **attrs: Any) -> None:
+        """One per-request engine span (docs/OBSERVABILITY.md §11),
+        externally timed via ``tracer.emit`` so the scheduler thread's
+        phase accounting stays the single clock. ``start`` is derived
+        from the monotonic anchor so the assembler's per-(host,pid) skew
+        domain sees consistent epoch/mono pairs. Short-circuits for
+        untraced requests (empty ``trace_id``) — the engine pays two
+        attribute reads per call when tracing is off."""
+        if not req.trace_id or not self._tel.tracer.enabled:
+            return
+        start = time_mod.time() - (time_mod.monotonic() - mono0)
+        self._tel.tracer.emit(
+            name, trace_id=req.trace_id, parent_id=req.parent_span,
+            dur_ms=dur_ms, start=start, mono=mono0,
+            request_id=req.request_id, tier=req.tier, **attrs)
+
     def _admit(self) -> None:
         """Move backlog requests into free slots (strict FIFO — a wide
         request blocks later ones rather than being starved), prefill
@@ -900,6 +967,8 @@ class InferenceServer:
             for req in admit:
                 req.admit_t = now
                 self._m_qwait.observe((now - req.enq_t) * 1000.0)
+                self._req_span(req, "queue_wait", req.enq_t,
+                               (now - req.enq_t) * 1000.0)
                 for row in range(req.prompt.shape[0]):
                     shared_len = 0
                     if self._paged and req.page_plan is not None:
@@ -996,6 +1065,7 @@ class InferenceServer:
                     dpages = plan["draft"]
                     self._draft_tables[s, :] = self._n_pages
                     self._draft_tables[s, :len(dpages)] = dpages
+        pf0 = time_mod.monotonic()
         with self._prof.phase("prefill"), self._device_lock, self.logger.time(
             f"admit[{n}->{bucket}x{plen}]"
         ):
@@ -1029,6 +1099,7 @@ class InferenceServer:
             first = np.asarray(pick_rows(
                 logits, temps, top_ks, top_ps, seeds,
                 np.full((bucket,), plen, np.int32)))[:n]
+        pf1 = time_mod.monotonic()  # first tokens are on the host now
         if self._spec_k:
             # the draft prefills the FULL prompt: even when the target rode
             # shared prefix pages, the draft cache holds no KV for them
@@ -1054,6 +1125,26 @@ class InferenceServer:
             self._slot_req[s] = req
             self._slot_row[s] = row
             self._slot_emitted[s] = 1
+            self._slot_emit_t[s] = pf1
+            if req.first_tok_t is None:
+                # first row of this request to land a token: the TTFT
+                # anchor is enqueue -> token on host, so queue wait and
+                # cold-compile stalls show up where the caller felt them
+                req.first_tok_t = pf1
+                req.ttft_ms = round((pf1 - req.enq_t) * 1000.0, 3)
+                self._m_ttft[req.tier].observe(req.ttft_ms)
+                if req.ttft_ms > self._ttft_peak[req.tier]:
+                    # worst-request watermark: the sentinel's breach
+                    # bundle ring then names the offending trace (§11)
+                    self._ttft_peak[req.tier] = req.ttft_ms
+                    self._tel.flight.record(
+                        "ttft_high", request_id=req.request_id,
+                        trace_id=req.trace_id, tier=req.tier,
+                        ttft_ms=req.ttft_ms)
+                self._req_span(req, "admission", req.admit_t,
+                               (pf0 - req.admit_t) * 1000.0)
+            self._req_span(req, "prefill", pf0, (pf1 - pf0) * 1000.0,
+                           slot=s, row=row, plen=plen, shared=shared_len)
             if self._paged:
                 plan = req.page_plan[row]
                 plan["committed"] = True
@@ -1124,8 +1215,8 @@ class InferenceServer:
                 tok = np.array(tok)
                 done = np.array(done)
                 toks = np.array(toks)
-            elapsed_ms = (time_mod.monotonic() - t0) * 1000.0
-            self._m_tpot.observe(elapsed_ms / srv.decode_chunk)
+            t1 = time_mod.monotonic()
+            elapsed_ms = (t1 - t0) * 1000.0
             self.decode_batches += 1
             self._m_batches.inc()
             self._tok = tok
@@ -1139,6 +1230,17 @@ class InferenceServer:
                 chunk_toks = toks[s, :take].astype(np.int32)
                 emitted_now += take
                 self._slot_emitted[s] = have + take
+                # per-slot decode-interval TPOT (satellite 1): time since
+                # THIS slot last emitted, per token it emitted now — the
+                # old batch-level observe divided one dispatch across all
+                # active slots and conflated every co-resident request
+                if take > 0:
+                    self._m_tpot[req.tier].observe(
+                        (t1 - self._slot_emit_t[s]) * 1000.0 / take)
+                self._slot_emit_t[s] = t1
+                self._req_span(req, "decode_iter", t0, elapsed_ms,
+                               slot=s, n_active=len(active), take=take,
+                               share=round(elapsed_ms / len(active), 3))
                 req.rows_out[row] = np.concatenate(
                     [req.rows_out[row], chunk_toks])
                 if done[s]:
@@ -1188,6 +1290,7 @@ class InferenceServer:
                     dparams, self._draft_cache, self._tok, self._temps,
                     self._top_ks, self._top_ps, self._seeds)
                 drafts.block_until_ready()
+            td = time_mod.monotonic()
             with self._prof.phase("spec_verify"):
                 (self._slot_cache, emit, n_emit, n_acc, new_tok, new_done,
                  catch, new_idx) = verify(
@@ -1199,12 +1302,13 @@ class InferenceServer:
                 n_acc = np.array(n_acc)
                 new_tok = np.array(new_tok)
                 new_done = np.array(new_done)
+            tv = time_mod.monotonic()
             with self._prof.phase("spec_commit"):
                 self._draft_cache = commit(
                     dparams, self._draft_cache, drafts[:, -1], catch,
                     new_idx)
                 jax.block_until_ready(self._draft_cache)
-        elapsed_ms = (time_mod.monotonic() - t0) * 1000.0
+        tc = time_mod.monotonic()
         self.decode_batches += 1
         self._m_batches.inc()
         self._tok = new_tok
@@ -1219,6 +1323,19 @@ class InferenceServer:
             emitted_now += take
             accepted_now += int(n_acc[s])
             self._slot_emitted[s] = have + take
+            # per-slot decode-interval TPOT (satellite 1), spec flavor:
+            # a round yields 1..k+1 tokens per row, so the interval is
+            # normalized by what THIS slot actually committed
+            if take > 0:
+                self._m_tpot[req.tier].observe(
+                    (tc - self._slot_emit_t[s]) * 1000.0 / take)
+                self._slot_emit_t[s] = tc
+            self._req_span(req, "spec_draft", t0, (td - t0) * 1000.0,
+                           slot=s)
+            self._req_span(req, "spec_verify", td, (tv - td) * 1000.0,
+                           slot=s)
+            self._req_span(req, "spec_commit", tv, (tc - tv) * 1000.0,
+                           slot=s, accepted=int(n_acc[s]), take=take)
             req.rows_out[row] = np.concatenate(
                 [req.rows_out[row], emit[s, :take].astype(np.int32)])
             if new_done[s]:
@@ -1235,7 +1352,6 @@ class InferenceServer:
         self._m_spec_accepted.inc(accepted_now)
         self.spec_accept_per_step = accepted_now / len(active)
         self._m_spec_rate.set(self.spec_accept_per_step)
-        self._m_tpot.observe(elapsed_ms * len(active) / max(emitted_now, 1))
         self._m_slots.set(sum(1 for r in self._slot_req if r is not None))
 
     def _complete_row(self, s: int) -> None:
@@ -1247,6 +1363,22 @@ class InferenceServer:
         if req.rows_left == 0 and not req.done.is_set():
             req.result = np.concatenate(
                 [req.prompt, np.stack(req.rows_out)], axis=1)
+            now = time_mod.monotonic()
+            if req.first_tok_t is not None:
+                # per-request TPOT: wall from first token to completion
+                # over the remaining token budget — what the caller
+                # experienced, regardless of who shared the batch
+                req.tpot_ms = round((now - req.first_tok_t) * 1000.0
+                                    / max(req.n_tokens - 1, 1), 3)
+                if req.tpot_ms > self._tpot_peak[req.tier]:
+                    self._tpot_peak[req.tier] = req.tpot_ms
+                    self._tel.flight.record(
+                        "tpot_high", request_id=req.request_id,
+                        trace_id=req.trace_id, tier=req.tier,
+                        tpot_ms=req.tpot_ms)
+            self._req_span(req, "retire", now, 0.0, outcome="complete",
+                           emitted=int(req.n_tokens),
+                           ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms)
             self._unregister(req)
             req.done.set()
 
@@ -1285,6 +1417,10 @@ class InferenceServer:
     def _finish_error(self, req: _Request, err: Exception) -> None:
         if not req.done.is_set():
             req.error = err
+            self._req_span(
+                req, "retire", time_mod.monotonic(), 0.0,
+                outcome="cancelled" if req.cancelled else "error",
+                error=type(err).__name__)
             self._unregister(req)
             req.done.set()
 
@@ -1386,11 +1522,15 @@ class InferenceServer:
                 beam_size=beam_size, length_penalty=length_penalty,
                 eos_id=int(eos_id) if eos_id is not None else None,
             )
-        return {
+        ack = {
             "result": pack_bytes(
                 {"tokens": serialize_array(out), "scores": serialize_array(scores)}
             )
         }
+        tid = payload.get("trace_id")
+        if tid:
+            ack["trace_id"] = tid
+        return ack
 
     # dfcheck: payload payload=score_request -> direct_ack
     def _on_score(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -1400,4 +1540,8 @@ class InferenceServer:
             f"score[{tokens.shape[0]}x{tokens.shape[1]} from={from_pos}]"
         ):
             scores = sequence_logprob(self.config, self.params, tokens, from_pos)
-        return {"result": pack_bytes({"scores": serialize_array(scores)})}
+        ack = {"result": pack_bytes({"scores": serialize_array(scores)})}
+        tid = payload.get("trace_id")
+        if tid:
+            ack["trace_id"] = tid
+        return ack

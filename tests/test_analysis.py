@@ -256,24 +256,57 @@ def leaky(tracer):
 
 
 def test_balanced_span_shapes_are_silent(tmp_path):
+    # span names come from the documented taxonomy so the phase-drift
+    # check stays quiet and only balance is under test
     src = '''
 def with_item(tracer):
-    with tracer.span("a"):
+    with tracer.span("fit"):
         pass
 
 
 def factory(tracer):
-    return tracer.span("b")  # balance is the caller's obligation
+    return tracer.span("submit")  # balance is the caller's obligation
 
 
 def try_finally(tracer):
-    s = tracer.span("c")
+    s = tracer.span("upload")
     try:
         pass
     finally:
         s.__exit__(None, None, None)
 '''
     assert _findings(tmp_path, src, ["obs"]) == []
+
+
+def test_undocumented_phase_is_flagged(tmp_path):
+    src = '''
+def with_item(tracer):
+    with tracer.span("dfcheck_fixture_bogus_phase"):
+        pass
+'''
+    found = _findings(tmp_path, src, ["obs"])
+    assert [f.check for f in found] == ["phase-undocumented"]
+    assert "dfcheck_fixture_bogus_phase" in found[0].message
+
+
+def test_undocumented_phase_ignore_comment(tmp_path):
+    src = '''
+def with_item(tracer):
+    with tracer.span("bogus"):  # dfcheck: ignore[phase-undocumented]
+        pass
+'''
+    assert _findings(tmp_path, src, ["obs"]) == []
+
+
+def test_doc_phase_taxonomy_covers_request_lifecycle():
+    # the doc side of the two-way drift gate: the OBSERVABILITY.md
+    # taxonomy tables must parse and carry the serving request
+    # lifecycle names the assembler keys on (§11)
+    from distriflow_tpu.analysis.obs_check import collect_doc_phases
+
+    names = collect_doc_phases()
+    assert {"request", "route", "queue_wait", "admission", "prefill",
+            "decode_iter", "retire"} <= names
 
 
 # ---------------------------------------------------------------------------

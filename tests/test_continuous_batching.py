@@ -219,7 +219,10 @@ def test_serving_metrics_surface(served):
     assert tel.counter_value("serving_tokens_generated_total") >= 6
     assert snap["gauges"]["serving_slots_active"] == 0
     assert "serving_queue_wait_ms" in snap["histograms"]
-    assert "serving_time_per_output_token_ms" in snap["histograms"]
+    # TTFT and TPOT are tier-labeled (docs/OBSERVABILITY.md §11); an
+    # untiered request lands in tier 0
+    assert "serving_ttft_ms{tier=0}" in snap["histograms"]
+    assert "serving_time_per_output_token_ms{tier=0}" in snap["histograms"]
 
 
 def test_int8_kv_auto_gates_below_latency_crossover():

@@ -1,0 +1,510 @@
+"""Serving request-lifecycle tracing (PR 15; docs/OBSERVABILITY.md §11).
+
+Pins the contracts of the request-trace plane:
+
+- the assembler merges every routing attempt of one request — including
+  failover hops across replicas and client retries that re-send the same
+  ``request_id`` under a fresh trace — into ONE request round with an
+  attempt chain, and checks the exactly-once commit (exactly one
+  ``forwarded`` attempt); sheds and drains assemble as terminated rounds
+  carrying their verdict;
+- a live direct request leaves the full span set (request root,
+  queue_wait, admission, prefill, decode_iter, retire) in one trace and
+  its ack metadata carries the replica-measured TTFT/TPOT;
+- per-slot TPOT (satellite 1): two co-resident requests with UNEQUAL
+  token budgets each get their own decode-interval observations — the
+  tier-labeled histogram gains exactly one sample per slot per dispatch
+  it emitted in, not one conflated sample per batch dispatch;
+- chaos (FaultPlan reset mid-decode): a replica killed under the router
+  yields zero orphan spans and ONE assembled round per request, spanning
+  both replicas with ``retries >= 1`` and a single forwarded attempt;
+- the router is a fleet citizen: ``snapshot()["fleet"]["router"]``
+  reconciles EXACTLY with the ``router_*`` counters, and
+  ``dump --fleet`` renders the row from the run dir alone;
+- per-tier TTFT/TPOT SLO bands are edge-triggered and histogram-gated
+  (``min_count``), and ``dump --requests`` attributes per-tier latencies
+  from a run dir's ``spans.jsonl`` alone.
+
+Tiny CPU transformer; deliberately NOT in conftest's slow set — tier-1
+exercises the request-trace plane every run.
+"""
+
+import itertools
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distriflow_tpu.client import InferenceClient, RequestShed
+from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+from distriflow_tpu.fleet import FleetRouter, RouterClient, page_hashes
+from distriflow_tpu.models.generate import generate
+from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+from distriflow_tpu.obs.health import HealthSentinel, default_bands
+from distriflow_tpu.obs.telemetry import Telemetry
+from distriflow_tpu.obs.trace_assembler import assemble, render_requests
+from distriflow_tpu.server import InferenceServer
+from distriflow_tpu.utils.config import ServingConfig
+
+pytestmark = pytest.mark.reqtrace
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=48,
+    dtype=jnp.float32, use_flash_attention=False,
+)
+PS = 16
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer_lm(CFG, example_seq=16).init(jax.random.PRNGKey(0))
+
+
+def _prompt(seed, plen=33, batch=1):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, CFG.vocab_size, size=(batch, plen)).astype(np.int32)
+
+
+def _solo(params, prompt, n):
+    return np.asarray(generate(CFG, dict(params), prompt, n))
+
+
+def _hcount(tel, ident):
+    return tel.snapshot()["histograms"].get(ident, {}).get("count", 0)
+
+
+# -- synthetic assembler rounds (no server) --------------------------------
+
+_SEQ = itertools.count()
+
+
+def _row(name, tid, t0, dur_ms=1.0, **attrs):
+    """One synthetic span row in the tracer's on-disk schema; start==mono
+    puts every row in the same zero-offset clock domain."""
+    base = {"name": name, "trace_id": tid, "span_id": f"s{next(_SEQ):04d}",
+            "parent_id": None, "start": t0, "mono": t0, "pid": 7,
+            "dur_ms": dur_ms, "status": "ok"}
+    base.update(attrs)
+    return base
+
+
+def _failover_rows(tid="t-fail", rid="r-1"):
+    """A failed attempt on A, the forwarded retry on B, and B's engine
+    spans — the canonical one-request failover timeline."""
+    return [
+        _row("request", tid, 100.000, 600.0, op="generate", tier=0),
+        _row("route", tid, 100.010, 50.0, verdict="failover:ConnectionLost",
+             policy="affinity", replica="A", request_id=rid, tier=0),
+        _row("route", tid, 100.070, 500.0, verdict="forwarded",
+             policy="affinity", replica="B", request_id=rid, tier=0,
+             ttft_ms=80.0, tpot_ms=9.5),
+        _row("queue_wait", tid, 100.080, 20.0, request_id=rid, tier=0),
+        _row("admission", tid, 100.100, 30.0, request_id=rid, tier=0),
+        _row("prefill", tid, 100.130, 60.0, request_id=rid, tier=0),
+        _row("decode_iter", tid, 100.200, 150.0, request_id=rid, tier=0),
+        _row("decode_iter", tid, 100.360, 150.0, request_id=rid, tier=0),
+        _row("retire", tid, 100.550, 0.0, request_id=rid, tier=0,
+             outcome="complete", ttft_ms=80.0, tpot_ms=9.5),
+    ]
+
+
+def test_assembler_failover_merges_one_round():
+    asm = assemble(_failover_rows())
+    assert asm.orphans == [] and len(asm.rounds) == 1
+    r = asm.rounds[0]
+    assert r.kind == "request" and r.applied
+    assert r.retries == 1 and r.apply_spans == 1  # exactly-once commit
+    assert r.attrs["verdict"] == "forwarded"
+    assert r.attrs["tier"] == 0 and r.attrs["request_id"] == "r-1"
+    assert r.attrs["replicas"] == ["A", "B"]
+    assert [a["verdict"] for a in r.attrs["attempts"]] == [
+        "failover:ConnectionLost", "forwarded"]
+    # the forwarded route echoed the replica-measured SLO latencies, so a
+    # router-run-dir-only span set still attributes them
+    assert r.attrs["ttft_ms"] == 80.0 and r.attrs["tpot_ms"] == 9.5
+    assert "prefill" in r.phases and "decode_iter" in r.phases
+    assert r.wall_ms > 0
+
+
+def test_assembler_double_commit_is_not_applied():
+    """Two forwarded attempts = the exactly-once contract broken: the
+    round must assemble as NOT applied so the violation is loud."""
+    rows = _failover_rows()
+    rows.append(_row("route", "t-fail", 100.600, 10.0, verdict="forwarded",
+                     policy="affinity", replica="A", request_id="r-1",
+                     tier=0))
+    asm = assemble(rows)
+    assert len(asm.rounds) == 1
+    assert not asm.rounds[0].applied
+    assert asm.rounds[0].apply_spans == 2
+
+
+def test_assembler_shed_is_terminated_round():
+    tid = "t-shed"
+    rows = [
+        _row("request", tid, 200.0, 5.0, op="generate", tier=2,
+             status="error:RequestShed"),
+        _row("route", tid, 200.001, 0.1, verdict="shed", policy="affinity",
+             replica=None, request_id="r-shed", tier=2, queue_depth=3),
+    ]
+    asm = assemble(rows)
+    assert len(asm.rounds) == 1
+    r = asm.rounds[0]
+    assert r.kind == "request" and not r.applied
+    assert r.attrs["verdict"] == "shed" and r.attrs["tier"] == 2
+    agg = asm.request_attribution()
+    assert agg["tiers"][2]["shed"] == 1
+    assert agg["tiers"][2]["committed"] == 0
+
+
+def test_assembler_request_id_merges_fresh_traces():
+    """A client retry re-sends the same request_id under a NEW trace
+    (fresh root span); both traces describe the one answered request and
+    must assemble into one round — the §11 idempotency-key merge."""
+    rid = "r-retry"
+    rows = [
+        _row("request", "t-first", 300.0, 40.0, op="generate", tier=1,
+             status="error:AckTimeout"),
+        _row("route", "t-first", 300.001, 30.0,
+             verdict="failover:AckTimeout", policy="affinity", replica="A",
+             request_id=rid, tier=1),
+        _row("request", "t-second", 300.1, 200.0, op="generate", tier=1),
+        _row("route", "t-second", 300.101, 180.0, verdict="forwarded",
+             policy="affinity", replica="B", request_id=rid, tier=1,
+             ttft_ms=42.0),
+        _row("retire", "t-second", 300.290, 0.0, request_id=rid, tier=1,
+             outcome="complete", ttft_ms=42.0, tpot_ms=3.0),
+    ]
+    asm = assemble(rows)
+    assert len(asm.rounds) == 1
+    r = asm.rounds[0]
+    assert r.applied and r.retries == 1 and r.apply_spans == 1
+    assert len(r.attrs["attempts"]) == 2
+    assert r.span_count == 5
+
+
+def test_render_requests_attempt_chain_and_tier_table():
+    rows = _failover_rows() + [
+        _row("request", "t-shed", 200.0, 5.0, op="generate", tier=2,
+             status="error:RequestShed"),
+        _row("route", "t-shed", 200.001, 0.1, verdict="shed",
+             policy="affinity", replica=None, request_id="r-s", tier=2),
+    ]
+    lines = render_requests(assemble(rows))
+    assert lines[0].startswith("requests: 2 assembled, 1 committed")
+    body = "\n".join(lines)
+    assert "A[failover:ConnectionLost] -> B[forwarded]" in body
+    assert "per-tier SLO attribution:" in body
+    assert "ttft=80.0ms" in body
+    # tier filter narrows the per-request listing, keeps the table
+    t2 = "\n".join(render_requests(assemble(rows), tier=2))
+    assert "shed" in t2 and "forwarded" not in t2.split("per-tier")[0]
+
+
+# -- live engine spans + per-slot TPOT -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served_traced(params):
+    """One slab-layout replica sharing a PRIVATE telemetry with its
+    clients, so request traces land in a single tracer. decode_chunk=2
+    makes token-budget math cheap; the wide window co-admits the
+    unequal-length TPOT pair."""
+    tel = Telemetry()
+    server = InferenceServer(
+        CFG, params, port=0, telemetry=tel,
+        serving=ServingConfig(batch_window_s=0.4, decode_chunk=2,
+                              max_slots=4),
+    ).setup()
+    yield server, tel
+    server.stop()
+
+
+def test_direct_request_span_set_and_slo_meta(served_traced, params):
+    server, tel = served_traced
+    prompt = _prompt(1, plen=6)
+    with InferenceClient(server.address, telemetry=tel) as c:
+        out = c.generate(prompt, 5, request_id="direct-1")
+        meta = c.last_serving_meta
+    assert np.array_equal(out, _solo(params, prompt, 5))
+    assert meta["ttft_ms"] > 0 and meta["tpot_ms"] > 0
+    tid = tel.tracer.finished("request")[-1]["trace_id"]
+    rows = [r for r in tel.tracer.finished() if r.get("trace_id") == tid]
+    names = {r["name"] for r in rows}
+    assert {"request", "queue_wait", "admission", "prefill", "decode_iter",
+            "retire"} <= names
+    # every engine span is attributed to the request and its tier
+    for r in rows:
+        if r["name"] != "request":
+            assert r["request_id"] == "direct-1" and r["tier"] == 0
+    retire = [r for r in rows if r["name"] == "retire"]
+    assert len(retire) == 1 and retire[0]["outcome"] == "complete"
+    assert retire[0]["ttft_ms"] == meta["ttft_ms"]
+    asm = assemble(rows)
+    assert len(asm.rounds) == 1
+    r = asm.rounds[0]
+    assert r.kind == "request" and r.applied
+    assert r.attrs["verdict"] == "complete"
+    assert r.attrs["ttft_ms"] == meta["ttft_ms"]
+    assert "prefill" in r.phases and "decode_iter" in r.phases
+
+
+def test_per_slot_tpot_unequal_budgets(served_traced, params):
+    """Satellite 1 pin: two co-resident requests, budgets 5 and 9,
+    decode_chunk=2. Per-slot decode-interval TPOT observes once per slot
+    per dispatch it emitted in — (5-1)/2 + (9-1)/2 = 2 + 4 = 6 samples —
+    where the old batch-level observe produced one conflated sample per
+    dispatch (4) regardless of who was resident."""
+    server, tel = served_traced
+    ttft_id = "serving_ttft_ms{tier=0}"
+    tpot_id = "serving_time_per_output_token_ms{tier=0}"
+    ttft0, tpot0 = _hcount(tel, ttft_id), _hcount(tel, tpot_id)
+    batches0 = server.decode_batches
+    prompts = [_prompt(11, plen=6), _prompt(12, plen=6)]
+    budgets = [5, 9]
+    results = [None, None]
+    errors = []
+    barrier = threading.Barrier(2)
+
+    def run(i):
+        try:
+            with InferenceClient(server.address, telemetry=tel) as c:
+                barrier.wait()
+                results[i] = (c.generate(prompts[i], budgets[i]),
+                              dict(c.last_serving_meta))
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for i in (0, 1):
+        out, meta = results[i]
+        assert np.array_equal(out, _solo(params, prompts[i], budgets[i]))
+        assert meta["ttft_ms"] > 0 and meta["tpot_ms"] > 0
+    assert _hcount(tel, ttft_id) - ttft0 == 2
+    assert _hcount(tel, tpot_id) - tpot0 == 6
+    # <= 5 dispatches proves the two requests shared decode iterations
+    # (separate admissions would cost 2 + 4 = 6)
+    assert server.decode_batches - batches0 <= 5
+
+
+# -- chaos: failover trace integrity over a live fleet ---------------------
+
+
+def _replica(params, telemetry, **serving_kw):
+    kw = dict(batch_window_s=0.05, decode_chunk=4, kv_layout="paged",
+              page_size=PS, max_slots=2, page_pool_pages=24)
+    kw.update(serving_kw)
+    return InferenceServer(CFG, params, port=0, telemetry=telemetry,
+                           serving=ServingConfig(**kw)).setup()
+
+
+@pytest.fixture()
+def fleet_traced(params, tmp_path):
+    """Two paged replicas + router + clients all sharing ONE telemetry
+    (cross-endpoint traces land in a single tracer, streamed to the run
+    dir for the dump tests) plus a router factory."""
+    tel = Telemetry(save_dir=str(tmp_path))
+    sa = _replica(params, tel)
+    sb = _replica(params, tel)
+    made = []
+
+    def mk_router(**kw):
+        plan_a = kw.pop("fault_plan_a", None)
+        kw.setdefault("stats_interval_s", 0.0)
+        kw.setdefault("redial", False)
+        kw.setdefault("telemetry", tel)
+        router = FleetRouter(port=0, **kw)
+        router.add_replica(sa.address, name="A", fault_plan=plan_a)
+        router.add_replica(sb.address, name="B")
+        made.append(router)
+        return router.setup()
+
+    yield sa, sb, tel, str(tmp_path), mk_router
+    for router in made:
+        router.stop()
+    sa.stop()
+    sb.stop()
+
+
+def test_chaos_failover_assembles_one_round_per_request(
+        fleet_traced, params):
+    """FaultPlan reset mid-decode + failover: every request — including
+    the two that lost replica A — assembles into exactly ONE round
+    spanning both replicas with a single forwarded attempt, zero orphan
+    spans, and ``dump --requests`` attributes the tier from the run dir
+    alone."""
+    sa, _sb, tel, run_dir, mk_router = fleet_traced
+    plan = FaultPlan(seed=13, schedule=[
+        ScriptedFault(event="generate", nth=3, action="reset")])
+    router = mk_router(policy="affinity", fault_plan_a=plan)
+    shared = _prompt(70)
+    with RouterClient(router.address, telemetry=tel) as c:
+        c.generate(shared, 3)  # 1st on A: warms the affinity map
+        assert c.last_replica == "A"
+        results = {}
+        long_prompt = shared[:, :17]
+
+        def long_decode():
+            with RouterClient(router.address, telemetry=tel) as cl:
+                results["long"] = (cl.generate(long_prompt, 31, seed=0),
+                                   cl.last_route)
+
+        t = threading.Thread(target=long_decode)
+        t.start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:  # wait until A is mid-decode
+            if any(r is not None for r in sa._slot_req):
+                break
+            time.sleep(0.002)
+        # 3rd generate on A: the scripted reset tears the connection out
+        # from under the in-flight long decode too
+        out = c.generate(shared, 5)
+        t.join(timeout=120.0)
+        assert not t.is_alive()
+        assert c.last_replica == "B" and c.last_route["failovers"] >= 1
+        assert np.array_equal(out, _solo(params, shared, 5))
+        long_out, long_route = results["long"]
+        assert long_route["replica"] == "B"
+        assert np.array_equal(long_out, _solo(params, long_prompt, 31))
+
+    asm = assemble(tel.tracer.finished())
+    assert asm.orphans == []
+    reqs = asm.requests()
+    assert len(reqs) == 3  # one round per request, failovers merged in
+    assert len({r.attrs["request_id"] for r in reqs}) == 3
+    for r in reqs:
+        assert r.applied and r.apply_spans == 1  # exactly-once commit
+        assert r.attrs["attempts"][-1]["verdict"] == "forwarded"
+    failed_over = [r for r in reqs if r.retries >= 1]
+    assert len(failed_over) == 2  # the 3rd generate and the long decode
+    for r in failed_over:
+        assert r.attrs["replicas"] == ["A", "B"]
+    assert sum(r.retries for r in reqs) == float(
+        tel.counter_value("router_failovers_total"))
+    # the run dir alone reproduces the attribution (dump --requests)
+    from distriflow_tpu.obs.dump import summarize_requests
+    lines = summarize_requests(run_dir)
+    body = "\n".join(lines)
+    assert "3 assembled, 3 committed, 0 orphan span(s)" in body
+    assert "per-tier SLO attribution:" in body
+    assert "B[forwarded]" in body
+
+
+def test_shed_verdict_wrong_hint_and_fleet_row(fleet_traced, params):
+    """shed_depth={2: -1} sheds tier 2 at depth 0 (no saturation threads
+    needed): the shed assembles as a terminated round carrying the
+    verdict; a poisoned affinity hint still yields a complete,
+    bit-identical trace; and the router's fleet row reconciles EXACTLY
+    with its counters, all the way through ``dump --fleet``."""
+    _sa, _sb, tel, run_dir, mk_router = fleet_traced
+    router = mk_router(policy="affinity", shed_depth={2: -1})
+    prompt = _prompt(50)
+    with RouterClient(router.address, tier=2, telemetry=tel) as c:
+        with pytest.raises(RequestShed) as exc:
+            c.generate(prompt, 3)
+        assert exc.value.tier == 2
+        out = c.generate(prompt, 3, tier=0)  # tier 0 has no threshold
+        assert np.array_equal(out, _solo(params, prompt, 3))
+    # wrong-affinity hint: claim B holds a prefix it has never seen
+    hinted = _prompt(21)
+    router.registry.learn("B", page_hashes(hinted[0], PS))
+    with RouterClient(router.address, telemetry=tel) as c:
+        out = c.generate(hinted, 5)
+        assert c.last_replica == "B"
+        assert c.last_route["affinity_depth"] == 2
+        assert np.array_equal(out, _solo(params, hinted, 5))
+        hint_tid = tel.tracer.finished("request")[-1]["trace_id"]
+
+    asm = assemble(tel.tracer.finished())
+    assert asm.orphans == []
+    reqs = asm.requests()
+    shed = [r for r in reqs if r.attrs["verdict"] == "shed"]
+    assert len(shed) == 1
+    assert not shed[0].applied and shed[0].attrs["tier"] == 2
+    attempts = shed[0].attrs["attempts"]
+    assert len(attempts) == 1 and attempts[0]["verdict"] == "shed"
+    assert attempts[0]["replica"] is None
+    hint_round = next(r for r in reqs if r.trace_id == hint_tid)
+    assert hint_round.applied and hint_round.retries == 0
+    assert hint_round.attrs["verdict"] == "forwarded"
+    assert "prefill" in hint_round.phases  # replica spans joined the trace
+
+    # satellite 2: the router's fleet row, counter-exact
+    row = tel.snapshot()["fleet"]["router"]
+    assert row["role"] == "router" and row["policy"] == "affinity"
+    assert row["requests"] == 2 == int(sum(
+        tel.counter_value("router_requests_total", tier=str(t))
+        for t in (0, 1, 2)))
+    assert row["shed"] == 1 == int(
+        tel.counter_value("router_shed_total", tier="2"))
+    assert row["goodput"] == 2 == int(sum(
+        tel.counter_value("router_goodput_total", tier=str(t))
+        for t in (0, 1, 2)))
+    assert row["failovers"] == 0 and row["replicas_live"] == 2
+    assert row["affinity_hits"] == int(
+        tel.counter_value("router_affinity_hits_total"))
+    fleet = tel.snapshot()["fleet"]
+    assert fleet["A"]["role"] == "replica"
+    assert fleet["B"]["role"] == "replica"
+    # and the rendered fleet view from the run dir shows the front door
+    tel.export_snapshot()
+    from distriflow_tpu.obs.dump import summarize_fleet
+    body = "\n".join(summarize_fleet(run_dir))
+    assert "role=router" in body and "role=replica" in body
+
+
+# -- per-tier SLO bands + dump surfaces ------------------------------------
+
+
+def test_tier_slo_bands_edge_triggered():
+    tel = Telemetry()
+    h = tel.histogram("serving_ttft_ms", tier="0")
+    sentinel = HealthSentinel(tel, bands=default_bands(
+        ttft_p99_ms={0: 100.0}, tpot_p99_ms={0: 50.0}, slo_min_count=4))
+    for _ in range(3):
+        h.observe(10.0)
+    assert sentinel.check() == []  # below min_count: unknown, no breach
+    h.observe(10.0)
+    assert sentinel.check() == []  # judged, healthy
+    for _ in range(4):
+        h.observe(400.0)
+    entered = sentinel.check()
+    assert [e["band"] for e in entered] == ["ttft_p99_tier0"]
+    assert entered[0]["metric"] == "serving_ttft_ms"
+    assert entered[0]["observed"] > 100.0
+    assert sentinel.check() == []  # edge-triggered: staying in breach is
+    assert sentinel.breached() == ["ttft_p99_tier0"]  # not a new event
+    assert tel.counter_value("obs_slo_breach_total",
+                             band="ttft_p99_tier0") == 1.0
+    # the TPOT band never saw a sample: unknown, never breached
+    assert tel.counter_value("obs_slo_breach_total",
+                             band="tpot_p99_tier0") == 0.0
+
+
+def test_dump_requests_from_spans_file(tmp_path):
+    """``dump --requests`` end to end: stream the canonical failover
+    timeline through a save_dir tracer, then summarize the run dir."""
+    tel = Telemetry(save_dir=str(tmp_path))
+    for r in _failover_rows():
+        attrs = {k: v for k, v in r.items()
+                 if k not in ("name", "trace_id", "span_id", "parent_id",
+                              "start", "mono", "dur_ms", "pid", "status")}
+        tel.tracer.emit(r["name"], trace_id=r["trace_id"],
+                        dur_ms=r["dur_ms"], start=r["start"],
+                        mono=r["mono"], **attrs)
+    from distriflow_tpu.obs.dump import summarize_requests
+    body = "\n".join(summarize_requests(str(tmp_path)))
+    assert "1 assembled, 1 committed, 0 orphan span(s)" in body
+    assert "A[failover:ConnectionLost] -> B[forwarded]" in body
+    assert "per-tier SLO attribution:" in body
+    # tier filter: no tier-1 requests in this set
+    t1 = "\n".join(summarize_requests(str(tmp_path), tier=1))
+    assert "(showing tier 1: 0)" in t1
