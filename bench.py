@@ -848,6 +848,47 @@ def bench_obs_overhead():
     }
 
 
+def bench_fleet_soak():
+    """Fleet soak row (docs/ROBUSTNESS.md §10): the churn+chaos soak
+    harness at a fixed seed — goodput (applies/sec of wall), the fleet
+    p99 round time, and the adaptive-controller action count. The run
+    itself enforces exactness (exactly-once accounting, fleet-vs-local
+    telemetry reconciliation, convergence vs the serial baseline) and
+    raises on any violation, so a row existing at all certifies the
+    invariants; the ledger then pins the PERFORMANCE of surviving the
+    abuse. Numpy-only clients — no jit, so the numbers move with host
+    scheduling, not compilation."""
+    from distriflow_tpu.fleet import SoakConfig, run_soak
+
+    n_clients = 24 if (FAST or SLOW) else 64
+    result = run_soak(SoakConfig(
+        n_clients=n_clients,
+        n_batches=60 if (FAST or SLOW) else 150,
+        epochs=2, churn_kills=4 if (FAST or SLOW) else 8,
+        timeout_s=min(180.0, max(60.0, time_left())),
+    ))
+    log(f"#soak fleet_soak: {result.applied} applies over "
+        f"{result.n_clients} clients in {result.wall_s:.1f}s "
+        f"({result.goodput_applies_per_s:.0f}/s), {result.kills} kills, "
+        f"{result.deduped} dedup, {result.suppressed} suppressed, "
+        f"{result.adaptations} adaptations")
+    return {
+        "config": "fleet_soak",
+        "metric": "soak goodput under churn+chaos (applies/sec)",
+        "value": round(result.goodput_applies_per_s, 1),
+        "clients": result.n_clients,
+        "goodput_applies_per_s": round(result.goodput_applies_per_s, 1),
+        "round_p99_ms": round(result.round_p99_ms, 2),
+        "ack_p99_ms": round(result.ack_p99_ms, 2),
+        "kills": result.kills,
+        "rejoins": result.rejoins,
+        "deduped": result.deduped,
+        "suppressed": result.suppressed,
+        "adaptations": result.adaptations,
+        "final_loss": round(result.final_loss, 5),
+    }
+
+
 # -- config #5: MobileNetV2 (synthetic ImageNet-subset) --------------------
 
 
@@ -2301,6 +2342,7 @@ def main() -> None:
     run(bench_cifar_async, matrix)  # reads the cifar sync row for pct
     run(bench_fedavg)
     run(bench_obs_overhead)
+    run(bench_fleet_soak)
     if not FAST:
         run(bench_mobilenet, n_chips)
 

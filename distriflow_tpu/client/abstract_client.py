@@ -326,6 +326,28 @@ class AbstractClient:
         if self.transport is not None:
             self.transport.close()
 
+    def abort(self) -> None:
+        """Abrupt kill (chaos/soak churn): no goodbye, no upload drain —
+        the in-process stand-in for a worker crash. The connection just
+        dies; the server learns via EOF (or heartbeat timeout) and
+        requeues the outstanding window. Unlike :meth:`dispose`, anything
+        riding the upload pipeline is abandoned mid-flight — which is
+        exactly the case the server's lease/requeue/dedup machinery must
+        absorb."""
+        self._disposed = True  # suppresses on_server_lost -> reconnect
+        self._transport_ready.clear()
+        transport = self.transport
+        if transport is not None:
+            transport.close()
+        # reap the comm thread WITHOUT draining: queued uploads fail fast
+        # against the closed transport (the loop parks them as comm
+        # errors), and the thread exits on the sentinel
+        thread = self._comm_thread
+        if thread is not None:
+            self._comm_q.put(None)
+            thread.join(timeout=5.0)
+            self._comm_thread = None
+
     # -- upload pipeline (inflight_window > 1) -------------------------------
 
     def inflight_window(self) -> int:

@@ -289,6 +289,38 @@ PAYLOADS["direct_ack"] = _payload(
     WireField("trace_id"),
 )
 
+#: per-client hyperparam override (server adaptive controller ->
+#: AbstractServer.set_client_hyperparams -> DownloadMsg.hyperparams merge).
+#: A sparse patch over ClientHyperparams: every key optional, only the
+#: knobs the controller actually moved are present.  The merged result is
+#: validated against ClientHyperparams before it ever reaches the wire.
+PAYLOADS["hyperparam_override"] = _payload(
+    "hyperparam_override", 1,
+    WireField("batch_size"),
+    WireField("learning_rate"),
+    WireField("epochs"),
+    WireField("examples_per_update"),
+    WireField("gradient_compression"),
+    WireField("topk_fraction"),
+    WireField("inflight_window"),
+    WireField("telemetry_report_interval_s"),
+)
+
+#: one adaptive-controller decision (fleet/controller.py action log +
+#: doctor/bench assertions).  ``client`` is absent for fleet-wide actions
+#: (dispatch-window cap moves); ``observed`` echoes the breach detail that
+#: triggered the move.
+PAYLOADS["controller_action"] = _payload(
+    "controller_action", 1,
+    WireField("action", required=True),
+    WireField("band", required=True),
+    WireField("client"),
+    WireField("knob"),
+    WireField("old"),
+    WireField("new"),
+    WireField("observed"),
+)
+
 #: dftp-flat per-leaf metadata — version 1 is dense-only; version 2 adds the
 #: sparse leaf variant (encoding="sparse" + index chunk).  The v2 fields are
 #: ``since=2`` so readers must guard on ``encoding`` before touching them.

@@ -546,6 +546,61 @@ def main() -> int:
     ok &= _check("fleet telemetry drill (wire reports + straggler band)",
                  fleet_telemetry)
 
+    def fleet_soak():
+        """Soak drill (docs/ROBUSTNESS.md §10), two legs over the fleet
+        soak harness. Leg A (clean): a seeded heterogeneous fleet with
+        abrupt churn must quiesce with EXACT accounting — applied +
+        rejected == total completions, model version == applies, zero
+        leaked leases/outstanding batches, fleet telemetry totals equal
+        to the sum of every client's local counters — and take zero
+        controller actions. Chaos stays off in this leg: fault-injected
+        resets/retries stall a round for whole seconds, which IS a
+        transient straggler the controller is entitled to steer (the
+        tier-1 soak test covers chaos reconciliation and lets the
+        controller act); "clean" here pins the converse — no straggler,
+        no adaptation. Leg B
+        (scripted straggler): one client fits 8x slow for its first
+        three batches; the straggler band must trip, the controller must
+        push exactly one per-client adaptation, the band must clear on
+        recovery and ramp the override back — with the same exact
+        reconciliation at the end."""
+        from distriflow_tpu.fleet import SoakConfig, run_soak
+
+        with tempfile.TemporaryDirectory() as d:
+            clean = run_soak(SoakConfig(
+                n_clients=12, n_batches=48, epochs=2, churn_kills=2,
+                chaos=False, fit_delay_range_s=(0.01, 0.02),
+                straggler_factor=50.0,  # scheduler-jitter headroom on loaded boxes
+                save_dir=d, timeout_s=90))
+        assert clean.errors == [], clean.errors
+        assert clean.adaptations == 0, (
+            f"clean leg took {clean.adaptations} controller actions: "
+            f"{clean.actions}")
+        assert clean.reconcile_ok and clean.rejoins == clean.kills
+        with tempfile.TemporaryDirectory() as d:
+            strag = run_soak(SoakConfig(
+                n_clients=6, n_batches=120, epochs=2, chaos=False,
+                churn_kills=0, straggler_slow_fits=3,
+                straggler_slow_mult=8.0, fit_delay_range_s=(0.015, 0.025),
+                straggler_factor=3.0, recovery_checks=2,
+                poll_interval_s=0.05, save_dir=d, timeout_s=90))
+        assert strag.errors == [], strag.errors
+        assert strag.adaptations == 1, (
+            f"straggler leg: {strag.adaptations} adaptations "
+            f"(want exactly 1): {strag.actions}")
+        assert strag.ramps >= 1 and strag.overrides_active == 0, (
+            "override never ramped back")
+        assert strag.hparam_pushes >= 2  # the adapt push + the clear push
+        assert strag.reconcile_ok
+        return (f"clean: {clean.applied}/{clean.total_batches} applies, "
+                f"{clean.kills} kills rejoined, 0 adaptations, "
+                f"{clean.counter_idents} counter idents reconcile exactly; "
+                f"straggler: 1 adaptation pushed + ramped back, "
+                f"goodput {strag.goodput_applies_per_s:.0f} applies/s")
+
+    ok &= _check("fleet soak drill (churn exactness + adaptive "
+                 "controller)", fleet_soak)
+
     def fleet_failover():
         """Fleet-router drill (docs/PERFORMANCE.md §7h): two paged
         replicas behind an affinity router. Clean phase: ten

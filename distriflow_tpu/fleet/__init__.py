@@ -1,21 +1,41 @@
-"""Serving fleet: an affinity-aware router over N inference replicas.
+"""Fleet plane: serving router + training-fleet robustness harnesses.
 
 Round 13 (docs/PERFORMANCE.md §7h): ``FleetRouter`` fronts independent
 ``InferenceServer`` replicas with prefix-affinity routing (the shared
 chain hash in ``prefix_hash.py``), SLO-tiered admission with queue-depth
 shedding, and drain/failover over request-id idempotency.
+
+Round 16 (docs/ROBUSTNESS.md §10): ``run_soak`` drives hundreds of
+simulated training clients through churn + chaos and audits exactly-once
+accounting and convergence at quiescence; ``AdaptiveController`` closes
+the telemetry loop by pushing per-client hyperparam overrides and a
+fleet-wide dispatch-window cap on SLO breaches.
 """
 
 from distriflow_tpu.fleet.client import RouterClient
+from distriflow_tpu.fleet.controller import AdaptiveController
 from distriflow_tpu.fleet.prefix_hash import page_hashes, shareable_pages
 from distriflow_tpu.fleet.registry import ReplicaRegistry, ReplicaState
 from distriflow_tpu.fleet.router import FleetRouter
+from distriflow_tpu.fleet.soak import (
+    SoakConfig,
+    SoakError,
+    SoakModel,
+    SoakResult,
+    run_soak,
+)
 
 __all__ = [
+    "AdaptiveController",
     "FleetRouter",
     "RouterClient",
     "ReplicaRegistry",
     "ReplicaState",
+    "SoakConfig",
+    "SoakError",
+    "SoakModel",
+    "SoakResult",
     "page_hashes",
+    "run_soak",
     "shareable_pages",
 ]

@@ -179,16 +179,27 @@ class TelemetryCollector:
     executor) and the heartbeat hook concurrently.
     """
 
-    def __init__(self, telemetry: Any = None, fleet: Any = None):
+    #: per-client state entries kept (LRU by last ingest): at hundreds of
+    #: churning clients, state for departed clients must age out, not grow
+    #: forever. Must exceed the number of LIVE stable clients — evicting a
+    #: client that later reports a delta loses its un-refreshed idents from
+    #: the fleet totals until its next full snapshot.
+    MAX_CLIENTS = 1024
+
+    def __init__(self, telemetry: Any = None, fleet: Any = None,
+                 max_clients: Optional[int] = None):
         if telemetry is None:
             from distriflow_tpu.obs.telemetry import get_telemetry
             telemetry = get_telemetry()
         self.telemetry = telemetry
         self.fleet = fleet  # FleetTable to fold per-client rows into
+        self.max_clients = max_clients if max_clients is not None else self.MAX_CLIENTS
         self._lock = threading.Lock()
         # per-client replace-not-add state: seq high-water + latest
-        # cumulative maps (counters/gauges/hists keyed by ident)
-        self._clients: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        # cumulative maps (counters/gauges/hists keyed by ident), bounded
+        # LRU on last-ingest order
+        self._clients: "collections.OrderedDict[str, Dict[str, Any]]" = \
+            collections.OrderedDict()  # guarded-by: _lock
         # span_ids already written (bounded): retries/duplicates and the
         # shared-Telemetry loopback case must not duplicate rows
         self._span_seen: "collections.OrderedDict[str, None]" = \
@@ -197,9 +208,11 @@ class TelemetryCollector:
         self.reports_ingested = 0  # guarded-by: _lock
         self.full_reports = 0  # guarded-by: _lock
         self.stale_dropped = 0  # guarded-by: _lock
+        self.clients_evicted = 0  # guarded-by: _lock
         self._c_reports = telemetry.counter("fleet_reports_total")
         self._c_full = telemetry.counter("fleet_reports_full_total")
         self._c_stale = telemetry.counter("fleet_reports_stale_total")
+        self._c_evicted = telemetry.counter("fleet_clients_evicted_total")
 
     # -- ingest -------------------------------------------------------------
 
@@ -248,6 +261,19 @@ class TelemetryCollector:
                 else set(report.get("counters") or {})
             changed_g = set(st["gauges"]) if full \
                 else set(report.get("gauges") or {})
+            # bounded LRU: this client is freshest; evict the stalest
+            # beyond capacity and re-sum everything they contributed so
+            # the fleet/* aggregates drop their share
+            self._clients.move_to_end(cid)
+            evicted = 0
+            while len(self._clients) > self.max_clients:
+                _, old = self._clients.popitem(last=False)
+                changed_c |= set(old["counters"])
+                changed_g |= set(old["gauges"])
+                evicted += 1
+            self.clients_evicted += evicted
+        for _ in range(evicted):
+            self._c_evicted.inc()
         self._c_reports.inc()
         self._refresh_fleet_gauges(changed_c, changed_g)
         self._fold_fleet_row(cid, str(client_id))

@@ -60,6 +60,7 @@ def default_bands(*, mfu_floor: Optional[float] = None,
                   router_min_replicas: Optional[float] = None,
                   ttft_p99_ms: Optional[Mapping[int, float]] = None,
                   tpot_p99_ms: Optional[Mapping[int, float]] = None,
+                  controller_overrides_max: Optional[float] = None,
                   slo_min_count: int = 1) -> List[SLOBand]:
     """The stock bands from docs/OBSERVABILITY.md §6; pass only the
     thresholds you want enforced.
@@ -108,6 +109,14 @@ def default_bands(*, mfu_floor: Optional[float] = None,
                              "p99", {"tier": str(int(t))},
                              upper=float(ceiling),
                              min_count=int(slo_min_count)))
+    if controller_overrides_max is not None:
+        # adaptive-control saturation: many clients pinned on per-client
+        # override patches means the fleet is degraded beyond what
+        # per-client steering can absorb — page a human, don't keep
+        # turning knobs (docs/ROBUSTNESS.md §10)
+        bands.append(SLOBand("controller_saturation",
+                             "controller_overrides_active",
+                             "value", {}, upper=controller_overrides_max))
     return bands
 
 

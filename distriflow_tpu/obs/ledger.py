@@ -132,6 +132,28 @@ BANDS: Dict[str, Dict[str, Dict[str, float]]] = {
         "shed": {"warn_pct": 1e9, "regress_pct": 1e9},
         "failovers": {"warn_pct": 1e9, "regress_pct": 1e9},
     },
+    "fleet_soak": {
+        # churn+chaos soak row (docs/ROBUSTNESS.md §10): the run itself
+        # enforces the exactness invariants (it raises on violation), so
+        # the ledger only pins the performance of surviving the abuse.
+        # Goodput ("value") is loopback wall time over hundreds of
+        # threads on a shared host — guarded loosely; the p99 latencies
+        # likewise. Churn/dedup/suppression/adaptation counts are
+        # seeded-schedule structure, not performance — advisory-only —
+        # and final_loss moves with apply interleaving, bounded by the
+        # in-run convergence audit rather than the ledger.
+        "value": {"warn_pct": 40.0, "regress_pct": 100.0},
+        "goodput_applies_per_s": {"warn_pct": 40.0, "regress_pct": 100.0},
+        "round_p99_ms": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "ack_p99_ms": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "clients": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "kills": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "rejoins": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "deduped": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "suppressed": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "adaptations": {"warn_pct": 1e9, "regress_pct": 1e9},
+        "final_loss": {"warn_pct": 1e9, "regress_pct": 1e9},
+    },
     "cifar10_convnet_async_bounded_staleness": {
         # round-6 semantic change: floor_ms/ceiling_sps are now derived
         # from the continuous profiler's phase digests (per-upload

@@ -165,6 +165,7 @@ class AbstractServer:
         self._c_down_delta = self.telemetry.counter("comm_broadcasts_delta_total", role="server")
         self._c_down_full = self.telemetry.counter("comm_broadcasts_full_total", role="server")
         self._c_resyncs = self.telemetry.counter("comm_resyncs_total", role="server")
+        self._c_hparam_pushes = self.telemetry.counter("server_hparam_pushes_total")
         self._g_apply_queue = self.telemetry.gauge("comm_apply_queue_depth")
         # continuous phase profiler (docs/OBSERVABILITY.md §5): the upload
         # lifecycle decomposes into decode / quarantine / apply / broadcast
@@ -216,6 +217,16 @@ class AbstractServer:
         self._delta_lock = ordered_lock("AbstractServer._delta_lock")
         self._client_bases: Dict[str, str] = {}  # guarded-by: _delta_lock
         self._param_history: "collections.OrderedDict[str, Any]" = collections.OrderedDict()  # guarded-by: _delta_lock
+        # per-client hyperparam overrides (adaptive control, docs/
+        # ROBUSTNESS.md §10): sparse patches over the single global
+        # ``client_hyperparams``, keyed by the STABLE client id (the id a
+        # client carries across reconnects), plus the connection-id ->
+        # stable-id identity map learned from uploads. Guarded by a
+        # dedicated leaf lock — the dispatch paths read these outside
+        # self._lock.
+        self._hparam_lock = ordered_lock("AbstractServer._hparam_lock")
+        self._hparam_overrides: Dict[str, Dict[str, Any]] = {}  # guarded-by: _hparam_lock
+        self._conn_identity: Dict[str, str] = {}  # guarded-by: _hparam_lock
         # apply pipeline (config.apply_queue_depth): created in setup()
         self._apply_queue: Optional["queue.Queue"] = None
         self._apply_worker: Optional[threading.Thread] = None
@@ -332,6 +343,97 @@ class AbstractServer:
         return ModelMsg(version=full.version, vars=serialize_tree(delta),
                         delta_base=base_version)
 
+    # -- per-client hyperparams (adaptive control) --------------------------
+
+    def hyperparams_for(self, client_id: str) -> Dict[str, Any]:
+        """Effective client hyperparams for ONE connection: the global
+        ``client_hyperparams`` merged with the stable client's override
+        patch (when its identity is known and an override is set). This is
+        what rides ``DownloadMsg.hyperparams`` on every per-connection
+        send; the broadcast path (``download_msg``) stays global."""
+        merged = asdict(self.client_hyperparams)
+        with self._hparam_lock:
+            stable = self._conn_identity.get(client_id)
+            override = self._hparam_overrides.get(stable) if stable else None
+            if override:
+                merged.update(override)
+        return merged
+
+    def client_overrides(self, stable_id: str) -> Dict[str, Any]:
+        """Current override patch for a stable client id ({} when none)."""
+        with self._hparam_lock:
+            return dict(self._hparam_overrides.get(stable_id, ()))
+
+    def override_ids(self) -> List[str]:
+        """Stable client ids with an active override patch."""
+        with self._hparam_lock:
+            return sorted(self._hparam_overrides)
+
+    def identity_of(self, client_id: str) -> Optional[str]:
+        """Stable client id behind a connection id (None until the
+        connection's first upload identifies it)."""
+        with self._hparam_lock:
+            return self._conn_identity.get(client_id)
+
+    def connections_of(self, stable_id: str) -> List[str]:
+        """Live connection ids whose uploads identified as ``stable_id``."""
+        live = set(self.transport.client_ids)
+        with self._hparam_lock:
+            return sorted(c for c, s in self._conn_identity.items()
+                          if s == stable_id and c in live)
+
+    # dfcheck: payload overrides=hyperparam_override
+    def set_client_hyperparams(
+        self,
+        stable_id: str,
+        overrides: Optional[Dict[str, Any]],
+        push: bool = True,
+    ) -> Dict[str, Any]:
+        """Install (or clear, with ``None``/``{}``) a per-client hyperparam
+        override patch, validating the merged result against
+        ``ClientHyperparams`` first — a controller can never push knobs the
+        client-side validator would refuse. With ``push`` the new effective
+        hyperparams ride a data-less Download to every live connection of
+        the client immediately; otherwise they reach it on its next
+        per-connection send. Returns the effective merged dict."""
+        merged = asdict(self.client_hyperparams)
+        if overrides:
+            merged.update(overrides)
+        client_hyperparams(merged)  # raises on an invalid knob
+        with self._hparam_lock:
+            if overrides:
+                self._hparam_overrides[stable_id] = dict(overrides)
+            else:
+                self._hparam_overrides.pop(stable_id, None)
+        if push:
+            for conn in self.connections_of(stable_id):
+                self.push_client_hyperparams(conn)
+        return merged
+
+    def clear_client_hyperparams(self, stable_id: str, push: bool = True) -> None:
+        """Ramp-back: drop the override patch and (optionally) push the
+        restored global hyperparams to the client's live connections."""
+        self.set_client_hyperparams(stable_id, None, push=push)
+
+    def push_client_hyperparams(self, client_id: str) -> bool:
+        """Push the connection's effective hyperparams on a data-less
+        Download (the same install path every dispatch uses — the client
+        adopts ``msg.hyperparams`` for every knob it did not pin locally).
+        Returns False when the connection vanished mid-push."""
+        try:
+            self.transport.emit_to(
+                client_id,
+                Events.Download.value,
+                DownloadMsg(
+                    model=self.download_model_msg(client_id),
+                    hyperparams=self.hyperparams_for(client_id),
+                ).to_wire(),
+            )
+        except KeyError:
+            return False
+        self._c_hparam_pushes.inc()
+        return True
+
     # -- lifecycle ----------------------------------------------------------
 
     def setup(self) -> None:
@@ -416,6 +518,10 @@ class AbstractServer:
             # connection ids never recur, so the gone connection's delta
             # base is dead weight; the replacement dial starts base-less
             self._client_bases.pop(client_id, None)
+        with self._hparam_lock:
+            # identity is per-connection; the stable id's override patch
+            # (if any) survives and re-attaches on the next upload
+            self._conn_identity.pop(client_id, None)
         self._g_clients.set(n)
         self.fleet.disconnect(client_id)
         self.telemetry.flight.record("disconnect", client_id=client_id,
@@ -460,6 +566,10 @@ class AbstractServer:
                 else:
                     self._c_up_dense.inc()
             self.fleet.note_upload(client_id, nbytes)
+            # learn the connection's stable identity: per-client hyperparam
+            # overrides are keyed by the id a client keeps across reconnects
+            with self._hparam_lock:
+                self._conn_identity[client_id] = msg.client_id
             if msg.metrics is not None:
                 self.log(f"client {msg.client_id} metrics: {msg.metrics}")
             if msg.report is not None:
@@ -614,7 +724,7 @@ class AbstractServer:
                 Events.Download.value,
                 DownloadMsg(
                     model=self.download_model_msg(client_id),
-                    hyperparams=self.download_msg.hyperparams,
+                    hyperparams=self.hyperparams_for(client_id),
                 ).to_wire(),
             )
         except KeyError:

@@ -99,6 +99,12 @@ class AsynchronousSGDServer(AbstractServer):
         # it still names the version it was computed against — staleness is
         # judged from the GRADIENT's version, not the connection's history.
         self._version_tokens: "collections.OrderedDict[str, int]" = collections.OrderedDict()  # guarded-by: _lock
+        # fleet-wide dispatch-window cap (adaptive control): a sustained
+        # fleet ack-p99 breach shrinks it below every client's pushed
+        # inflight_window; recovery ramps it back to None (uncapped). Reads
+        # are racy-by-design (a dispatch mid-shrink uses the old cap once).
+        self._fleet_window_cap: Optional[int] = None
+        self._g_window_cap = self.telemetry.gauge("server_dispatch_window_cap")
 
     _VERSION_TOKEN_WINDOW = 64  # comfortably > any sane maximum_staleness
 
@@ -129,20 +135,48 @@ class AsynchronousSGDServer(AbstractServer):
 
     # -- dispatch ----------------------------------------------------------
 
-    def _dispatch_window(self) -> int:
-        """How many batches a client may hold at once: the pushed client
-        ``inflight_window`` clamped at ``maximum_staleness + 1`` — the
-        server-side cap is what makes the pipeline's effective staleness
-        bounded BY CONSTRUCTION (a batch the server never dispatched can't
-        age in anyone's window)."""
-        return max(1, min(int(self.client_hyperparams.inflight_window),
-                          int(self.hyperparams.maximum_staleness) + 1))
+    def set_fleet_window_cap(self, cap: Optional[int]) -> None:
+        """Fleet-wide dispatch-window ceiling (adaptive degradation):
+        ``None`` removes the cap, otherwise every client's window is
+        clamped to ``max(1, cap)`` regardless of its pushed
+        ``inflight_window``. Takes effect on the next dispatch."""
+        self._fleet_window_cap = None if cap is None else max(1, int(cap))
+        self._g_window_cap.set(0 if self._fleet_window_cap is None
+                               else self._fleet_window_cap)
+
+    @property
+    def fleet_window_cap(self) -> Optional[int]:
+        return self._fleet_window_cap
+
+    def outstanding_snapshot(self) -> Dict[str, List[int]]:
+        """Per-connection outstanding batches, copied under the lock —
+        the soak harness's leak audit (must be empty at quiescence)."""
+        with self._lock:
+            return {c: list(b) for c, b in self._client_batches.items()}
+
+    def active_leases(self) -> int:
+        """Live batch leases, read under the lock (0 at quiescence)."""
+        with self._lock:
+            return len(self._lease_deadlines)
+
+    def _dispatch_window(self, client_id: str) -> int:
+        """How many batches THIS connection may hold at once: its effective
+        ``inflight_window`` (global, or the stable client's override patch)
+        clamped at ``maximum_staleness + 1`` — the server-side cap is what
+        makes the pipeline's effective staleness bounded BY CONSTRUCTION (a
+        batch the server never dispatched can't age in anyone's window) —
+        and at the fleet-wide adaptive cap, when one is set."""
+        window = int(self.hyperparams_for(client_id)["inflight_window"])
+        cap = self._fleet_window_cap
+        if cap is not None:
+            window = min(window, cap)
+        return max(1, min(window, int(self.hyperparams.maximum_staleness) + 1))
 
     def _fill_window(self, client_id: str) -> None:
         """Dispatch-ahead: top the client's outstanding set up to the
         window. Stops at the first failed dispatch (starved queue,
         exhaustion, or the client vanishing)."""
-        window = self._dispatch_window()
+        window = self._dispatch_window(client_id)
         while True:
             with self._lock:
                 outstanding = len(self._client_batches.get(client_id, ()))
@@ -190,7 +224,7 @@ class AsynchronousSGDServer(AbstractServer):
                 # full-or-delta weights for THIS connection (delta when the
                 # server knows what the connection last installed)
                 model=self.download_model_msg(client_id),
-                hyperparams=self.download_msg.hyperparams,
+                hyperparams=self.hyperparams_for(client_id),
                 data=batch_to_data_msg(batch),
                 trace_id=span.trace_id or None,
                 span_id=span.span_id or None,
@@ -268,6 +302,24 @@ class AsynchronousSGDServer(AbstractServer):
         # weights + first batch(es) to the new client (reference :59-63);
         # a pipelined client gets its whole dispatch-ahead window up front
         self._fill_window(client_id)
+        with self._lock:
+            got_work = bool(self._client_batches.get(client_id))
+        if not got_work:
+            # parked (all work outstanding elsewhere) or post-exhaustion
+            # joiner: the handshake still owes a weights+hyperparams
+            # Download (data-less). Without it a late joiner's setup()
+            # hangs on a starved fleet, and a client rejoining after a
+            # crash would idle on stale weights (and miss any per-client
+            # override pushed while it was away) until a batch freed up.
+            try:
+                self.transport.emit_to(
+                    client_id, Events.Download.value,
+                    DownloadMsg(
+                        model=self.download_model_msg(client_id),
+                        hyperparams=self.hyperparams_for(client_id),
+                    ).to_wire())
+            except KeyError:
+                pass  # vanished between connect and welcome
 
     def handle_resync(self, client_id: str) -> None:
         """Resync repair for the dispatching plane: the client discarded the

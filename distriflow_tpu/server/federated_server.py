@@ -41,7 +41,7 @@ class FederatedServer(AbstractServer):
             Events.Download.value,
             DownloadMsg(
                 model=self.download_model_msg(client_id),
-                hyperparams=self.download_msg.hyperparams,
+                hyperparams=self.hyperparams_for(client_id),
             ).to_wire(),
         )
 
@@ -237,7 +237,6 @@ class FederatedServer(AbstractServer):
         # new weights to everyone (reference :80) — sent per connection so
         # each client receives a delta against what IT last installed (full
         # weights for anything the ledger doesn't know)
-        hyperparams = self.download_msg.hyperparams
         for cid in self.transport.client_ids:
             try:
                 self.transport.emit_to(
@@ -245,7 +244,7 @@ class FederatedServer(AbstractServer):
                     Events.Download.value,
                     DownloadMsg(
                         model=self.download_model_msg(cid),
-                        hyperparams=hyperparams,
+                        hyperparams=self.hyperparams_for(cid),
                     ).to_wire(),
                 )
             except Exception:

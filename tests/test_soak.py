@@ -1,0 +1,209 @@
+"""Fleet soak harness + adaptive controller tests (docs/ROBUSTNESS.md §10).
+
+Four layers:
+
+* miniature tier-1 soak: ~24 churned + chaos'd clients through
+  ``run_soak``'s full exactness audit (exactly-once accounting,
+  fleet-vs-local telemetry reconciliation, convergence vs the dense
+  serial baseline);
+* the same harness at fleet scale (220 clients; ``slow`` tier);
+* collector LRU bound: 500 join/leave cycles keep the per-client state
+  flat (bounded map + eviction counter);
+* the controller loop at wire level: a scripted transient straggler
+  trips ``fleet_straggler`` exactly once (edge-triggered), the server
+  pushes that client a per-client override, the knob change round-trips
+  onto the client's effective hyperparams, and once the client recovers
+  the band clears and the controller ramps the override back — no
+  manual intervention anywhere.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distriflow_tpu.client.abstract_client import DistributedClientConfig
+from distriflow_tpu.client.async_client import AsynchronousSGDClient
+from distriflow_tpu.data.dataset import DistributedDataset
+from distriflow_tpu.fleet import AdaptiveController, SoakConfig, run_soak
+from distriflow_tpu.fleet.soak import SoakModel
+from distriflow_tpu.obs import HealthSentinel, Telemetry
+from distriflow_tpu.obs.collector import ReportBuilder, TelemetryCollector
+from distriflow_tpu.server.abstract_server import DistributedServerConfig
+from distriflow_tpu.server.async_server import AsynchronousSGDServer
+from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+pytestmark = [pytest.mark.soak, pytest.mark.chaos]
+
+
+def test_soak_miniature(tmp_path):
+    """Tier-1 soak: 24 heterogeneous clients, mid-epoch churn (abrupt
+    kills + same-identity rejoins), seeded chaos on both endpoints —
+    and an exact audit at quiescence (run_soak raises on any
+    violation; the asserts re-state the load-bearing ones)."""
+    result = run_soak(SoakConfig(save_dir=str(tmp_path)))
+    assert result.errors == []
+    assert result.applied + result.rejected == result.total_batches
+    assert result.version_counter == result.applied
+    assert result.reconcile_ok and not result.mismatches
+    assert result.counter_idents > 0
+    # churn actually happened, and every kill rejoined
+    assert result.kills >= 2
+    assert result.rejoins == result.kills
+    # convergence: better than the zero-init start, near the baseline
+    assert result.final_loss < result.initial_loss / 2
+    assert result.final_loss <= (result.baseline_loss * 3.0
+                                 + 0.10 * result.initial_loss)
+
+
+@pytest.mark.slow
+def test_soak_fleet_scale(tmp_path):
+    """The same audit at fleet scale: 220 clients, 24 churn cycles.
+    Exactly-once accounting and exact telemetry reconciliation must
+    survive hundreds of concurrent loopback connections."""
+    result = run_soak(SoakConfig(
+        n_clients=220, n_batches=400, epochs=2, churn_kills=24,
+        churn_interval_s=0.15, timeout_s=300, save_dir=str(tmp_path)))
+    assert result.errors == []
+    assert result.n_clients >= 200
+    assert result.applied + result.rejected == result.total_batches
+    assert result.reconcile_ok and not result.mismatches
+    assert result.kills >= 10 and result.rejoins == result.kills
+
+
+def test_collector_lru_stays_flat():
+    """500 join/leave cycles (a new client identity each time) must not
+    grow the collector: the per-client LRU stays at ``max_clients`` and
+    every displacement is counted."""
+    tel = Telemetry()
+    collector = TelemetryCollector(telemetry=tel, max_clients=32)
+    for i in range(500):
+        client_tel = Telemetry()
+        client_tel.counter("client_uploads_total").inc()
+        builder = ReportBuilder(client_tel, f"cycle-{i:03d}")
+        assert collector.ingest(f"cycle-{i:03d}", builder.build())
+        assert len(collector.client_ids()) <= 32
+    assert len(collector.client_ids()) == 32
+    assert collector.clients_evicted == 500 - 32
+    assert tel.counter_value("fleet_clients_evicted_total") == 500 - 32
+    # totals reflect only the retained window — evicted state is gone,
+    # not leaked
+    assert collector.totals()["client_uploads_total"] == 32.0
+
+
+def test_straggler_override_roundtrip(tmp_path):
+    """The controller loop, observed at the wire: a transient straggler
+    (first 3 fits 8x slow, then recovered) trips ``fleet_straggler``
+    exactly once; the controller pushes it ``inflight_window=1`` +
+    boosted ``topk_fraction``; the pushed values land on the client's
+    EFFECTIVE hyperparams (server -> Download.hyperparams -> client);
+    after recovery the band clears on its own and the ramp removes the
+    override, pushing the base knobs back."""
+    rng = np.random.default_rng(3)
+    dim, bs, n_batches, epochs = 6, 4, 120, 2
+    x = rng.normal(size=(n_batches * bs, dim)).astype(np.float32)
+    y = (x @ rng.normal(size=(dim,))).astype(np.float32)
+    dataset = DistributedDataset(x, y, {"batch_size": bs, "epochs": epochs})
+    total = n_batches * epochs
+    tel_s = Telemetry()
+    server = AsynchronousSGDServer(
+        DistributedServerInMemoryModel(SoakModel(dim, 0.02)),
+        dataset,
+        DistributedServerConfig(
+            save_dir=str(tmp_path),
+            heartbeat_interval_s=0.2, heartbeat_timeout_s=10.0,
+            server_hyperparams={"maximum_staleness": 1000},
+            client_hyperparams={
+                "learning_rate": 0.02, "inflight_window": 2,
+                "topk_fraction": 0.25,
+                "telemetry_report_interval_s": 0.01,
+            },
+            telemetry=tel_s, verbose=False,
+        ),
+    )
+    clients = []
+    try:
+        server.setup()
+        sentinel = HealthSentinel(
+            tel_s, collector=server.collector,
+            fleet_straggler_factor=3.0, dump_dir=str(tmp_path))
+        controller = AdaptiveController(server, sentinel, recovery_checks=2)
+        for i in range(4):
+            model = SoakModel(
+                dim, 0.02, fit_delay_s=0.02, seed=i,
+                slow_first=3 if i == 0 else 0, slow_mult=8.0)
+            client = AsynchronousSGDClient(
+                server.address, model,
+                DistributedClientConfig(
+                    client_id=f"rt-{i}",
+                    # window/topk deliberately NOT pinned locally: the
+                    # override must win through msg.hyperparams
+                    hyperparams={"telemetry_report_interval_s": 0.01},
+                    heartbeat_interval_s=0.2, heartbeat_timeout_s=10.0,
+                    upload_timeout_s=5.0, telemetry=Telemetry(),
+                    verbose=False,
+                ),
+            )
+            client.setup(timeout=15.0)
+            clients.append(client)
+        straggler = clients[0]
+        assert straggler.hyperparam("inflight_window") == 2
+        assert straggler.hyperparam("topk_fraction") == 0.25
+
+        # stage 1: drive until the breach fires and the controller adapts
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and controller.adaptations < 1:
+            controller.step()
+            time.sleep(0.05)
+        assert controller.adaptations == 1, "straggler band never tripped"
+        assert server.override_ids() == ["rt-0"]
+        knobs = {a["knob"]: a for a in controller.actions()
+                 if a["action"] == "adapt"}
+        assert knobs["inflight_window"]["new"] == 1
+        assert knobs["topk_fraction"]["new"] == 1.0
+        assert knobs["inflight_window"]["client"] == "rt-0"
+        # stage 2: the push round-trips onto the client's EFFECTIVE
+        # knobs (server override -> Download.hyperparams -> client.msg).
+        # No controller polling here — the override must hold while the
+        # breach signal is still dirty.
+        push_deadline = time.monotonic() + 10.0
+        while time.monotonic() < push_deadline:
+            if (straggler.hyperparam("inflight_window") == 1
+                    and straggler.hyperparam("topk_fraction") == 1.0):
+                break
+            time.sleep(0.02)
+        assert straggler.hyperparam("inflight_window") == 1
+        assert straggler.hyperparam("topk_fraction") == 1.0
+        # stage 3: drain the run; the straggler recovers after its slow
+        # phase, the band clears on its own, and the ramp removes the
+        # override — no manual intervention
+        while time.monotonic() < deadline:
+            controller.step()
+            if (dataset.exhausted
+                    and server.applied_updates + server.rejected_updates
+                    >= total and controller.ramps >= 1):
+                break
+            time.sleep(0.05)
+        assert dataset.exhausted, "run never drained"
+        assert controller.ramps == 1
+        assert server.client_overrides("rt-0") == {}
+        assert server.override_ids() == []
+        # edge-triggered: one breach total, despite many dirty polls
+        assert tel_s.counter_value(
+            "obs_slo_breach_total", band="fleet_straggler") == 1
+        # the clear was pushed too: base knobs restored on the client
+        clear_deadline = time.monotonic() + 10.0
+        while time.monotonic() < clear_deadline:
+            if (straggler.hyperparam("inflight_window") == 2
+                    and straggler.hyperparam("topk_fraction") == 0.25):
+                break
+            time.sleep(0.05)
+        assert straggler.hyperparam("inflight_window") == 2
+        assert straggler.hyperparam("topk_fraction") == 0.25
+        # no re-trip after recovery: still exactly one breach
+        assert tel_s.counter_value(
+            "obs_slo_breach_total", band="fleet_straggler") == 1
+    finally:
+        for client in clients:
+            client.dispose()
+        server.stop()
