@@ -848,6 +848,94 @@ def bench_obs_overhead():
     }
 
 
+def bench_obs_timeline():
+    """Timeline-sampler overhead row (docs/OBSERVABILITY.md §12): the
+    SAME loopback async-CIFAR smoke run twice with telemetry fully on —
+    once with the background TimelineStore sampling the registry every
+    50 ms and persisting ``timeline.jsonl``, once without — and the
+    per-round delta pinned in the ledger. The sampler is a snapshot +
+    bucket-state copy + one JSONL append per tick off the hot path, so
+    the honest budget is noise-level; the row exists so a regression
+    (say, a sampler that starts holding the registry lock across I/O)
+    shows up as a number, not a vibe."""
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from distriflow_tpu.client.abstract_client import DistributedClientConfig
+    from distriflow_tpu.client.async_client import AsynchronousSGDClient
+    from distriflow_tpu.data.dataset import DistributedDataset
+    from distriflow_tpu.models import cifar_convnet
+    from distriflow_tpu.models.base import SpecModel
+    from distriflow_tpu.obs import TIMELINE_FILENAME, Telemetry
+    from distriflow_tpu.server.abstract_server import DistributedServerConfig
+    from distriflow_tpu.server.async_server import AsynchronousSGDServer
+    from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+    B = 32
+    n_batches = 6 if (FAST or SLOW) else 12
+    rng = np.random.RandomState(0)
+    x = rng.randn(n_batches * B, 32, 32, 3).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n_batches * B)]
+
+    def one_run(sampler_on, save_dir):
+        tel = Telemetry()
+        if sampler_on:
+            tel.start_timeline(interval_s=0.05, save_dir=save_dir)
+        dataset = DistributedDataset(x, y, {"batch_size": B, "epochs": 1})
+        client_model = SpecModel(cifar_convnet(), rng=jax.random.PRNGKey(0))
+        server_model = SpecModel(cifar_convnet(), rng=jax.random.PRNGKey(0))
+        for m in (client_model, server_model):
+            m.setup()
+            m.update(m.fit(x[:B], y[:B]))
+        server = AsynchronousSGDServer(
+            DistributedServerInMemoryModel(server_model), dataset,
+            DistributedServerConfig(
+                heartbeat_interval_s=0.5, heartbeat_timeout_s=20.0,
+                telemetry=tel),
+        )
+        server.setup()
+        client = AsynchronousSGDClient(
+            server.address, client_model,
+            DistributedClientConfig(
+                heartbeat_interval_s=0.5, heartbeat_timeout_s=20.0,
+                upload_timeout_s=60.0, telemetry=tel),
+        )
+        try:
+            client.setup(timeout=20.0)
+            start = time.perf_counter()
+            client.train_until_complete(timeout=600.0)
+            elapsed = time.perf_counter() - start
+        finally:
+            client.dispose()
+            server.stop()
+        tel.stop_timeline()
+        samples = len(tel.timeline.samples()) if sampler_on else 0
+        applied = max(server.applied_updates, 1)
+        return elapsed * 1e3 / applied, samples
+
+    with tempfile.TemporaryDirectory() as d:
+        off_ms, _ = one_run(False, None)
+        on_ms, samples = one_run(True, d)
+        jsonl_kib = os.path.getsize(
+            os.path.join(d, TIMELINE_FILENAME)) / 1024.0
+    overhead_ms = on_ms - off_ms
+    log(f"#obs obs_timeline: {on_ms:.1f} ms/round sampled vs {off_ms:.1f} "
+        f"unsampled ({overhead_ms:+.2f} ms; {samples} samples, "
+        f"{jsonl_kib:.1f} KiB timeline.jsonl)")
+    return {
+        "config": "obs_timeline",
+        "metric": "50 ms timeline sampler overhead per async round",
+        "value": round(overhead_ms, 2),
+        "sampler_on_round_ms": round(on_ms, 2),
+        "sampler_off_round_ms": round(off_ms, 2),
+        "timeline_samples": samples,
+        "timeline_jsonl_kib": round(jsonl_kib, 1),
+    }
+
+
 def bench_fleet_soak():
     """Fleet soak row (docs/ROBUSTNESS.md §10): the churn+chaos soak
     harness at a fixed seed — goodput (applies/sec of wall), the fleet
@@ -2342,6 +2430,7 @@ def main() -> None:
     run(bench_cifar_async, matrix)  # reads the cifar sync row for pct
     run(bench_fedavg)
     run(bench_obs_overhead)
+    run(bench_obs_timeline)
     run(bench_fleet_soak)
     if not FAST:
         run(bench_mobilenet, n_chips)

@@ -8,6 +8,13 @@ Three contracts between code and the obs plane:
   :func:`distriflow_tpu.obs.registry.parse_ident` and appear in the
   docs/OBSERVABILITY.md metric tables; conversely, every ident a metric
   table documents must still exist in code (doc drift is a finding too).
+* **metric-no-help** — every statically-resolvable factory registration
+  (``.counter()/.gauge()/.histogram()`` with a literal or constant name)
+  must carry a literal ``help=`` string: the registry's first-write-wins
+  help text is what the Prometheus renderer emits as ``# HELP``, so a
+  registration without one ships an operator-opaque metric. Tests and
+  fixtures are exempt; dynamically-named sites (the collector's
+  ``fleet/`` re-aggregation) are unresolvable and therefore out of scope.
 * **span-unbalanced** — every ``tracer.span(...)`` / ``prof.phase(...)`` /
   ``prof.step(...)`` enter must have a matching exit on all code paths.
   Statically we accept exactly the shapes that guarantee it: used directly
@@ -182,7 +189,34 @@ def _check_metrics(modules: List[SourceModule], findings: List[Finding]) -> None
                 )
             continue
         if in_tests:
-            continue  # test-local metrics carry no doc obligation
+            continue  # test-local metrics carry no doc/help obligation
+        # metric-no-help: a resolvable factory registration must carry a
+        # literal help= string — that text IS the `# HELP` line scrapers
+        # see, so a silent registration is an operator-invisible metric
+        is_factory = (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in _METRIC_FACTORIES
+        )
+        has_help = any(
+            kw.arg == "help" and _literal_str(kw.value) is not None
+            for kw in call.keywords
+        )
+        if is_factory and not has_help:
+            if not mod.ignored(call.lineno, "metric-no-help"):
+                findings.append(
+                    Finding(
+                        check="metric-no-help",
+                        path=mod.relpath,
+                        line=call.lineno,
+                        symbol="<metrics>",
+                        message=(
+                            f"metric {base!r} is registered without help= "
+                            "text (the Prometheus renderer emits it as the "
+                            "# HELP line)"
+                        ),
+                        detail=base,
+                    )
+                )
         code_idents.add(base)
         if base not in doc_idents:
             if not mod.ignored(call.lineno, "metric-undocumented"):

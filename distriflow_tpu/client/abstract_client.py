@@ -175,18 +175,39 @@ class AbstractClient:
             if self.config.telemetry is not None
             else get_telemetry()
         )
-        self._c_reconnects = self.telemetry.counter("client_reconnects_total")
-        self._c_uploads = self.telemetry.counter("client_uploads_total")
-        self._c_retries = self.telemetry.counter("client_upload_retries_total")
+        self._c_reconnects = self.telemetry.counter(
+            "client_reconnects_total",
+            help="reconnect attempts after a dropped server connection")
+        self._c_uploads = self.telemetry.counter(
+            "client_uploads_total", help="variable uploads sent to the server")
+        self._c_retries = self.telemetry.counter(
+            "client_upload_retries_total",
+            help="upload attempts retried after a transport failure")
         # wire accounting (see docs/OBSERVABILITY.md comm_* table)
-        self._c_up_bytes = self.telemetry.counter("comm_up_bytes_total", role="client")
-        self._c_down_bytes = self.telemetry.counter("comm_down_bytes_total", role="client")
-        self._c_up_sparse = self.telemetry.counter("comm_uploads_sparse_total", role="client")
-        self._c_up_dense = self.telemetry.counter("comm_uploads_dense_total", role="client")
-        self._c_down_delta = self.telemetry.counter("comm_broadcasts_delta_total", role="client")
-        self._c_down_full = self.telemetry.counter("comm_broadcasts_full_total", role="client")
-        self._c_resyncs = self.telemetry.counter("comm_resyncs_total", role="client")
-        self._g_residual = self.telemetry.gauge("comm_residual_norm")
+        self._c_up_bytes = self.telemetry.counter(
+            "comm_up_bytes_total", role="client",
+            help="payload bytes sent upstream")
+        self._c_down_bytes = self.telemetry.counter(
+            "comm_down_bytes_total", role="client",
+            help="payload bytes received downstream")
+        self._c_up_sparse = self.telemetry.counter(
+            "comm_uploads_sparse_total", role="client",
+            help="uploads shipped sparse (top-k compressed)")
+        self._c_up_dense = self.telemetry.counter(
+            "comm_uploads_dense_total", role="client",
+            help="uploads shipped dense (compression bypassed)")
+        self._c_down_delta = self.telemetry.counter(
+            "comm_broadcasts_delta_total", role="client",
+            help="delta broadcasts received")
+        self._c_down_full = self.telemetry.counter(
+            "comm_broadcasts_full_total", role="client",
+            help="full-model broadcasts received")
+        self._c_resyncs = self.telemetry.counter(
+            "comm_resyncs_total", role="client",
+            help="full-state resyncs after a version gap")
+        self._g_residual = self.telemetry.gauge(
+            "comm_residual_norm",
+            help="norm of the error-feedback residual carried locally")
         # continuous phase profiler (docs/OBSERVABILITY.md §5): the
         # client step decomposes into fit / ef_compress / serialize /
         # submit / ack_wait; shared no-op handles when telemetry is off
@@ -358,23 +379,34 @@ class AbstractClient:
         except (TypeError, ValueError):
             return 1
 
-    def _comm_acquire_slot(self) -> None:
+    def _comm_acquire_slot(self) -> bool:
         """Backpressure: block until the upload window has room. Starts the
         comm thread on first use. MUST be called with no locks held — the
-        comm thread takes client locks to publish results."""
-        if self._comm_thread is None:
-            with self._comm_cv:
-                if self._comm_thread is None:
-                    window = self.inflight_window()
-                    self._comm_q = queue.Queue()
-                    self._comm_slots = threading.Semaphore(
-                        max(1, window - 1))
-                    self._comm_thread = threading.Thread(
-                        target=self._comm_loop,
-                        name=f"client-comm-{self.client_id[:8]}",
-                        daemon=True)
-                    self._comm_thread.start()
-        self._comm_slots.acquire()
+        comm thread takes client locks to publish results.
+
+        Returns False (holding no slot) once the client is disposed. The
+        wait is bounded and re-checked: ``abort()`` reaps the comm thread
+        WITHOUT draining, so a permit held by an abandoned upload is never
+        released — an unbounded ``acquire()`` here would strand the
+        transport's dispatch thread (non-daemon: the interpreter would
+        then hang at exit joining it) on a semaphore nobody will post."""
+        while True:
+            if self._disposed:
+                return False
+            if self._comm_thread is None:
+                with self._comm_cv:
+                    if self._comm_thread is None:
+                        window = self.inflight_window()
+                        self._comm_q = queue.Queue()
+                        self._comm_slots = threading.Semaphore(
+                            max(1, window - 1))
+                        self._comm_thread = threading.Thread(
+                            target=self._comm_loop,
+                            name=f"client-comm-{self.client_id[:8]}",
+                            daemon=True)
+                        self._comm_thread.start()
+            if self._comm_slots.acquire(timeout=0.5):
+                return True
 
     def _comm_release_slot(self) -> None:
         self._comm_slots.release()

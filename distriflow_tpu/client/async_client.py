@@ -157,7 +157,10 @@ class AsynchronousSGDClient(AbstractClient):
         under the lock would deadlock the pipe), and slot-then-lock also
         pins enqueue order to fit order."""
         with self._prof.step():
-            self._comm_acquire_slot()
+            if not self._comm_acquire_slot():
+                # disposed mid-wait (churn kill): drop the round — the
+                # server's lease expires and redelivers the batch elsewhere
+                return
             enqueued = False
             try:
                 with self._update_lock:

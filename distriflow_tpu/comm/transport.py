@@ -290,14 +290,30 @@ class _Endpoint:
         self._write_lock = asyncio.Lock()
         t = telemetry if telemetry is not None else get_telemetry()
         # handles cached once: the send/ack hot path does no registry lookups
-        self._c_sent = t.counter("transport_frames_sent_total", role=role)
-        self._c_offered = t.counter("transport_frames_offered_total", role=role)
-        self._c_dropped = t.counter("transport_frames_dropped_total", role=role)
-        self._c_duplicated = t.counter("transport_frames_duplicated_total", role=role)
-        self._c_corrupted = t.counter("transport_frames_corrupted_total", role=role)
-        self._c_delayed = t.counter("transport_frames_delayed_total", role=role)
-        self._c_resets = t.counter("transport_resets_total", role=role)
-        self._h_ack = t.histogram("transport_ack_latency_ms", role=role)
+        self._c_sent = t.counter(
+            "transport_frames_sent_total", role=role,
+            help="frames actually written to the wire")
+        self._c_offered = t.counter(
+            "transport_frames_offered_total", role=role,
+            help="frames offered to the fault plan (pre-loss)")
+        self._c_dropped = t.counter(
+            "transport_frames_dropped_total", role=role,
+            help="frames dropped by the injected fault plan")
+        self._c_duplicated = t.counter(
+            "transport_frames_duplicated_total", role=role,
+            help="frames duplicated by the injected fault plan")
+        self._c_corrupted = t.counter(
+            "transport_frames_corrupted_total", role=role,
+            help="frames corrupted in flight by the fault plan")
+        self._c_delayed = t.counter(
+            "transport_frames_delayed_total", role=role,
+            help="frames delayed in flight by the fault plan")
+        self._c_resets = t.counter(
+            "transport_resets_total", role=role,
+            help="connection resets injected by the fault plan")
+        self._h_ack = t.histogram(
+            "transport_ack_latency_ms", role=role,
+            help="send-to-ack round trip per frame (ms)")
 
     async def _send(self, msg: Dict[str, Any]) -> None:
         copies, corrupt = 1, False
@@ -379,9 +395,11 @@ class ServerTransport:
         self.fault_plan = fault_plan  # chaos testing: shared by all connections
         self.telemetry = telemetry if telemetry is not None else get_telemetry()
         self._c_received = self.telemetry.counter(
-            "transport_frames_received_total", role="server")
+            "transport_frames_received_total", role="server",
+            help="frames received and framed off the wire")
         self._c_corrupt_rx = self.telemetry.counter(
-            "transport_frames_corrupt_rx_total", role="server")
+            "transport_frames_corrupt_rx_total", role="server",
+            help="received frames rejected by checksum/decode")
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -610,9 +628,11 @@ class ClientTransport:
         self.fault_plan = fault_plan
         self.telemetry = telemetry if telemetry is not None else get_telemetry()
         self._c_received = self.telemetry.counter(
-            "transport_frames_received_total", role="client")
+            "transport_frames_received_total", role="client",
+            help="frames received and framed off the wire")
         self._c_corrupt_rx = self.telemetry.counter(
-            "transport_frames_corrupt_rx_total", role="client")
+            "transport_frames_corrupt_rx_total", role="client",
+            help="received frames rejected by checksum/decode")
         self.on_server_lost: Optional[Callable[[], None]] = None
         # fleet telemetry plane: zero-arg callable polled each beat; a
         # non-None return rides the heartbeat as its payload (how

@@ -1119,6 +1119,152 @@ def main() -> int:
 
     ok &= _check("health-sentinel drill (SLO breach + flight dump)", sentinel)
 
+    def timeline_drill():
+        """Time-resolved telemetry drill (docs/OBSERVABILITY.md §12),
+        three ways over the same loopback run. Clean: the sampled
+        timeline persists to ``timeline.jsonl``, carries ZERO events,
+        and ``dump --timeline`` renders it from the run dir alone.
+        Transient: one scripted 0.4 s ack delay is a single out-of-band
+        interval — the ``sustained`` band (3 consecutive observed
+        samples) must stay silent where the old point band would have
+        paged. Sustained: delaying EVERY frame 0.35 s trips the band
+        exactly once (edge-triggered), and the breach event lands on the
+        rendered timeline at its recorded timestamp."""
+        import os
+
+        import numpy as np
+
+        from distriflow_tpu.client.abstract_client import DistributedClientConfig
+        from distriflow_tpu.client.async_client import AsynchronousSGDClient
+        from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+        from distriflow_tpu.data.dataset import DistributedDataset
+        from distriflow_tpu.obs import Telemetry, TIMELINE_FILENAME
+        from distriflow_tpu.obs.dump import summarize_timeline
+        from distriflow_tpu.obs.health import HealthSentinel, SLOBand
+        from distriflow_tpu.obs.timeline import TimelineStore
+        from distriflow_tpu.server.abstract_server import DistributedServerConfig
+        from distriflow_tpu.server.async_server import AsynchronousSGDServer
+        from distriflow_tpu.server.models import DistributedServerInMemoryModel
+
+        TinyModel = _tiny_model_cls()
+        band = SLOBand("ack_sustained", "transport_ack_latency_ms", "p99",
+                       {"role": "client"}, upper=250.0, kind="sustained",
+                       sustained_samples=3, sustained_s=0.1, window_s=60.0)
+
+        def run_once(fault_plan, run_dir):
+            x = np.arange(8, dtype=np.float32).reshape(8, 1)
+            y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+            dataset = DistributedDataset(x, y, {"batch_size": 2, "epochs": 1})
+            tel = Telemetry()
+            tel.start_timeline(interval_s=0.05, save_dir=run_dir)
+            watch = HealthSentinel(tel, bands=[band], dump_dir=run_dir)
+            server = AsynchronousSGDServer(
+                DistributedServerInMemoryModel(TinyModel()),
+                dataset,
+                DistributedServerConfig(
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=5.0,
+                    telemetry=tel,
+                ),
+            )
+            server.setup()
+            client = AsynchronousSGDClient(
+                server.address, TinyModel(),
+                DistributedClientConfig(
+                    heartbeat_interval_s=0.1, heartbeat_timeout_s=5.0,
+                    upload_timeout_s=5.0, fault_plan=fault_plan,
+                    telemetry=tel,
+                ),
+            )
+            try:
+                client.setup(timeout=10.0)
+                client.train_until_complete(timeout=60.0)
+            finally:
+                client.dispose()
+                server.stop()
+            tel.stop_timeline()
+            entered = watch.check()
+            watch.check()  # edge trigger: must not re-fire
+            count = tel.counter_value(
+                "obs_slo_breach_total", band="ack_sustained")
+            return tel, entered, count
+
+        with tempfile.TemporaryDirectory() as d:
+            # -- clean leg: flat timeline, zero events, renderable -------
+            clean_dir = os.path.join(d, "clean")
+            tel, entered, count = run_once(None, clean_dir)
+            assert not entered and count == 0, (
+                f"clean run breached the sustained band: {entered}"
+            )
+            assert os.path.exists(
+                os.path.join(clean_dir, TIMELINE_FILENAME)), (
+                "clean run wrote no timeline.jsonl"
+            )
+            clean = TimelineStore.load(clean_dir)
+            # >= 2 is structural (first thread tick + the closing sample
+            # stop() takes); a loaded host can starve everything between
+            assert len(clean.samples()) >= 2, (
+                f"only {len(clean.samples())} timeline samples — the "
+                "sampler thread never ticked"
+            )
+            assert clean.events() == [], (
+                f"clean run stamped events: {clean.events()}"
+            )
+            lines, found = summarize_timeline(clean_dir)
+            assert found and any("|" in ln for ln in lines), (
+                "dump --timeline rendered no sparkline for the clean run"
+            )
+            clean_samples = len(clean.samples())
+
+            # -- transient leg: one 0.4 s spike must NOT trip sustained --
+            transient_dir = os.path.join(d, "transient")
+            plan = FaultPlan(seed=13, schedule=[
+                ScriptedFault(event="uploadVars", nth=2, action="delay",
+                              delay_s=0.4)])
+            _, entered, count = run_once(plan, transient_dir)
+            assert not entered and count == 0, (
+                f"a single transient spike tripped the sustained band: "
+                f"{entered} (count {count:g})"
+            )
+
+            # -- sustained leg: every frame slow -> exactly one breach ---
+            sustained_dir = os.path.join(d, "sustained")
+            _, entered, count = run_once(
+                FaultPlan(delay=1.0, delay_s=0.35), sustained_dir)
+            assert [e["band"] for e in entered] == ["ack_sustained"], (
+                f"expected exactly the sustained band to enter: {entered}"
+            )
+            assert count == 1, (
+                f"obs_slo_breach_total{{band=ack_sustained}} = {count:g}, "
+                "expected exactly 1 (edge trigger)"
+            )
+            assert entered[0]["run_samples"] >= 3
+            store = TimelineStore.load(sustained_dir)
+            breaches = [e for e in store.events()
+                        if e["kind"] == "slo_breach"]
+            assert len(breaches) == 1, (
+                f"expected 1 slo_breach timeline event, got {breaches}"
+            )
+            # the rendered legend carries the breach at its recorded
+            # timestamp (offset from the axis origin, 2dp)
+            lines, found = summarize_timeline(sustained_dir)
+            t_lo = min([s["t"] for s in store.samples()]
+                       + [e["t"] for e in store.events()])
+            stamp = f"+{breaches[0]['t'] - t_lo:.2f}s B slo_breach"
+            joined = "\n".join(lines)
+            assert found and stamp in joined, (
+                f"breach stamp {stamp!r} missing from dump --timeline:\n"
+                f"{joined}"
+            )
+        return (f"clean: {clean_samples} samples, 0 events, sparklines "
+                "render; 1 transient 0.4 s spike: sustained band silent; "
+                "0.35 s delay on every frame: ack_sustained tripped "
+                f"exactly once ({entered[0]['run_samples']} consecutive "
+                "slow samples) with the breach event time-aligned on the "
+                "rendered timeline")
+
+    ok &= _check("timeline drill (sustained vs transient SLO, "
+                 "event-annotated dump)", timeline_drill)
+
     def request_trace():
         """Request-trace drill (docs/OBSERVABILITY.md §11), both ways:
         a clean two-replica routed serving run must assemble every

@@ -21,10 +21,18 @@ Degradation ladder (docs/ROBUSTNESS.md §10):
 * sustained ``fleet_ack_p99`` breach -> shrink the FLEET-WIDE dispatch
   window cap (halve toward 1): every client's in-flight work drops, the
   wire and the apply queue drain.
-* recovery ramps back: after ``recovery_checks`` consecutive clean
-  polls the per-client override is cleared (and pushed) / the window
-  cap is doubled toward uncapped. Knobs move one rung per poll — no
-  thrash on a flapping signal.
+* recovery ramps back: the per-client override is cleared (and pushed)
+  / the window cap is doubled toward uncapped only once its signal has
+  stayed clean for a **sustained-clean window** — ``recovery_window_s``
+  of wall clock judged against the telemetry timeline
+  (docs/OBSERVABILITY.md §12) when one is running, falling back to
+  ``recovery_checks`` consecutive clean point-polls when not. Knobs
+  move one rung per poll — no thrash on a flapping signal.
+
+Every adapt/ramp is also stamped on the run timeline
+(``controller_adapt`` / ``controller_ramp`` events), so ``python -m
+distriflow_tpu.obs.dump RUN_DIR --timeline`` shows each knob move
+aligned against the series that caused it.
 
 Every decision is recorded as a ``controller_action`` payload dict
 (``comm/schema.py``) in a bounded action log, and counted on
@@ -36,6 +44,7 @@ to page a human when per-client steering saturates.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["AdaptiveController"]
@@ -56,13 +65,20 @@ class AdaptiveController:
                  topk_boost: float = 4.0,
                  straggler_window: int = 1,
                  cap_floor: int = 1,
-                 recovery_checks: int = 3):
+                 recovery_checks: int = 3,
+                 recovery_window_s: Optional[float] = None):
         self.server = server
         self.sentinel = sentinel
         self.topk_boost = float(topk_boost)
         self.straggler_window = int(straggler_window)
         self.cap_floor = int(cap_floor)
         self.recovery_checks = int(recovery_checks)
+        # trend-aware recovery: with a running telemetry timeline, ramp
+        # only after the signal stayed clean for this much WALL CLOCK
+        # (a sustained-clean window) instead of counting point polls;
+        # None keeps the point-poll recovery_checks behaviour
+        self.recovery_window_s = (None if recovery_window_s is None
+                                  else float(recovery_window_s))
         self.telemetry = server.telemetry
         self._actions: List[Dict[str, Any]] = []
         self.adaptations = 0
@@ -70,8 +86,21 @@ class AdaptiveController:
         # consecutive clean polls per pinned client / for the window cap
         self._clear_streak: Dict[str, int] = {}
         self._cap_clear_streak = 0
-        self._g_overrides = self.telemetry.gauge("controller_overrides_active")
-        self._c_ramps = self.telemetry.counter("controller_ramps_total")
+        # trend mode: wall time each knob's signal was last seen dirty
+        self._clean_since: Dict[str, float] = {}
+        self._cap_clean_since: Optional[float] = None
+        self._g_overrides = self.telemetry.gauge(
+            "controller_overrides_active",
+            help="clients currently pinned on a controller override")
+        self._c_ramps = self.telemetry.counter(
+            "controller_ramps_total",
+            help="controller recovery ramps (knobs restored)")
+
+    def _trend_mode(self) -> bool:
+        """True when ramp-back is judged on the timeline's wall clock
+        (a sustained-clean window) instead of poll streaks."""
+        return (self.recovery_window_s is not None
+                and self.telemetry.timeline.active)
 
     # -- public surface -----------------------------------------------------
 
@@ -118,9 +147,11 @@ class AdaptiveController:
         }
         self.server.set_client_hyperparams(stable, override, push=True)
         self._clear_streak[stable] = 0
+        self._clean_since.pop(stable, None)
         self.adaptations += 1
         self.telemetry.counter("controller_adaptations_total",
-                               band="fleet_straggler").inc()
+                               band="fleet_straggler",
+                               help="controller degradations, by band").inc()
         self._record("adapt", "fleet_straggler", client=stable,
                      knob="topk_fraction", old=old_topk, new=new_topk,
                      observed=hit.get("observed"))
@@ -139,31 +170,53 @@ class AdaptiveController:
             return  # already at the floor; nothing left to shed
         self.server.set_fleet_window_cap(new)
         self._cap_clear_streak = 0
+        self._cap_clean_since = None
         self.adaptations += 1
         self.telemetry.counter("controller_adaptations_total",
-                               band="fleet_ack_p99").inc()
+                               band="fleet_ack_p99",
+                               help="controller degradations, by band").inc()
         self._record("adapt", "fleet_ack_p99", knob="dispatch_window_cap",
                      old=old, new=new, observed=hit.get("observed"))
 
     # -- recovery -----------------------------------------------------------
 
+    def _clean_long_enough(self, key: str, now: float) -> bool:
+        """Trend mode: has ``key``'s signal been clean (as polled) for a
+        full ``recovery_window_s`` of wall clock — AND has the timeline
+        actually observed that long a span (a freshly started sampler
+        has not witnessed a sustained-clean window yet)?"""
+        since = self._clean_since.setdefault(key, now)
+        if now - since < self.recovery_window_s:
+            return False
+        return self.telemetry.timeline.span_s() >= self.recovery_window_s
+
     def _ramp_back(self) -> None:
-        """Clear knobs whose signal stayed clean for ``recovery_checks``
-        consecutive polls. A client with no live connections counts as
+        """Clear knobs whose signal stayed clean long enough — a
+        sustained-clean wall-clock window in trend mode (see
+        ``recovery_window_s``), ``recovery_checks`` consecutive clean
+        polls otherwise. A client with no live connections counts as
         clean — its override would otherwise pin a ghost forever."""
+        trend = self._trend_mode()
+        now = time.time()
         breached = set(self.sentinel.breached())
         for stable in self.server.override_ids():
             conns = self.server.connections_of(stable)
             dirty = any(f"fleet_straggler:{c}" in breached for c in conns)
             if dirty:
                 self._clear_streak[stable] = 0
+                self._clean_since.pop(stable, None)
                 continue
-            streak = self._clear_streak.get(stable, 0) + 1
-            self._clear_streak[stable] = streak
-            if streak < self.recovery_checks:
-                continue
+            if trend:
+                if not self._clean_long_enough(stable, now):
+                    continue
+            else:
+                streak = self._clear_streak.get(stable, 0) + 1
+                self._clear_streak[stable] = streak
+                if streak < self.recovery_checks:
+                    continue
             self.server.clear_client_hyperparams(stable, push=True)
             self._clear_streak.pop(stable, None)
+            self._clean_since.pop(stable, None)
             self.ramps += 1
             self._c_ramps.inc()
             self._record("ramp", "fleet_straggler", client=stable,
@@ -171,17 +224,30 @@ class AdaptiveController:
         cap = self.server.fleet_window_cap
         if cap is None:
             self._cap_clear_streak = 0
+            self._cap_clean_since = None
         elif "fleet_ack_p99" in breached:
             self._cap_clear_streak = 0
+            self._cap_clean_since = None
         else:
-            self._cap_clear_streak += 1
-            if self._cap_clear_streak >= self.recovery_checks:
+            ready = False
+            if trend:
+                if self._cap_clean_since is None:
+                    self._cap_clean_since = now
+                ready = (now - self._cap_clean_since
+                         >= self.recovery_window_s
+                         and self.telemetry.timeline.span_s()
+                         >= self.recovery_window_s)
+            else:
+                self._cap_clear_streak += 1
+                ready = self._cap_clear_streak >= self.recovery_checks
+            if ready:
                 base = int(self.server.client_hyperparams.inflight_window)
                 new: Optional[int] = cap * 2
                 if new >= base:
                     new = None
                 self.server.set_fleet_window_cap(new)
                 self._cap_clear_streak = 0
+                self._cap_clean_since = None
                 self.ramps += 1
                 self._c_ramps.inc()
                 self._record("ramp", "fleet_ack_p99",
@@ -198,3 +264,9 @@ class AdaptiveController:
         row.update({k: v for k, v in extra.items() if v is not None})
         self._actions.append(row)
         del self._actions[:-_MAX_ACTIONS]
+        # stamp the knob move on the run timeline (no-op until a
+        # timeline is started) so `dump --timeline` aligns it with the
+        # series that caused it
+        self.telemetry.timeline.event(
+            f"controller_{action}",
+            **{k: v for k, v in row.items() if k != "action"})

@@ -128,21 +128,35 @@ class FleetRouter:
         self._fault_plans: Dict[str, Optional[FaultPlan]] = {}
         tel = telemetry if telemetry is not None else get_telemetry()
         self._tel = tel
-        self._m_requests = {t: tel.counter("router_requests_total",
-                                           tier=str(t)) for t in (0, 1, 2)}
-        self._m_shed = {t: tel.counter("router_shed_total", tier=str(t))
-                        for t in (0, 1, 2)}
-        self._m_affinity = tel.counter("router_affinity_hits_total")
-        self._m_failovers = tel.counter("router_failovers_total")
-        self._m_live = tel.gauge("router_replicas_live")
+        self._m_requests = {t: tel.counter(
+            "router_requests_total", tier=str(t),
+            help="requests accepted by the router, by SLO tier")
+            for t in (0, 1, 2)}
+        self._m_shed = {t: tel.counter(
+            "router_shed_total", tier=str(t),
+            help="requests shed at admission, by SLO tier")
+            for t in (0, 1, 2)}
+        self._m_affinity = tel.counter(
+            "router_affinity_hits_total",
+            help="requests routed to their session-affine replica")
+        self._m_failovers = tel.counter(
+            "router_failovers_total",
+            help="requests re-dispatched after a replica failure")
+        self._m_live = tel.gauge(
+            "router_replicas_live", help="replicas currently routable")
         # goodput = generate requests answered with a result (sheds,
         # drain refusals, and handler errors all miss); hedge candidates
         # = answered requests that needed >=1 failover, i.e. where a
         # hedged duplicate fired at first-submit time would have beaten
         # the failover round trip
-        self._m_goodput = {t: tel.counter("router_goodput_total",
-                                          tier=str(t)) for t in (0, 1, 2)}
-        self._m_hedge = tel.counter("router_hedge_candidates_total")
+        self._m_goodput = {t: tel.counter(
+            "router_goodput_total", tier=str(t),
+            help="generate requests answered with a result, by SLO tier")
+            for t in (0, 1, 2)}
+        self._m_hedge = tel.counter(
+            "router_hedge_candidates_total",
+            help="answered requests that needed >=1 failover (a hedge "
+                 "fired at submit time would have beaten the retry)")
         # the router is a fleet citizen too: its own row (plus one row
         # per replica from the registry view routing actually used)
         # merges into ``tel.snapshot()["fleet"]`` so ``dump --fleet`` on

@@ -191,6 +191,15 @@ class SoakConfig:
     recovery_checks: int = 3
     topk_boost: float = 4.0
     poll_interval_s: float = 0.1
+    # time-resolved telemetry (docs/OBSERVABILITY.md §12): sampling
+    # period of the run timeline (samples + churn/controller/breach
+    # events land in save_dir/timeline.jsonl for `dump --timeline`);
+    # 0 disables the sampler
+    timeline_interval_s: float = 0.05
+    # sustained-clean wall-clock window the controller requires before
+    # ramping a knob back (trend mode; None derives it from
+    # recovery_checks * poll_interval_s when the timeline is on)
+    recovery_window_s: Optional[float] = None
     # convergence tolerance vs the dense serial baseline
     loss_factor: float = 3.0
     loss_slack_frac: float = 0.10
@@ -402,6 +411,11 @@ def run_soak(cfg: SoakConfig) -> SoakResult:
     errors: List[str] = []
     try:
         server.setup()
+        if cfg.timeline_interval_s > 0:
+            # the run timeline: registry samples + control-plane events,
+            # persisted so `dump --timeline <save_dir>` replays the run
+            tel_s.start_timeline(interval_s=cfg.timeline_interval_s,
+                                 save_dir=save_dir)
         sentinel = HealthSentinel(
             tel_s, collector=server.collector,
             fleet_straggler_factor=(cfg.straggler_factor
@@ -409,9 +423,16 @@ def run_soak(cfg: SoakConfig) -> SoakResult:
             fleet_ack_p99_ms=cfg.fleet_ack_p99_ms,
             dump_dir=save_dir)
         if cfg.controller:
+            recovery_window_s = cfg.recovery_window_s
+            if recovery_window_s is None and cfg.timeline_interval_s > 0:
+                # trend mode by default when the timeline is running:
+                # the same clean span the streak counter used to demand,
+                # measured in wall clock instead of poll counts
+                recovery_window_s = cfg.recovery_checks * cfg.poll_interval_s
             controller = AdaptiveController(
                 server, sentinel, topk_boost=cfg.topk_boost,
-                recovery_checks=cfg.recovery_checks)
+                recovery_checks=cfg.recovery_checks,
+                recovery_window_s=recovery_window_s)
 
         start = time.monotonic()
         for i, rec in enumerate(recs):
@@ -438,6 +459,8 @@ def run_soak(cfg: SoakConfig) -> SoakResult:
                     if _setup_with_retry(rec, server.address, cfg,
                                          int(now * 1e3) & 0xFFFF):
                         rejoins += 1
+                        tel_s.timeline.event("churn_rejoin",
+                                             client=rec.stable_id)
             while kill_times and now >= kill_times[0]:
                 kill_times.pop(0)
                 live = [r for r in killable if r.client is not None]
@@ -447,6 +470,7 @@ def run_soak(cfg: SoakConfig) -> SoakResult:
                 victim.client.abort()  # no goodbye: the server sees EOF
                 victim.client = None
                 kills += 1
+                tel_s.timeline.event("churn_kill", client=victim.stable_id)
                 pending_rejoin.append((now + cfg.rejoin_delay_s, victim))
             if controller is not None:
                 controller.step()
@@ -475,11 +499,22 @@ def run_soak(cfg: SoakConfig) -> SoakResult:
             for _ in range(cfg.recovery_checks + 2):
                 controller.step()
                 time.sleep(min(cfg.poll_interval_s, 0.05))
+            # trend mode needs a sustained-clean WALL-CLOCK window, not a
+            # poll count: keep polling (bounded) until every knob is
+            # restored so the ramp-back invariant holds either mode
+            ramp_deadline = time.monotonic() + max(
+                2.0, 4.0 * (controller.recovery_window_s or 0.0))
+            while ((server.override_ids()
+                    or server.fleet_window_cap is not None)
+                   and time.monotonic() < ramp_deadline):
+                controller.step()
+                time.sleep(min(cfg.poll_interval_s, 0.05))
 
         # rejoin anyone still dead so every stable identity quiesces live
         for _, rec in pending_rejoin:
             if _setup_with_retry(rec, server.address, cfg, cfg.seed + 31):
                 rejoins += 1
+                tel_s.timeline.event("churn_rejoin", client=rec.stable_id)
         pending_rejoin.clear()
 
         # ---- freeze the fleet, then audit ------------------------------
@@ -598,6 +633,7 @@ def run_soak(cfg: SoakConfig) -> SoakResult:
         for rec in recs:
             if rec.client is not None:
                 rec.client.dispose()
+        tel_s.stop_timeline()
         server.stop()
         if tmp is not None:
             tmp.cleanup()

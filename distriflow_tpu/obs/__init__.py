@@ -55,6 +55,13 @@ from distriflow_tpu.obs.telemetry import (
     get_telemetry,
     set_telemetry,
 )
+from distriflow_tpu.obs.timeline import (
+    NOOP_TIMELINE,
+    TIMELINE_FILENAME,
+    TimelineStore,
+    fit_slope,
+    quantile_from_buckets,
+)
 from distriflow_tpu.obs.trace_assembler import (
     Assembly,
     Round,
@@ -85,19 +92,23 @@ __all__ = [
     "NOOP_PHASE",
     "NOOP_PROFILER",
     "NOOP_SPAN",
+    "NOOP_TIMELINE",
     "PhaseProfiler",
     "REPORT_VERSION",
     "ReportBuilder",
     "Round",
     "SLOBand",
     "Span",
+    "TIMELINE_FILENAME",
     "Telemetry",
     "TelemetryCollector",
+    "TimelineStore",
     "Tracer",
     "assemble",
     "assemble_dir",
     "band_for",
     "default_bands",
+    "fit_slope",
     "get_telemetry",
     "install_jax_hooks",
     "lower_is_better",
@@ -105,6 +116,7 @@ __all__ = [
     "new_span_id",
     "new_trace_id",
     "parse_ident",
+    "quantile_from_buckets",
     "render_prometheus",
     "set_telemetry",
 ]

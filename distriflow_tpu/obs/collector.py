@@ -209,10 +209,18 @@ class TelemetryCollector:
         self.full_reports = 0  # guarded-by: _lock
         self.stale_dropped = 0  # guarded-by: _lock
         self.clients_evicted = 0  # guarded-by: _lock
-        self._c_reports = telemetry.counter("fleet_reports_total")
-        self._c_full = telemetry.counter("fleet_reports_full_total")
-        self._c_stale = telemetry.counter("fleet_reports_stale_total")
-        self._c_evicted = telemetry.counter("fleet_clients_evicted_total")
+        self._c_reports = telemetry.counter(
+            "fleet_reports_total",
+            help="client telemetry reports ingested by the collector")
+        self._c_full = telemetry.counter(
+            "fleet_reports_full_total",
+            help="full (non-delta) telemetry reports ingested")
+        self._c_stale = telemetry.counter(
+            "fleet_reports_stale_total",
+            help="reports dropped for stale/duplicate sequence numbers")
+        self._c_evicted = telemetry.counter(
+            "fleet_clients_evicted_total",
+            help="client rows evicted after the retention deadline")
 
     # -- ingest -------------------------------------------------------------
 

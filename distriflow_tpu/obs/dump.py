@@ -29,6 +29,17 @@ every ``--interval`` seconds it re-reads the latest snapshot row and
 prints which counters/gauges moved (``--iterations`` bounds the loop;
 0 = forever).
 
+``--timeline`` renders the run's ``timeline.jsonl`` (written when the
+run was started with a timeline sampling interval — see
+``docs/OBSERVABILITY.md`` §12): per-ident ASCII sparklines on a shared
+time axis, an event-marker strip (controller adaptations/ramps, churn
+kills/rejoins, SLO breaches, quarantines, resyncs), and a timestamped
+event legend. ``--window S`` clips to the trailing S seconds;
+``--idents a,b`` overrides the auto-picked movers. ``--watch`` shares
+the same machinery: each poll feeds the latest snapshot row into an
+in-memory timeline store and prints windowed deltas across the last two
+samples.
+
 ``--fleet`` renders the fleet telemetry plane (docs/OBSERVABILITY.md
 §10) from a SERVER's run dir: the per-client table (connection state,
 server-observed round latency, and the client-authoritative columns the
@@ -249,11 +260,248 @@ def summarize_requests(run_dir: str, max_rounds: int = 20,
         assembly, max_rounds=max_rounds, tier=tier)
 
 
+#: sparkline glyphs, 0 = empty bin; values map onto indices 1..8
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+#: event-kind -> single-letter axis marker (anything else renders "*")
+_EVENT_LETTERS = {
+    "controller_adapt": "A",
+    "controller_ramp": "R",
+    "churn_kill": "K",
+    "churn_rejoin": "J",
+    "slo_breach": "B",
+    "quarantine": "Q",
+    "resync": "S",
+    "rollback": "L",
+    "lease_expiry": "E",
+}
+
+
+def _bin_index(t: float, t_lo: float, t_hi: float, width: int) -> int:
+    if t_hi <= t_lo:
+        return 0
+    return min(width - 1, max(0, int((t - t_lo) / (t_hi - t_lo) * width)))
+
+
+def _sparkline(bins: List[Any]) -> str:
+    """Render per-bin values (None = no data) as a ``▁▂▃▄▅▆▇█`` strip."""
+    present = [v for v in bins if v is not None]
+    if not present:
+        return " " * len(bins)
+    lo, hi = min(present), max(present)
+    out = []
+    for v in bins:
+        if v is None:
+            out.append(" ")
+        elif hi <= lo:
+            out.append(_SPARK[5])  # flat series: mid-height
+        else:
+            out.append(_SPARK[1 + int(round((v - lo) / (hi - lo) * 7.0))])
+    return "".join(out)
+
+
+def _bin_deltas(samples: List[Dict[str, Any]], values: List[Any],
+                t_lo: float, t_hi: float, width: int) -> List[Any]:
+    """Per-bin increase of a cumulative series (counter values or
+    histogram counts); a bin stays None until a sample-to-sample delta
+    lands in it."""
+    bins: List[Any] = [None] * width
+    prev = None
+    for s, cur in zip(samples, values):
+        if cur is None:
+            continue
+        if prev is not None and t_lo <= s["t"] <= t_hi:
+            b = _bin_index(s["t"], t_lo, t_hi, width)
+            bins[b] = (bins[b] or 0.0) + max(0.0, float(cur) - prev)
+        prev = float(cur)
+    return bins
+
+
+def _bin_means(samples: List[Dict[str, Any]], values: List[Any],
+               t_lo: float, t_hi: float, width: int) -> List[Any]:
+    """Per-bin mean of a point-in-time series (gauge values)."""
+    sums = [0.0] * width
+    counts = [0] * width
+    for s, v in zip(samples, values):
+        if v is None or not (t_lo <= s["t"] <= t_hi):
+            continue
+        b = _bin_index(s["t"], t_lo, t_hi, width)
+        sums[b] += float(v)
+        counts[b] += 1
+    return [sums[i] / counts[i] if counts[i] else None
+            for i in range(width)]
+
+
+def _timeline_pick_idents(store: Any, samples: List[Dict[str, Any]],
+                          window_s: float) -> List[Any]:
+    """Auto-select the idents worth plotting: the counters that moved
+    most, the gauges that swung most, the histograms that observed most.
+    Returns ``[(kind, ident), ...]``."""
+    newest = samples[-1]
+    ranked = []
+    for k in newest["counters"]:
+        ranked.append((abs(store.delta(k, window_s) or 0.0), "counter", k))
+    for k in newest["gauges"]:
+        st = store.gauge_stats(k, window_s)
+        ranked.append(((st["max"] - st["min"]) if st else 0.0, "gauge", k))
+    for k in newest["hists"]:
+        d = store.hist_delta(k, window_s)
+        ranked.append((float(d["count"]) if d else 0.0, "hist", k))
+    ranked.sort(key=lambda r: -r[0])
+    moved = [(kind, k) for score, kind, k in ranked if score > 0.0]
+    picks = ([(k, i) for k, i in moved if k == "counter"][:4]
+             + [(k, i) for k, i in moved if k == "gauge"][:2]
+             + [(k, i) for k, i in moved if k == "hist"][:2])
+    for score, kind, k in ranked:  # pad flat runs up to a useful minimum
+        if len(picks) >= 3:
+            break
+        if (kind, k) not in picks:
+            picks.append((kind, k))
+    return picks
+
+
+def _timeline_resolve_idents(samples: List[Dict[str, Any]],
+                             wanted: List[str]) -> List[Any]:
+    """Map ``--idents`` entries (exact ident, or bare metric name
+    matching every labeled ident of that metric) to ``(kind, ident)``."""
+    newest = samples[-1]
+    kinds = {}
+    for kind in ("counter", "gauge", "hist"):
+        for k in newest[kind + "s"]:
+            kinds[k] = kind
+    out = []
+    for want in wanted:
+        if want in kinds:
+            out.append((kinds[want], want))
+            continue
+        hits = [k for k in sorted(kinds) if k.split("{", 1)[0] == want]
+        out.extend((kinds[k], k) for k in hits)
+        if not hits:
+            out.append((None, want))  # rendered as a "(not found)" row
+    return out
+
+
+def summarize_timeline(run_dir: str, window_s: float = None,
+                       idents: List[str] = None,
+                       width: int = 60) -> "tuple[List[str], bool]":
+    """Render the run timeline — per-ident sparklines with event markers
+    on a shared time axis — from ``timeline.jsonl`` alone. Returns
+    ``(lines, found)``."""
+    from distriflow_tpu.obs.timeline import TIMELINE_FILENAME, TimelineStore
+
+    path = run_dir
+    if not path.endswith(".jsonl"):
+        path = os.path.join(run_dir, TIMELINE_FILENAME)
+    if not os.path.exists(path):
+        return [f"(no {TIMELINE_FILENAME} in {run_dir} — was the run "
+                f"started with a timeline interval?)"], False
+    store = TimelineStore.load(path)
+    samples = store.samples()
+    events = store.events()
+    head = f"timeline: {len(samples)} sample(s), {len(events)} event(s)"
+    if store.skipped:
+        head += f" [{store.skipped} malformed line(s) skipped]"
+    head += f" ({path})"
+    lines = [head]
+    if not samples:
+        lines.append("  (no samples)")
+        return lines, True
+    # the shared axis spans samples AND events: a breach stamped after
+    # the final sample (e.g. a post-run sentinel check) must still land
+    # on the strip instead of being clipped
+    all_t = [s["t"] for s in samples] + [e["t"] for e in events]
+    t_hi = max(all_t)
+    t_lo = min(all_t)
+    if window_s is not None:
+        t_lo = max(t_lo, t_hi - float(window_s))
+        samples = [s for s in samples if s["t"] >= t_lo]
+        events = [e for e in events if e["t"] >= t_lo]
+        if not samples:
+            lines.append(f"  (no samples in the trailing {window_s:g}s)")
+            return lines, True
+    span = t_hi - t_lo
+    # size the window queries to the clipped axis so stats match the strip
+    q_window = span + 1e-9 if span > 0 else None
+    if idents:
+        picked = _timeline_resolve_idents(samples, idents)
+    else:
+        picked = _timeline_pick_idents(store, samples, q_window)
+    lines.append(f"  span={span:.2f}s bins={width} "
+                 f"bin={span / width * 1000.0:.0f}ms" if span > 0
+                 else f"  span=0.00s (single instant)")
+    label_w = max([len(i) for _, i in picked] + [6])
+    label_w = min(label_w, 40)
+    for kind, ident in picked:
+        label = ident[:label_w].ljust(label_w)
+        if kind is None:
+            lines.append(f"  {label} (not found in the newest sample)")
+            continue
+        if kind == "counter":
+            vals = [s["counters"].get(ident) for s in samples]
+            bins = _bin_deltas(samples, vals, t_lo, t_hi, width)
+            d = store.delta(ident, q_window) or 0.0
+            r = store.rate(ident, q_window)
+            note = f"delta={d:g}" + (f" rate={r:.3g}/s" if r is not None
+                                     else "")
+        elif kind == "gauge":
+            vals = [s["gauges"].get(ident) for s in samples]
+            bins = _bin_means(samples, vals, t_lo, t_hi, width)
+            st = store.gauge_stats(ident, q_window)
+            note = (f"min={st['min']:g} mean={st['mean']:g} "
+                    f"max={st['max']:g}" if st else "")
+        else:
+            vals = [(s["hists"].get(ident) or {}).get("count")
+                    for s in samples]
+            bins = _bin_deltas(samples, vals, t_lo, t_hi, width)
+            summ = store.window_summary(ident, q_window)
+            note = (f"n={summ['count']:g} p50={summ['p50']:g} "
+                    f"p95={summ['p95']:g}" if summ else "n=0")
+        lines.append(f"  {label} |{_sparkline(bins)}| {note}")
+    # event marker strip on the same axis
+    marker = [" "] * width
+    for e in events:
+        b = _bin_index(e["t"], t_lo, t_hi, width)
+        letter = _EVENT_LETTERS.get(e["kind"], "*")
+        marker[b] = letter if marker[b] in (" ", letter) else "*"
+    lines.append(f"  {'events'.ljust(label_w)} |{''.join(marker)}| "
+                 f"{len(events)} event(s)")
+    shown = events[:20]
+    for e in shown:
+        letter = _EVENT_LETTERS.get(e["kind"], "*")
+        fields = " ".join(f"{k}={e[k]}" for k in sorted(e)
+                          if k not in ("t", "kind"))
+        lines.append(f"    +{e['t'] - t_lo:.2f}s {letter} {e['kind']}"
+                     + (f" {fields}" if fields else ""))
+    if len(events) > len(shown):
+        lines.append(f"    (+{len(events) - len(shown)} more)")
+    return lines, True
+
+
+def _snapshot_scalars(row: Dict[str, Any]
+                      ) -> "tuple[Dict[str, float], Dict[str, float]]":
+    """Split one flattened snapshot row back into counter/gauge maps."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    for k, v in row.items():
+        if not isinstance(v, (int, float)):
+            continue
+        if k.startswith("counter:"):
+            counters[k.split(":", 1)[1]] = float(v)
+        elif k.startswith("gauge:"):
+            gauges[k.split(":", 1)[1]] = float(v)
+    return counters, gauges
+
+
 def watch(run_dir: str, interval: float, iterations: int) -> int:
-    """Live mode: poll the latest snapshot row and print counter/gauge
-    movement between polls. Returns 0 once a metrics file was seen."""
+    """Live mode: feed each polled snapshot row into an offline
+    :class:`~distriflow_tpu.obs.timeline.TimelineStore` and print the
+    windowed movement between the last two samples — the same delta
+    machinery ``--timeline`` rates come from. Returns 0 once a metrics
+    file was seen."""
+    from distriflow_tpu.obs.timeline import TimelineStore
+
     metrics_path = os.path.join(run_dir, METRICS_FILENAME)
-    prev: Dict[str, float] = None
+    store = TimelineStore()  # offline: fed by hand, no thread, no sink
     seen = False
     i = 0
     while iterations <= 0 or i < iterations:
@@ -270,23 +518,39 @@ def watch(run_dir: str, interval: float, iterations: int) -> int:
         if not rows:
             print(f"watch[{i}] (no telemetry_snapshot rows yet)", flush=True)
             continue
-        vals = {k: float(v) for k, v in rows[-1].items()
-                if k.startswith(("counter:", "gauge:"))
-                and isinstance(v, (int, float))}
-        changed = sorted(vals) if prev is None else sorted(
-            k for k in vals if vals[k] != prev.get(k))
+        counters, gauges = _snapshot_scalars(rows[-1])
+        t = float(rows[-1].get("snapshot_time") or time.time())
+        samples = store.samples()
+        fresh = not samples or t > samples[-1]["t"]
+        if fresh:
+            store.add_sample(t, counters, gauges)
+            samples = store.samples()
         parts = []
-        for k in changed[:12]:
-            name = k.split(":", 1)[1]
-            if prev is not None and k in prev:
-                parts.append(f"{name} {prev[k]:g}->{vals[k]:g}")
-            else:
-                parts.append(f"{name}={vals[k]:g}")
-        if len(changed) > 12:
-            parts.append(f"(+{len(changed) - 12} more)")
+        n_changed = 0
+        if fresh and len(samples) == 1:
+            # first sample: everything is new, show absolute values
+            idents = sorted(set(counters) | set(gauges))
+            n_changed = len(idents)
+            parts = [f"{k}={counters.get(k, gauges.get(k)):g}"
+                     for k in idents[:12]]
+        elif fresh:
+            # windowed delta across the last two samples — the same
+            # edge-subtraction --timeline rates come from
+            # epsilon so t1 - window_s lands at-or-before the previous
+            # sample's exact timestamp despite float rounding
+            dt = samples[-1]["t"] - samples[-2]["t"] + 1e-9
+            for k in sorted(set(counters) | set(gauges)):
+                d = store.delta(k, window_s=dt)
+                if not d:
+                    continue
+                n_changed += 1
+                if n_changed <= 12:
+                    cur = counters.get(k, gauges.get(k))
+                    parts.append(f"{k} {cur - d:g}->{cur:g}")
+        if n_changed > 12:
+            parts.append(f"(+{n_changed - 12} more)")
         print(f"watch[{i}] {len(rows)} snapshot(s); "
               + ("; ".join(parts) if parts else "no change"), flush=True)
-        prev = vals
     return 0 if seen else 2
 
 
@@ -316,6 +580,17 @@ def main(argv: List[str] = None) -> int:
                         help="render the fleet telemetry plane (per-client "
                              "table + fleet/* aggregates) from a server "
                              "run dir")
+    parser.add_argument("--timeline", action="store_true",
+                        help="render timeline.jsonl as per-ident "
+                             "sparklines with event markers on a shared "
+                             "time axis")
+    parser.add_argument("--window", type=float, default=None,
+                        help="with --timeline: only the trailing WINDOW "
+                             "seconds (default: the whole run)")
+    parser.add_argument("--idents", type=str, default=None,
+                        help="with --timeline: comma-separated idents (or "
+                             "bare metric names) to plot instead of the "
+                             "auto-picked movers")
     parser.add_argument("--watch", action="store_true",
                         help="poll the latest snapshot and print deltas "
                              "(with --fleet: re-render the live table)")
@@ -332,6 +607,14 @@ def main(argv: List[str] = None) -> int:
         print("\n".join(summarize_fleet(args.run_dir)))
         return 0 if os.path.exists(
             os.path.join(args.run_dir, METRICS_FILENAME)) else 2
+
+    if args.timeline:
+        wanted = ([s.strip() for s in args.idents.split(",") if s.strip()]
+                  if args.idents else None)
+        lines, found = summarize_timeline(
+            args.run_dir, window_s=args.window, idents=wanted)
+        print("\n".join(lines))
+        return 0 if found else 2
 
     if args.watch:
         return watch(args.run_dir, args.interval, args.iterations)

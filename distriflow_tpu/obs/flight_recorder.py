@@ -36,6 +36,24 @@ FLIGHT_SCHEMA = 1
 _SENSITIVE = re.compile(
     r"secret|token|password|passwd|credential|api_key|auth", re.IGNORECASE)
 _MAX_STR = 256  # longest string value kept per event field
+_MAX_SEQ = 64  # longest list/tuple value kept per event field
+
+
+def _scrub_value(v: Any, depth: int = 0) -> Any:
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return f"<{len(v)} bytes>"
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, str):
+        return v if len(v) <= _MAX_STR else v[:_MAX_STR] + "..."
+    if isinstance(v, (list, tuple)) and depth < 2:
+        # bounded scalar series (e.g. a breach bundle's trailing
+        # timeline series) stay structured — newest items win
+        return [_scrub_value(x, depth + 1) for x in list(v)[-_MAX_SEQ:]]
+    r = repr(v)
+    return r if len(r) <= _MAX_STR else r[:_MAX_STR] + "..."
 
 
 def _scrub(fields: Dict[str, Any]) -> Dict[str, Any]:
@@ -44,17 +62,8 @@ def _scrub(fields: Dict[str, Any]) -> Dict[str, Any]:
     for k, v in fields.items():
         if _SENSITIVE.search(k):
             out[k] = "<redacted>"
-        elif isinstance(v, (bytes, bytearray, memoryview)):
-            out[k] = f"<{len(v)} bytes>"
-        elif isinstance(v, bool) or v is None:
-            out[k] = v
-        elif isinstance(v, (int, float)):
-            out[k] = v
-        elif isinstance(v, str):
-            out[k] = v if len(v) <= _MAX_STR else v[:_MAX_STR] + "..."
         else:
-            r = repr(v)
-            out[k] = r if len(r) <= _MAX_STR else r[:_MAX_STR] + "..."
+            out[k] = _scrub_value(v)
     return out
 
 

@@ -33,6 +33,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Mapping, Optional
 
+from distriflow_tpu.obs.registry import metric_ident
+
 BREACH_COUNTER = "obs_slo_breach_total"
 
 #: histogram stats a band may bind to (anything else reads ``.value``)
@@ -41,7 +43,26 @@ _HIST_STATS = ("p50", "p95", "p99", "min", "max", "count", "sum")
 
 @dataclass(frozen=True)
 class SLOBand:
-    """One declared objective: ``lower <= stat(metric{labels}) <= upper``."""
+    """One declared objective: ``lower <= stat(metric{labels}) <= upper``.
+
+    ``kind`` selects how the bound is judged (docs/OBSERVABILITY.md
+    §12):
+
+    - ``"point"`` (default): the live registry value, each check;
+    - ``"sustained"``: the bound must be violated at ≥
+      ``sustained_samples`` consecutive observed timeline samples
+      spanning ≥ ``sustained_s`` seconds within the trailing
+      ``window_s`` — a transient spike shorter than that never trips;
+    - ``"slope"``: the least-squares rate-of-change (per second) of the
+      series over the trailing ``window_s`` is what ``upper``/``lower``
+      bound — a ramp is caught while the level is still in band.
+
+    Timeline kinds read ``stat`` as a series statistic: ``value`` /
+    ``rate`` for counters and gauges, ``p50``/``p95``/``p99``/``mean``
+    (per-interval bucket-delta) or ``count``/``rate`` for histograms.
+    They are *unknown* (never breach) until the sentinel's telemetry has
+    a started timeline with enough samples.
+    """
 
     name: str                 # band identity (label on the breach counter)
     metric: str               # registry metric name
@@ -50,6 +71,10 @@ class SLOBand:
     upper: Optional[float] = None
     lower: Optional[float] = None
     min_count: int = 1        # histogram bands: samples required to judge
+    kind: str = "point"       # "point" | "sustained" | "slope"
+    window_s: float = 30.0    # trailing timeline window examined
+    sustained_samples: int = 3  # min consecutive out-of-band observations
+    sustained_s: float = 0.0  # min wall-clock span of the breaching run
 
 
 def default_bands(*, mfu_floor: Optional[float] = None,
@@ -129,7 +154,8 @@ class HealthSentinel:
                  collector: Any = None,
                  fleet_straggler_factor: Optional[float] = None,
                  fleet_ack_p99_ms: Optional[float] = None,
-                 fleet_min_count: int = 8):
+                 fleet_min_count: int = 8,
+                 timeline: Any = None):
         if telemetry is None:
             from distriflow_tpu.obs.telemetry import get_telemetry
             telemetry = get_telemetry()
@@ -146,7 +172,16 @@ class HealthSentinel:
         self.fleet_straggler_factor = fleet_straggler_factor
         self.fleet_ack_p99_ms = fleet_ack_p99_ms
         self.fleet_min_count = int(fleet_min_count)
+        # sustained/slope bands read series from this timeline store;
+        # None resolves to the telemetry's (NOOP until start_timeline,
+        # under which timeline bands stay unknown)
+        self._timeline = timeline
         self._in_breach: Dict[str, bool] = {}
+
+    @property
+    def timeline(self) -> Any:
+        return (self._timeline if self._timeline is not None
+                else self.telemetry.timeline)
 
     def observe(self, band: SLOBand) -> Optional[float]:
         """Current value of a band's bound stat, or None when unknown."""
@@ -160,30 +195,86 @@ class HealthSentinel:
             return float(s[band.stat])
         return float(m.value)
 
+    def _out_of_band(self, band: SLOBand, v: float) -> bool:
+        return ((band.upper is not None and v > band.upper)
+                or (band.lower is not None and v < band.lower))
+
+    def _observe_sustained(self, band: SLOBand
+                           ) -> "tuple[bool, Dict[str, Any]]":
+        """``sustained`` kind: the trailing run of consecutive observed
+        samples that violate the bound must be ≥ ``sustained_samples``
+        long and span ≥ ``sustained_s`` seconds. Unobserved samples
+        (e.g. a histogram interval with no new observations) are
+        transparent — they neither extend nor break the run — so a
+        single spike stays a run of one no matter how long its value
+        would linger in a trailing-window quantile."""
+        series = self.timeline.series(
+            metric_ident(band.metric, band.labels), band.stat,
+            window_s=band.window_s)
+        obs = [(t, v) for t, v in series if v is not None]
+        extra: Dict[str, Any] = {
+            "observed": obs[-1][1] if obs else None,
+            "series": [(round(t, 3), v) for t, v in obs[-64:]],
+        }
+        run: List[Any] = []
+        for t, v in reversed(obs):
+            if not self._out_of_band(band, v):
+                break
+            run.append(t)
+        extra["run_samples"] = len(run)
+        if run:
+            extra["run_s"] = round(run[0] - run[-1], 3)
+        breached = (len(run) >= max(1, band.sustained_samples)
+                    and (run[0] - run[-1]) >= band.sustained_s if run
+                    else False)
+        return breached, extra
+
+    def _observe_slope(self, band: SLOBand
+                       ) -> "tuple[bool, Dict[str, Any]]":
+        """``slope`` kind: bound the least-squares per-second trend of
+        the observed series over the trailing window."""
+        from distriflow_tpu.obs.timeline import fit_slope
+        series = self.timeline.series(
+            metric_ident(band.metric, band.labels), band.stat,
+            window_s=band.window_s)
+        pts = [(t, v) for t, v in series if v is not None]
+        extra: Dict[str, Any] = {
+            "series": [(round(t, 3), v) for t, v in pts[-64:]],
+        }
+        if len(pts) < 3:
+            extra["observed"] = None
+            return False, extra
+        slope = fit_slope(pts)
+        extra["observed"] = slope
+        if slope is None:
+            return False, extra
+        return self._out_of_band(band, slope), extra
+
     def check(self) -> List[Dict[str, Any]]:
         """Evaluate every band; returns the bands that newly ENTERED
         breach this call (each already counted and flight-dumped)."""
         entered: List[Dict[str, Any]] = []
         for band in self.bands:
-            observed = self.observe(band)
-            breached = observed is not None and (
-                (band.upper is not None and observed > band.upper)
-                or (band.lower is not None and observed < band.lower))
-            was = self._in_breach.get(band.name, False)
-            self._in_breach[band.name] = breached
-            if breached and not was:
-                detail = {
-                    "band": band.name, "metric": band.metric,
-                    "stat": band.stat, "observed": observed,
-                    "upper": band.upper, "lower": band.lower,
-                }
-                self.telemetry.counter(BREACH_COUNTER, band=band.name).inc()
-                flight = self.telemetry.flight
-                flight.record("slo_breach", **detail)
-                bundle = flight.dump(f"slo_{band.name}",
-                                     save_dir=self.dump_dir, **detail)
-                detail["bundle"] = bundle
-                entered.append(detail)
+            if band.kind == "sustained":
+                breached, extra = self._observe_sustained(band)
+            elif band.kind == "slope":
+                breached, extra = self._observe_slope(band)
+            else:
+                observed = self.observe(band)
+                breached = observed is not None and self._out_of_band(
+                    band, observed)
+                extra = {"observed": observed}
+            detail = {
+                "band": band.name, "metric": band.metric,
+                "stat": band.stat, "kind": band.kind,
+            }
+            detail.update(extra)
+            detail["upper"] = band.upper
+            detail["lower"] = band.lower
+            hit = self._enter_breach(band.name, band.name, breached,
+                                     detail, f"slo_{band.name}")
+            if hit is not None:
+                entered.append(hit)
         entered.extend(self._check_fleet())
         return entered
 
@@ -197,9 +288,19 @@ class HealthSentinel:
         self._in_breach[key] = breached
         if not breached or was:
             return None
-        self.telemetry.counter(BREACH_COUNTER, band=band).inc()
+        self.telemetry.counter(
+            BREACH_COUNTER, band=band,
+            help="SLO band entries into breach (edge-triggered)").inc()
+        self.telemetry.timeline.event(
+            "slo_breach", band=band, observed=detail.get("observed"))
         flight = self.telemetry.flight
-        flight.record("slo_breach", **detail)
+        # the flight event drops the bulky series; "kind" is the event
+        # kind slot, so the band's judge kind rides as band_kind
+        record = {k: v for k, v in detail.items()
+                  if k not in ("series", "kind")}
+        if "kind" in detail:
+            record["band_kind"] = detail["kind"]
+        flight.record("slo_breach", **record)
         detail["bundle"] = flight.dump(dump_name, save_dir=self.dump_dir,
                                        **detail)
         return detail

@@ -49,7 +49,10 @@ def _sample_device_memory(telemetry: Any) -> None:
         if peak is None:
             continue
         label = f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', 0)}"
-        telemetry.gauge("device_peak_bytes", device=label).set(float(peak))
+        telemetry.gauge(
+            "device_peak_bytes", device=label,
+            help="peak accelerator memory in use, per device",
+        ).set(float(peak))
 
 
 def install_jax_hooks(telemetry: Optional[Any] = None) -> bool:
@@ -74,7 +77,9 @@ def install_jax_hooks(telemetry: Optional[Any] = None) -> bool:
     except Exception:
         return False
 
-    counter = telemetry.counter("jit_recompiles_total")
+    counter = telemetry.counter(
+        "jit_recompiles_total",
+        help="XLA compilations observed via jax.monitoring")
 
     def _on_duration(event: str, duration_secs: float, **kwargs: Any) -> None:
         if event == _COMPILE_EVENT:

@@ -161,9 +161,14 @@ class PhaseProfiler:
         self._hists: Dict[str, Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._local = threading.local()
-        self._h_wall = registry.histogram(STEP_WALL, role=role)
-        self._h_overlap = registry.histogram(STEP_OVERLAP, role=role)
-        self._h_idle = registry.histogram(STEP_IDLE, role=role)
+        self._h_wall = registry.histogram(
+            STEP_WALL, role=role, help="wall time per profiled step (ms)")
+        self._h_overlap = registry.histogram(
+            STEP_OVERLAP, role=role,
+            help="phase time overlapped with other phases per step (ms)")
+        self._h_idle = registry.histogram(
+            STEP_IDLE, role=role,
+            help="step wall time covered by no phase (ms)")
 
     def _hist(self, name: str) -> Any:
         # Deliberate double-checked fast path: dict.get on a never-shrinking
@@ -175,7 +180,8 @@ class PhaseProfiler:
                 h = self._hists.get(name)
                 if h is None:
                     h = self._registry.histogram(
-                        "phase_ms", phase=name, role=self.role)
+                        "phase_ms", phase=name, role=self.role,
+                        help="time in one named phase (ms), per role")
                     self._hists[name] = h
         return h
 

@@ -292,6 +292,9 @@ class MetricsRegistry:
         self.enabled = bool(enabled)
         self.histogram_window = histogram_window
         self._metrics: Dict[LabelKey, Any] = {}
+        # per-NAME help text (shared across label sets; first writer
+        # wins) — the `# HELP` line in the Prometheus exposition
+        self._help: Dict[str, str] = {}
         self._lock = threading.Lock()
 
     def _get(self, cls, name: str, labels: Dict[str, Any], **kw):
@@ -307,16 +310,28 @@ class MetricsRegistry:
                     self._metrics[key] = m
         return m
 
-    def counter(self, name: str, **labels: Any) -> Counter:
+    def counter(self, name: str, help: Optional[str] = None,
+                **labels: Any) -> Counter:
+        if help:
+            self._help.setdefault(name, help)
         return self._get(Counter, name, labels)
 
-    def gauge(self, name: str, **labels: Any) -> Gauge:
+    def gauge(self, name: str, help: Optional[str] = None,
+              **labels: Any) -> Gauge:
+        if help:
+            self._help.setdefault(name, help)
         return self._get(Gauge, name, labels)
 
     def histogram(self, name: str, window: Optional[int] = None,
-                  **labels: Any) -> Histogram:
+                  help: Optional[str] = None, **labels: Any) -> Histogram:
+        if help:
+            self._help.setdefault(name, help)
         return self._get(Histogram, name, labels,
                          window=window or self.histogram_window)
+
+    def help_text(self, name: str) -> Optional[str]:
+        """The registered ``help=`` text for a metric name, if any."""
+        return self._help.get(name)
 
     # -- read side ---------------------------------------------------------
 
@@ -360,6 +375,20 @@ class MetricsRegistry:
                 out["histograms"][ident] = m.summary()
         return out
 
+    def scalars(self) -> Tuple[Dict[str, float], Dict[str, float]]:
+        """``(counters, gauges)`` values keyed by snapshot ident — the
+        timeline sampler's cheap read (no histogram window sorting)."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        for (name, labels), m in metrics:
+            if isinstance(m, Counter):
+                counters[metric_ident(name, labels)] = m.value
+            elif isinstance(m, Gauge):
+                gauges[metric_ident(name, labels)] = m.value
+        return counters, gauges
+
     def histogram_states(self, max_window: Optional[int] = None
                          ) -> Dict[str, Dict[str, Any]]:
         """Mergeable :meth:`Histogram.export_state` per histogram, keyed
@@ -394,28 +423,33 @@ def render_prometheus(registry: "MetricsRegistry") -> str:
 
     Counters render as ``counter``, gauges as ``gauge``, histograms as
     summaries (windowed quantiles + exact ``_count``/``_sum``) — scrape
-    this from a debug endpoint or dump it at run end.
+    this from a debug endpoint or dump it at run end. Metrics registered
+    with ``help=`` text get a ``# HELP`` line ahead of their ``# TYPE``.
     """
     with registry._lock:
         metrics = sorted(registry._metrics.items(), key=lambda kv: kv[0])
     lines = []
     typed = set()
+
+    def _head(pname: str, name: str, ptype: str) -> None:
+        if pname in typed:
+            return
+        typed.add(pname)
+        h = registry._help.get(name)
+        if h:
+            lines.append(f"# HELP {pname} {h}")
+        lines.append(f"# TYPE {pname} {ptype}")
+
     for (name, labels), m in metrics:
         pname = _prom_name(name)
         if isinstance(m, Counter):
-            if pname not in typed:
-                lines.append(f"# TYPE {pname} counter")
-                typed.add(pname)
+            _head(pname, name, "counter")
             lines.append(f"{pname}{_prom_labels(labels)} {m.value:g}")
         elif isinstance(m, Gauge):
-            if pname not in typed:
-                lines.append(f"# TYPE {pname} gauge")
-                typed.add(pname)
+            _head(pname, name, "gauge")
             lines.append(f"{pname}{_prom_labels(labels)} {m.value:g}")
         elif isinstance(m, Histogram):
-            if pname not in typed:
-                lines.append(f"# TYPE {pname} summary")
-                typed.add(pname)
+            _head(pname, name, "summary")
             s = m.summary()
             for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
                 qlabel = 'quantile="%s"' % q

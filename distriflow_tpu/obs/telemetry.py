@@ -46,17 +46,19 @@ class Telemetry:
         self._fleet_providers: Dict[Any, Any] = {}
         self._samplers: list = []
         self._process_sampler_on = False
+        self._timeline = None
 
     # -- handle factories (delegate to the registry) -----------------------
 
-    def counter(self, name: str, **labels: Any):
-        return self.registry.counter(name, **labels)
+    def counter(self, name: str, help: Optional[str] = None, **labels: Any):
+        return self.registry.counter(name, help=help, **labels)
 
-    def gauge(self, name: str, **labels: Any):
-        return self.registry.gauge(name, **labels)
+    def gauge(self, name: str, help: Optional[str] = None, **labels: Any):
+        return self.registry.gauge(name, help=help, **labels)
 
-    def histogram(self, name: str, **labels: Any):
-        return self.registry.histogram(name, **labels)
+    def histogram(self, name: str, help: Optional[str] = None,
+                  **labels: Any):
+        return self.registry.histogram(name, help=help, **labels)
 
     def span(self, name: str, trace_id: Optional[str] = None,
              parent_id: Optional[str] = None, **attrs: Any):
@@ -93,6 +95,44 @@ class Telemetry:
                     self._flight = FlightRecorder(save_dir=self.save_dir)
         return self._flight
 
+    # -- timeline (obs/timeline.py; docs/OBSERVABILITY.md §12) -------------
+
+    @property
+    def timeline(self):
+        """The process timeline store — the shared ``NOOP_TIMELINE``
+        until :meth:`start_timeline` (or when disabled), so event call
+        sites never pay for an unstarted timeline."""
+        from distriflow_tpu.obs.timeline import NOOP_TIMELINE
+        if not self.enabled or self._timeline is None:
+            return NOOP_TIMELINE
+        return self._timeline
+
+    def start_timeline(self, interval_s: float = 0.25,
+                       save_dir: Optional[str] = None,
+                       capacity: int = 4096):
+        """Start (or return, idempotently) the background timeline
+        sampler; samples + events persist to ``<save_dir>/timeline.jsonl``
+        (defaulting to this telemetry's ``save_dir``; in-memory-only
+        when both are None). Returns the live store (``NOOP_TIMELINE``
+        when disabled)."""
+        from distriflow_tpu.obs.timeline import NOOP_TIMELINE, TimelineStore
+        if not self.enabled:
+            return NOOP_TIMELINE
+        with self._profilers_lock:
+            if self._timeline is None:
+                self._timeline = TimelineStore(
+                    telemetry=self, interval_s=interval_s,
+                    capacity=capacity,
+                    save_dir=self.save_dir if save_dir is None else save_dir)
+        return self._timeline.start()
+
+    def stop_timeline(self) -> None:
+        """Stop the background sampler (keeps the store attached, so
+        windowed queries over the retained ring keep working)."""
+        t = self._timeline
+        if t is not None:
+            t.stop()
+
     # -- fleet health table -------------------------------------------------
 
     def register_fleet(self, key: Any, provider) -> None:
@@ -125,8 +165,10 @@ class Telemetry:
             return
         self._process_sampler_on = True
         import resource  # stdlib on POSIX; this repo targets Linux/TPU VMs
-        rss = self.registry.gauge("process_rss_bytes")
-        cpu = self.registry.gauge("process_cpu_s")
+        rss = self.registry.gauge(
+            "process_rss_bytes", help="peak process RSS (ru_maxrss)")
+        cpu = self.registry.gauge(
+            "process_cpu_s", help="user+system CPU seconds this process")
 
         def _sample() -> None:
             ru = resource.getrusage(resource.RUSAGE_SELF)

@@ -121,7 +121,10 @@ def pick_block_k(s: int, hd: int = 512, kv_item: int = 2,
 def _note_gated(s: int, hd: int, kv_item: int) -> None:
     from distriflow_tpu.obs import get_telemetry
 
-    get_telemetry().counter("ops_flash_decode_gated_total").inc()
+    get_telemetry().counter(
+        "ops_flash_decode_gated_total",
+        help="decode calls routed to the XLA fallback by shape gating",
+    ).inc()
     key = (s, hd, kv_item)
     if key not in _warned_gated:
         _warned_gated.add(key)
@@ -451,7 +454,10 @@ def supports_paged(page_size: int, hd: int = 512, kv_item: int = 2) -> bool:
         return True
     from distriflow_tpu.obs import get_telemetry
 
-    get_telemetry().counter("ops_flash_decode_gated_total").inc()
+    get_telemetry().counter(
+        "ops_flash_decode_gated_total",
+        help="decode calls routed to the XLA fallback by shape gating",
+    ).inc()
     key = (page_size, hd, kv_item)
     if key not in _warned_paged:
         _warned_paged.add(key)

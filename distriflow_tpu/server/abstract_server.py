@@ -108,6 +108,11 @@ class DistributedServerConfig:
     # instance; tests/doctor pass one shared Telemetry to both endpoints so
     # cross-endpoint traces land in a single tracer
     telemetry: Optional[Telemetry] = None
+    # time-resolved telemetry (docs/OBSERVABILITY.md §12): > 0 starts the
+    # telemetry's background timeline sampler at this period for the life
+    # of the server (samples + events persist to save_dir/timeline.jsonl);
+    # 0 leaves the timeline unstarted
+    timeline_interval_s: float = 0.0
 
 
 class AbstractServer:
@@ -152,21 +157,45 @@ class AbstractServer:
             telemetry=self.telemetry,
         )
         # cached handles: per-event cost is one attribute bump
-        self._g_clients = self.telemetry.gauge("server_connected_clients")
-        self._g_version = self.telemetry.gauge("server_model_version")
-        self._c_uploads = self.telemetry.counter("server_uploads_total")
-        self._c_dedup = self.telemetry.counter("server_dedup_hits_total")
-        self._c_recoveries = self.telemetry.counter("server_recoveries_total")
+        self._g_clients = self.telemetry.gauge(
+            "server_connected_clients", help="currently connected clients")
+        self._g_version = self.telemetry.gauge(
+            "server_model_version", help="current global model version")
+        self._c_uploads = self.telemetry.counter(
+            "server_uploads_total", help="gradient uploads received")
+        self._c_dedup = self.telemetry.counter(
+            "server_dedup_hits_total",
+            help="duplicate uploads suppressed by the dedup cache")
+        self._c_recoveries = self.telemetry.counter(
+            "server_recoveries_total",
+            help="setups resumed from a checkpoint manifest")
         # wire accounting (see docs/OBSERVABILITY.md comm_* table)
-        self._c_up_bytes = self.telemetry.counter("comm_up_bytes_total", role="server")
-        self._c_down_bytes = self.telemetry.counter("comm_down_bytes_total", role="server")
-        self._c_up_sparse = self.telemetry.counter("comm_uploads_sparse_total", role="server")
-        self._c_up_dense = self.telemetry.counter("comm_uploads_dense_total", role="server")
-        self._c_down_delta = self.telemetry.counter("comm_broadcasts_delta_total", role="server")
-        self._c_down_full = self.telemetry.counter("comm_broadcasts_full_total", role="server")
-        self._c_resyncs = self.telemetry.counter("comm_resyncs_total", role="server")
-        self._c_hparam_pushes = self.telemetry.counter("server_hparam_pushes_total")
-        self._g_apply_queue = self.telemetry.gauge("comm_apply_queue_depth")
+        self._c_up_bytes = self.telemetry.counter(
+            "comm_up_bytes_total", role="server",
+            help="upload payload bytes, by role")
+        self._c_down_bytes = self.telemetry.counter(
+            "comm_down_bytes_total", role="server",
+            help="download payload bytes, by role")
+        self._c_up_sparse = self.telemetry.counter(
+            "comm_uploads_sparse_total", role="server",
+            help="sparse (top-k) uploads, by role")
+        self._c_up_dense = self.telemetry.counter(
+            "comm_uploads_dense_total", role="server",
+            help="dense uploads, by role")
+        self._c_down_delta = self.telemetry.counter(
+            "comm_broadcasts_delta_total", role="server",
+            help="delta-encoded weight broadcasts, by role")
+        self._c_down_full = self.telemetry.counter(
+            "comm_broadcasts_full_total", role="server",
+            help="full weight broadcasts, by role")
+        self._c_resyncs = self.telemetry.counter(
+            "comm_resyncs_total", role="server",
+            help="client-requested full resyncs, by role")
+        self._c_hparam_pushes = self.telemetry.counter(
+            "server_hparam_pushes_total",
+            help="hyperparam pushes to connected clients")
+        self._g_apply_queue = self.telemetry.gauge(
+            "comm_apply_queue_depth", help="uploads queued for apply")
         # continuous phase profiler (docs/OBSERVABILITY.md §5): the upload
         # lifecycle decomposes into decode / quarantine / apply / broadcast
         self._prof = self.telemetry.profiler("server")
@@ -465,6 +494,13 @@ class AbstractServer:
             )
             self._apply_worker.start()
         self.telemetry.register_fleet(id(self), self.fleet.snapshot)
+        if self.config.timeline_interval_s > 0:
+            # time-resolved telemetry (docs/OBSERVABILITY.md §12): the
+            # sampler's lifetime is this server's setup()..stop() span
+            self.telemetry.start_timeline(
+                interval_s=self.config.timeline_interval_s,
+                save_dir=self.config.save_dir)
+            self._timeline_started = True
         self.transport.start()
         self.log(f"serving on {self.transport.address}")
 
@@ -488,6 +524,11 @@ class AbstractServer:
             self._apply_worker = None
             self._apply_queue = None
         self.telemetry.unregister_fleet(id(self))
+        if getattr(self, "_timeline_started", False):
+            # only stop what setup() started: a shared Telemetry's
+            # timeline may outlive this server (loopback tests, soak)
+            self.telemetry.stop_timeline()
+            self._timeline_started = False
         self.transport.stop()
 
     @property
@@ -710,6 +751,7 @@ class AbstractServer:
         # bundle (no-op without a telemetry save_dir)
         self.telemetry.flight.record("resync", client_id=client_id)
         self.telemetry.flight.dump("resync", client_id=client_id)
+        self.telemetry.timeline.event("resync", client_id=client_id)
         self.log(f"resync requested by {client_id}: next broadcast is full")
         self.handle_resync(client_id)
         return True

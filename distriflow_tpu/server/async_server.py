@@ -64,12 +64,23 @@ class AsynchronousSGDServer(AbstractServer):
         super().__init__(model, config, transport)
         self.dataset = dataset
         self.version_counter = 0  # integer staleness clock  # guarded-by: _lock
-        self._h_staleness = self.telemetry.histogram("server_gradient_staleness")
-        self._c_applied = self.telemetry.counter("server_updates_applied_total")
-        self._c_rejected = self.telemetry.counter("server_updates_rejected_total")
-        self._c_lease_expired = self.telemetry.counter("server_lease_expirations_total")
-        self._c_suppressed = self.telemetry.counter("server_first_wins_suppressed_total")
-        self._c_requeued = self.telemetry.counter("server_recovery_requeued_total")
+        self._h_staleness = self.telemetry.histogram(
+            "server_gradient_staleness",
+            help="staleness (versions behind) of applied gradients")
+        self._c_applied = self.telemetry.counter(
+            "server_updates_applied_total", help="gradient updates applied")
+        self._c_rejected = self.telemetry.counter(
+            "server_updates_rejected_total",
+            help="gradient updates rejected (staleness/quarantine)")
+        self._c_lease_expired = self.telemetry.counter(
+            "server_lease_expirations_total",
+            help="batch leases expired and requeued")
+        self._c_suppressed = self.telemetry.counter(
+            "server_first_wins_suppressed_total",
+            help="late uploads suppressed by first-wins arbitration")
+        self._c_requeued = self.telemetry.counter(
+            "server_recovery_requeued_total",
+            help="batches requeued on disconnect/recovery")
         self._client_versions: Dict[str, int] = {}  # guarded-by: _lock
         # outstanding batches per client, in dispatch order. One entry in
         # serial mode; up to the dispatch-ahead window when the pushed
@@ -104,7 +115,9 @@ class AsynchronousSGDServer(AbstractServer):
         # inflight_window; recovery ramps it back to None (uncapped). Reads
         # are racy-by-design (a dispatch mid-shrink uses the old cap once).
         self._fleet_window_cap: Optional[int] = None
-        self._g_window_cap = self.telemetry.gauge("server_dispatch_window_cap")
+        self._g_window_cap = self.telemetry.gauge(
+            "server_dispatch_window_cap",
+            help="fleet-wide dispatch window cap (0 = uncapped)")
 
     _VERSION_TOKEN_WINDOW = 64  # comfortably > any sane maximum_staleness
 
@@ -451,6 +464,9 @@ class AsynchronousSGDServer(AbstractServer):
                 self.telemetry.flight.dump(
                     "quarantine", client_id=msg.client_id,
                     reason=verdict.reason)
+                self.telemetry.timeline.event(
+                    "quarantine", client_id=msg.client_id,
+                    reason=verdict.reason)
                 return False
             if decay != 1.0:
                 grads = jax.tree.map(lambda g: g * decay, grads)
@@ -481,6 +497,8 @@ class AsynchronousSGDServer(AbstractServer):
                         "rollback", client_id=msg.client_id,
                         update_id=msg.update_id)
                     self.telemetry.flight.dump(
+                        "rollback", client_id=msg.client_id)
+                    self.telemetry.timeline.event(
                         "rollback", client_id=msg.client_id)
                     return False
                 self.gate.accept(verdict.norm)
@@ -529,6 +547,8 @@ class AsynchronousSGDServer(AbstractServer):
                                              batch=batch)
                 self.telemetry.flight.dump("lease_expiry", client_id=cid,
                                            batch=batch)
+                self.telemetry.timeline.event("lease_expiry", client_id=cid,
+                                              batch=batch)
                 self.log(f"lease expired on batch {batch} held by {cid[:8]}; "
                          "speculative re-dispatch")
                 self.dataset.requeue(batch)

@@ -370,20 +370,32 @@ class InferenceServer:
                 (s, self._pp + 1), self._n_pages, np.int32)
         # serving metrics (contract table in docs/OBSERVABILITY.md §1)
         tel = telemetry if telemetry is not None else get_telemetry()
-        self._m_batches = tel.counter("serving_decode_batches_total")
-        self._m_admitted = tel.counter("serving_batched_requests_total")
-        self._m_tokens = tel.counter("serving_tokens_generated_total")
-        self._m_slots = tel.gauge("serving_slots_active")
-        self._m_qwait = tel.histogram("serving_queue_wait_ms")
+        self._m_batches = tel.counter(
+            "serving_decode_batches_total",
+            help="decode batches dispatched by the engine loop")
+        self._m_admitted = tel.counter(
+            "serving_batched_requests_total",
+            help="requests admitted into a decode slot")
+        self._m_tokens = tel.counter(
+            "serving_tokens_generated_total",
+            help="output tokens committed across all slots")
+        self._m_slots = tel.gauge(
+            "serving_slots_active", help="decode slots currently occupied")
+        self._m_qwait = tel.histogram(
+            "serving_queue_wait_ms",
+            help="enqueue-to-admission wait per request (ms)")
         # per-tier SLO surfaces (docs/OBSERVABILITY.md §11): TTFT is the
         # enqueue -> first-token wall per request; TPOT is per-SLOT
         # decode-interval time per emitted token (satellite 1: the old
         # single histogram divided one batch dispatch across all active
         # slots, conflating every co-resident request)
-        self._m_ttft = {t: tel.histogram("serving_ttft_ms", tier=str(t))
-                        for t in (0, 1, 2)}
+        self._m_ttft = {t: tel.histogram(
+            "serving_ttft_ms", tier=str(t),
+            help="enqueue-to-first-token wall per request (ms), by tier")
+            for t in (0, 1, 2)}
         self._m_tpot = {t: tel.histogram(
-            "serving_time_per_output_token_ms", tier=str(t))
+            "serving_time_per_output_token_ms", tier=str(t),
+            help="per-slot decode interval per emitted token (ms), by tier")
             for t in (0, 1, 2)}
         # running per-tier worst-request watermarks: a new maximum drops
         # a ttft_high/tpot_high flight event naming the request, so the
@@ -394,15 +406,28 @@ class InferenceServer:
         # admission, then every decode/spec commit) — the denominator
         # anchor for per-slot TPOT intervals
         self._slot_emit_t = [0.0] * s
-        self._m_pages = tel.gauge("serving_page_occupancy")
-        self._m_prefix_hits = tel.counter("serving_prefix_hits_total")
+        self._m_pages = tel.gauge(
+            "serving_page_occupancy",
+            help="fraction of KV-cache pages currently allocated")
+        self._m_prefix_hits = tel.counter(
+            "serving_prefix_hits_total",
+            help="admissions that reused a cached prefix")
         self._m_prefix_tokens = tel.counter(
-            "serving_prefix_tokens_saved_total")
-        self._m_pages_alloc = tel.counter("serving_pages_allocated_total")
-        self._m_pages_freed = tel.counter("serving_pages_released_total")
-        self._m_spec_proposed = tel.counter("serving_spec_proposed_total")
-        self._m_spec_accepted = tel.counter("serving_spec_accepted_total")
-        self._m_spec_rate = tel.gauge("serving_spec_accepted_per_step")
+            "serving_prefix_tokens_saved_total",
+            help="prompt tokens skipped via prefix-cache reuse")
+        self._m_pages_alloc = tel.counter(
+            "serving_pages_allocated_total", help="KV-cache pages allocated")
+        self._m_pages_freed = tel.counter(
+            "serving_pages_released_total", help="KV-cache pages released")
+        self._m_spec_proposed = tel.counter(
+            "serving_spec_proposed_total",
+            help="draft tokens proposed by speculative decoding")
+        self._m_spec_accepted = tel.counter(
+            "serving_spec_accepted_total",
+            help="draft tokens accepted by the target model")
+        self._m_spec_rate = tel.gauge(
+            "serving_spec_accepted_per_step",
+            help="accepted draft tokens per speculative step")
         # continuous phase profiler (docs/OBSERVABILITY.md §5): serving
         # records phases only — the engine loop mostly idles in _gather, so
         # a per-iteration step() would drown the digests in idle wall time

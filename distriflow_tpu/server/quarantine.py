@@ -84,8 +84,12 @@ class GradientGate:
         self.save_dir = save_dir
         self.quarantine_dir = os.path.join(save_dir, QUARANTINE_DIR)
         self._log = log or (lambda *a: None)
-        self._c_quarantined = telemetry.counter("server_quarantined_total")
-        self._c_rollbacks = telemetry.counter("server_rollbacks_total")
+        self._c_quarantined = telemetry.counter(
+            "server_quarantined_total",
+            help="updates diverted to quarantine instead of applying")
+        self._c_rollbacks = telemetry.counter(
+            "server_rollbacks_total",
+            help="model rollbacks to the last known-good checkpoint")
         # quarantined_updates / rollbacks are serialized by the OWNING
         # server's handler lock (every gate call sits inside the server's
         # ``with self._lock``), so they carry no guard of their own

@@ -203,9 +203,15 @@ class AsyncSGDTrainer:
         self.rejected_updates = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         _t = get_telemetry()
-        self._h_staleness = _t.histogram("train_gradient_staleness", mode="async")
-        self._c_applied = _t.counter("train_updates_applied_total", mode="async")
-        self._c_rejected = _t.counter("train_updates_rejected_total", mode="async")
+        self._h_staleness = _t.histogram(
+            "train_gradient_staleness", mode="async",
+            help="versions behind HEAD per applied gradient")
+        self._c_applied = _t.counter(
+            "train_updates_applied_total", mode="async",
+            help="gradient updates applied to the model")
+        self._c_rejected = _t.counter(
+            "train_updates_rejected_total", mode="async",
+            help="gradient updates rejected (stale beyond the bound)")
         # continuous phase profiler (docs/OBSERVABILITY.md §5): _phase()
         # feeds the same dt into rolling digests, and worker_loop bounds
         # each pull->fit->submit span with a step() so wall-vs-busy yields

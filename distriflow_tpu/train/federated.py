@@ -79,7 +79,9 @@ class FederatedAveragingTrainer:
         self.num_workers = self.mesh.shape["data"]
         self._round_fn = self._build_round()
         _t = get_telemetry()
-        self._h_round = _t.histogram("train_step_ms", mode="federated")
+        self._h_round = _t.histogram(
+            "train_step_ms", mode="federated",
+            help="wall time per training step/round (ms), by mode")
         # phase profiler + per-round trace (docs/OBSERVABILITY.md §5/§9):
         # a fedavg round decomposes into stage (host->device placement) and
         # fit (the jitted K-local-steps + allreduce), so bench rows can name

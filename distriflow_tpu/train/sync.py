@@ -153,7 +153,9 @@ class SyncTrainer:
         # observability (reference time()/log wrappers, abstract_server.ts:92-103)
         self.last_step_ms: Optional[float] = None
         self._step_times: List[float] = []  # rolling window
-        self._h_step = get_telemetry().histogram("train_step_ms", mode="sync")
+        self._h_step = get_telemetry().histogram(
+            "train_step_ms", mode="sync",
+            help="wall time per training step/round (ms), by mode")
         self._cost_cache: Dict[Any, Dict[str, float]] = {}  # per batch signature
         # checkpointing (reference saves on every update, server/models.ts:132-138;
         # here save_every is explicit and the write happens off-thread)
@@ -508,7 +510,10 @@ class SyncTrainer:
         # bench cross-check read this gauge (docs/OBSERVABILITY.md §6);
         # set only on success so a backend without flop counts leaves the
         # gauge unregistered rather than pinned at a stale value
-        get_telemetry().gauge("train_mfu", mode="sync").set(value)
+        get_telemetry().gauge(
+            "train_mfu", mode="sync",
+            help="model FLOPs utilization vs peak chip FLOPs",
+        ).set(value)
         return value
 
     # -- checkpointing -----------------------------------------------------

@@ -221,7 +221,7 @@ def outer(xs):
 def test_undocumented_metric_is_flagged(tmp_path):
     src = '''
 def register(telemetry):
-    telemetry.counter("dfcheck_fixture_bogus_total")
+    telemetry.counter("dfcheck_fixture_bogus_total", help="fixture")
 '''
     found = _findings(tmp_path, src, ["obs"])
     assert [f.check for f in found] == ["metric-undocumented"]
@@ -231,7 +231,29 @@ def register(telemetry):
 def test_documented_metric_is_silent(tmp_path):
     src = '''
 def register(telemetry):
+    telemetry.counter("server_uploads_total", help="fixture")
+'''
+    assert _findings(tmp_path, src, ["obs"]) == []
+
+
+def test_metric_without_help_is_flagged(tmp_path):
+    src = '''
+def register(telemetry):
     telemetry.counter("server_uploads_total")
+'''
+    found = _findings(tmp_path, src, ["obs"])
+    assert [f.check for f in found] == ["metric-no-help"]
+    assert "# HELP" in found[0].message
+
+
+def test_metric_ident_needs_no_help(tmp_path):
+    # metric_ident() is name-only resolution, not a registration site
+    src = '''
+from distriflow_tpu.obs.registry import metric_ident
+
+
+def key():
+    return metric_ident("server_uploads_total")
 '''
     assert _findings(tmp_path, src, ["obs"]) == []
 

@@ -1,0 +1,502 @@
+"""Time-resolved telemetry (docs/OBSERVABILITY.md §12).
+
+The contracts pinned here:
+
+- the timeline ring is bounded: eviction is oldest-first, queries keep
+  answering over what is retained;
+- ``rate()``/``delta()`` are EXACT — cumulative counter values at the
+  window edges subtract, no sampling error inside the window;
+- windowed histogram quantiles equal the quantile of a fresh histogram
+  fed only the window's observations (bucket-state deltas merge exactly,
+  at bucket resolution — the PR-10 mergeable-state machinery in reverse);
+- ``sustained`` bands are transient-proof: a single spike never trips
+  them, an intervals-with-no-observations gap is transparent, and a real
+  sustained violation fires exactly once (edge-triggered);
+- ``slope`` bands bound the trend, not the level;
+- the adaptive controller in trend mode ramps back only after a
+  sustained-clean wall-clock window witnessed by the timeline;
+- ``dump --timeline`` reconstructs sparklines + the event legend from
+  the run dir alone, and ``dump --watch`` rides the same store.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from distriflow_tpu.obs import (
+    NOOP_TIMELINE,
+    TIMELINE_FILENAME,
+    Telemetry,
+    TimelineStore,
+    metric_ident,
+    quantile_from_buckets,
+    render_prometheus,
+)
+from distriflow_tpu.obs.health import HealthSentinel, SLOBand
+from distriflow_tpu.obs.registry import Histogram
+
+pytestmark = pytest.mark.timeline
+
+
+# -- ring / persistence -----------------------------------------------------
+
+
+def test_ring_bounds_and_eviction():
+    store = TimelineStore(capacity=4)
+    for i in range(10):
+        store.add_sample(float(i), {"c": float(i)}, {})
+    samples = store.samples()
+    assert len(samples) == 4
+    assert [s["t"] for s in samples] == [6.0, 7.0, 8.0, 9.0]
+    assert store.span_s() == 3.0
+    # queries keep working over the retained suffix
+    assert store.delta("c") == 3.0
+
+
+def test_persist_and_load_roundtrip(tmp_path):
+    store = TimelineStore(save_dir=str(tmp_path), interval_s=0.05)
+    store.add_sample(1.0, {"c": 1.0}, {"g": 5.0},
+                     {"h": {"count": 2, "sum": 3.0, "min": 1.0,
+                            "max": 2.0, "buckets": {"10": 2}}})
+    store.add_sample(2.0, {"c": 4.0}, {"g": 7.0})
+    store.event("churn_kill", t=1.5, client="w3")
+    store.stop(final_sample=False)
+
+    loaded = TimelineStore.load(str(tmp_path))
+    assert loaded.skipped == 0
+    assert loaded.header["schema"] == 1
+    assert loaded.header["interval_s"] == 0.05
+    assert [s["t"] for s in loaded.samples()] == [1.0, 2.0]
+    assert loaded.samples()[0]["hists"]["h"]["buckets"] == {"10": 2}
+    assert loaded.delta("c") == 3.0
+    evts = loaded.events()
+    assert len(evts) == 1
+    assert evts[0]["kind"] == "churn_kill" and evts[0]["client"] == "w3"
+
+    # a torn trailing line (crash mid-write) is skipped and counted
+    path = tmp_path / TIMELINE_FILENAME
+    with open(path, "a") as f:
+        f.write('{"kind": "timeline_sample", "t": 3.0, "cou')
+    assert TimelineStore.load(str(path)).skipped == 1
+
+
+# -- windowed queries -------------------------------------------------------
+
+
+def test_delta_and_rate_exact():
+    store = TimelineStore()
+    store.add_sample(0.0, {"c": 0.0}, {"g": 1.0})
+    store.add_sample(2.0, {"c": 10.0}, {"g": 3.0})
+    store.add_sample(4.0, {"c": 30.0}, {"g": 2.0})
+    # full span: cumulative edges subtract exactly
+    assert store.delta("c") == 30.0
+    assert store.rate("c") == 30.0 / 4.0
+    # trailing window covering only the last interval
+    assert store.delta("c", window_s=2.0) == 20.0
+    assert store.rate("c", window_s=2.0) == 10.0
+    # gauges answer min/mean/max over the window's samples
+    st = store.gauge_stats("g")
+    assert (st["min"], st["max"], st["n"]) == (1.0, 3.0, 3.0)
+    assert st["mean"] == pytest.approx(2.0)
+    # unknown ident / single-sample windows stay None
+    assert store.delta("nope") is None
+    assert TimelineStore().rate("c") is None
+
+
+def test_windowed_quantile_equals_bucket_delta_merge():
+    t = Telemetry()
+    h = t.histogram("lat_ms", role="c")
+    store = TimelineStore(telemetry=t, interval_s=999.0)
+    batch1 = [1.0, 2.0, 4.0, 8.0]
+    batch2 = [16.0, 32.0, 64.0, 128.0, 256.0]
+    store.sample(now=99.0)  # baseline edge before any observation
+    for v in batch1:
+        h.observe(v)
+    store.sample(now=100.0)
+    for v in batch2:
+        h.observe(v)
+    store.sample(now=101.0)
+
+    ident = metric_ident("lat_ms", {"role": "c"})
+    # reference: a FRESH histogram fed only the second batch
+    ref = Histogram("ref", {})
+    for v in batch2:
+        ref.observe(v)
+    ref_buckets = ref.export_state()["buckets"]
+    for q in (0.5, 0.95, 0.99):
+        assert store.quantile(ident, q, window_s=1.0) == \
+            quantile_from_buckets(ref_buckets, q)
+    summ = store.window_summary(ident, window_s=1.0)
+    assert summ["count"] == len(batch2)
+    assert summ["sum"] == pytest.approx(sum(batch2))
+    assert summ["mean"] == pytest.approx(sum(batch2) / len(batch2))
+    # the full span covers both batches
+    full = store.window_summary(ident)
+    assert full["count"] == len(batch1) + len(batch2)
+
+
+def test_series_hist_stats_none_for_empty_interval():
+    store = TimelineStore()
+
+    def hist(count, s):
+        return {"lat": {"count": count, "sum": s, "min": 1.0,
+                        "max": 2.0, "buckets": {"12": count}}}
+
+    store.add_sample(0.0, {}, {}, hist(0, 0.0))
+    store.add_sample(1.0, {}, {}, hist(5, 10.0))
+    store.add_sample(2.0, {}, {}, hist(5, 10.0))  # nothing new
+    store.add_sample(3.0, {}, {}, hist(8, 19.0))
+    pts = dict(store.series("lat", "mean"))
+    assert pts[0.0] is None  # no previous interval
+    assert pts[1.0] == pytest.approx(2.0)
+    assert pts[2.0] is None  # empty interval is None, not carried over
+    assert pts[3.0] == pytest.approx(3.0)
+    rates = dict(store.series("lat", "rate"))
+    assert rates[2.0] == 0.0  # rate of an empty interval IS zero
+
+
+# -- sustained / slope bands ------------------------------------------------
+
+
+def _gauge_store(values, upper_spike=100.0):
+    """Offline store with one gauge series, 0.1s apart."""
+    store = TimelineStore()
+    ident = metric_ident("q", {"role": "s"})
+    for i, v in enumerate(values):
+        store.add_sample(float(i) * 0.1, {}, {ident: float(v)})
+    return store
+
+
+def test_sustained_band_transient_spike_is_silent(tmp_path):
+    t = Telemetry()
+    band = SLOBand("q_high", "q", "value", {"role": "s"}, upper=50.0,
+                   kind="sustained", sustained_samples=3,
+                   sustained_s=0.15, window_s=60.0)
+    # one spike in an otherwise clean series: run length 1 < 3
+    store = _gauge_store([10, 10, 100, 10, 10])
+    watch = HealthSentinel(t, bands=[band], timeline=store,
+                           dump_dir=str(tmp_path))
+    assert watch.check() == []
+    # two consecutive spikes still under sustained_samples
+    store2 = _gauge_store([10, 100, 100, 10])
+    watch2 = HealthSentinel(t, bands=[band], timeline=store2,
+                            dump_dir=str(tmp_path))
+    assert watch2.check() == []
+
+
+def test_sustained_band_fires_exactly_once(tmp_path):
+    t = Telemetry()
+    band = SLOBand("q_high", "q", "value", {"role": "s"}, upper=50.0,
+                   kind="sustained", sustained_samples=3,
+                   sustained_s=0.15, window_s=60.0)
+    store = _gauge_store([10, 10, 100, 100, 100])
+    watch = HealthSentinel(t, bands=[band], timeline=store,
+                           dump_dir=str(tmp_path))
+    entered = watch.check()
+    assert [e["band"] for e in entered] == ["q_high"]
+    assert entered[0]["kind"] == "sustained"
+    assert entered[0]["run_samples"] == 3
+    assert entered[0]["run_s"] == pytest.approx(0.2)
+    # the breach bundle carries the trailing series for the postmortem
+    assert len(entered[0]["series"]) == 5
+    assert t.counter_value("obs_slo_breach_total", band="q_high") == 1
+    # still in breach: edge-triggered, no second count
+    assert watch.check() == []
+    assert t.counter_value("obs_slo_breach_total", band="q_high") == 1
+
+
+def test_sustained_band_gap_intervals_are_transparent(tmp_path):
+    """Histogram intervals with no new observations neither break nor
+    extend the out-of-band run."""
+    t = Telemetry()
+    store = TimelineStore()
+    ident = metric_ident("lat", {"role": "c"})
+
+    def add(i, count):
+        store.add_sample(float(i) * 0.1, {}, {}, {
+            ident: {"count": count, "sum": 0.0, "min": None, "max": None,
+                    "buckets": {"17": count}}})  # bucket 17 -> 128ms
+
+    add(0, 0)
+    add(1, 5)   # p99 = 128 > 100: out of band
+    add(2, 5)   # empty interval: transparent
+    add(3, 5)   # empty interval: transparent
+    add(4, 10)  # 5 more high observations
+    band = SLOBand("lat_p99", "lat", "p99", {"role": "c"}, upper=100.0,
+                   kind="sustained", sustained_samples=2, window_s=60.0)
+    watch = HealthSentinel(t, bands=[band], timeline=store,
+                           dump_dir=str(tmp_path))
+    # two OBSERVED out-of-band points (t=0.1 and t=0.4) bridge the gap
+    entered = watch.check()
+    assert [e["band"] for e in entered] == ["lat_p99"]
+    assert entered[0]["run_samples"] == 2
+
+
+def test_slope_band_bounds_the_trend(tmp_path):
+    t = Telemetry()
+    band = SLOBand("q_ramp", "q", "value", {"role": "s"}, upper=5.0,
+                   kind="slope", window_s=60.0)
+    # level is tiny but climbing 100/s: the slope breaches, once
+    store = _gauge_store([0, 10, 20, 30, 40])
+    watch = HealthSentinel(t, bands=[band], timeline=store,
+                           dump_dir=str(tmp_path))
+    entered = watch.check()
+    assert [e["band"] for e in entered] == ["q_ramp"]
+    assert entered[0]["observed"] == pytest.approx(100.0)
+    assert watch.check() == []  # edge-triggered
+    # flat-but-high series: the LEVEL is huge, the slope is zero
+    flat = _gauge_store([1000, 1000, 1000, 1000])
+    watch2 = HealthSentinel(t, bands=[band], timeline=flat,
+                            dump_dir=str(tmp_path))
+    assert watch2.check() == []
+    # fewer than 3 observed points: unknown, never breaches
+    short = _gauge_store([0, 100])
+    watch3 = HealthSentinel(t, bands=[band], timeline=short,
+                            dump_dir=str(tmp_path))
+    assert watch3.check() == []
+
+
+# -- trend-aware controller recovery ----------------------------------------
+
+
+class _FakeHyperparams:
+    topk_fraction = 0.1
+    inflight_window = 4
+
+
+class _FakeServer:
+    def __init__(self, telemetry):
+        self.telemetry = telemetry
+        self.client_hyperparams = _FakeHyperparams()
+        self.fleet_window_cap = None
+        self._overrides = {}
+
+    def identity_of(self, conn_id):
+        return "worker-1"
+
+    def connections_of(self, stable):
+        return ["conn-1"]
+
+    def client_overrides(self, stable):
+        return self._overrides.get(stable)
+
+    def set_client_hyperparams(self, stable, override, push=False):
+        self._overrides[stable] = dict(override)
+
+    def clear_client_hyperparams(self, stable, push=False):
+        self._overrides.pop(stable, None)
+
+    def override_ids(self):
+        return sorted(self._overrides)
+
+    def set_fleet_window_cap(self, cap):
+        self.fleet_window_cap = cap
+
+
+class _FakeSentinel:
+    def __init__(self):
+        self.hits = []
+        self.dirty = []
+
+    def check(self):
+        hits, self.hits = self.hits, []
+        return hits
+
+    def breached(self):
+        return list(self.dirty)
+
+
+def test_controller_trend_ramp_roundtrip():
+    from distriflow_tpu.fleet.controller import AdaptiveController
+
+    tel = Telemetry()
+    store = tel.start_timeline(interval_s=999.0)  # sampled by hand below
+    try:
+        server = _FakeServer(tel)
+        sentinel = _FakeSentinel()
+        ctrl = AdaptiveController(server, sentinel, recovery_checks=1,
+                                  recovery_window_s=0.15)
+        sentinel.hits = [{"band": "fleet_straggler", "client_id": "conn-1",
+                          "observed": 900.0}]
+        ctrl.step()
+        assert ctrl.adaptations == 1
+        assert server.override_ids() == ["worker-1"]
+        # clean signal, but neither the wall clock nor the witnessed
+        # timeline span covers recovery_window_s yet: NO ramp — this is
+        # exactly where point-poll recovery_checks=1 would have ramped
+        store.sample()
+        ctrl.step()
+        assert ctrl.ramps == 0 and server.override_ids() == ["worker-1"]
+        # wall clock passes, but the timeline has witnessed ~no span
+        # (one instant): still no ramp
+        time.sleep(0.2)
+        ctrl.step()
+        assert ctrl.ramps == 0 and server.override_ids() == ["worker-1"]
+        # a second sample extends the witnessed span past the window:
+        # the sustained-clean window is now real -> ramp, exactly once
+        store.sample()
+        ctrl.step()
+        assert ctrl.ramps == 1 and server.override_ids() == []
+        # the knob moves were stamped on the run timeline
+        kinds = [e["kind"] for e in store.events()]
+        assert "controller_adapt" in kinds and "controller_ramp" in kinds
+    finally:
+        tel.stop_timeline()
+
+
+def test_controller_dirty_signal_resets_clean_window():
+    from distriflow_tpu.fleet.controller import AdaptiveController
+
+    tel = Telemetry()
+    store = tel.start_timeline(interval_s=999.0)
+    try:
+        server = _FakeServer(tel)
+        sentinel = _FakeSentinel()
+        ctrl = AdaptiveController(server, sentinel, recovery_checks=1,
+                                  recovery_window_s=0.1)
+        sentinel.hits = [{"band": "fleet_straggler", "client_id": "conn-1",
+                          "observed": 900.0}]
+        ctrl.step()
+        store.sample()
+        time.sleep(0.12)
+        store.sample()
+        # the signal went dirty again right before the window elapsed:
+        # the clean clock restarts, no ramp
+        sentinel.dirty = ["fleet_straggler:conn-1"]
+        ctrl.step()
+        assert ctrl.ramps == 0 and server.override_ids() == ["worker-1"]
+        sentinel.dirty = []
+        ctrl.step()  # clean again: window restarts from here
+        assert ctrl.ramps == 0
+        time.sleep(0.12)
+        store.sample()
+        ctrl.step()
+        assert ctrl.ramps == 1 and server.override_ids() == []
+    finally:
+        tel.stop_timeline()
+
+
+# -- live sampler lifecycle -------------------------------------------------
+
+
+def test_telemetry_timeline_lifecycle(tmp_path):
+    tel = Telemetry(save_dir=str(tmp_path))
+    assert tel.timeline is NOOP_TIMELINE  # unstarted: shared no-op
+    tel.counter("work_total", help="test work").inc(7)
+    store = tel.start_timeline(interval_s=0.02)
+    assert tel.start_timeline() is store  # idempotent
+    deadline = time.time() + 5.0
+    while len(store.samples()) < 3 and time.time() < deadline:
+        time.sleep(0.02)
+    tel.stop_timeline()
+    assert len(store.samples()) >= 3
+    assert store.delta("work_total") == 0.0  # counted before first sample
+    assert tel.timeline is store  # post-run queries keep working
+    assert os.path.exists(tmp_path / TIMELINE_FILENAME)
+    # the store's own meta-counters rode the samples
+    assert tel.counter_value("obs_timeline_samples_total") >= 3
+
+    disabled = Telemetry(enabled=False)
+    assert disabled.timeline is NOOP_TIMELINE
+    assert disabled.start_timeline() is NOOP_TIMELINE
+    assert NOOP_TIMELINE.series("x") == [] and NOOP_TIMELINE.rate("x") is None
+
+
+def test_help_text_rendered_as_prometheus_help():
+    t = Telemetry()
+    t.counter("frames_total", role="c",
+              help="frames that crossed the wire").inc(2)
+    t.gauge("depth", help="queue depth").set(3)
+    out = render_prometheus(t.registry)
+    assert "# HELP frames_total frames that crossed the wire" in out
+    assert "# TYPE frames_total counter" in out
+    assert "# HELP depth queue depth" in out
+    # first registration wins; later sites cannot rewrite the help text
+    t.counter("frames_total", role="d", help="something else").inc()
+    assert t.registry.help_text("frames_total") == \
+        "frames that crossed the wire"
+
+
+# -- dump surface -----------------------------------------------------------
+
+
+def test_dump_timeline_smoke(tmp_path, capsys):
+    from distriflow_tpu.obs import dump
+
+    store = TimelineStore(save_dir=str(tmp_path))
+    ident = metric_ident("up_total", {"role": "c"})
+    for i in range(20):
+        store.add_sample(100.0 + i * 0.1, {ident: float(3 * i)},
+                         {"depth": 5.0 + (i % 4)})
+    store.event("controller_adapt", t=100.6, band="fleet_straggler")
+    store.event("slo_breach", t=102.5, band="ack_sustained")  # past last sample
+    store.stop(final_sample=False)
+
+    assert dump.main([str(tmp_path), "--timeline"]) == 0
+    out = capsys.readouterr().out
+    assert "timeline: 20 sample(s), 2 event(s)" in out
+    assert ident in out and "depth" in out
+    assert "delta=57" in out
+    # event markers + legend, including the breach AFTER the last sample
+    assert "A controller_adapt" in out and "B slo_breach" in out
+    events_row = [ln for ln in out.splitlines()
+                  if ln.strip().startswith("events")][0]
+    assert "A" in events_row and "B" in events_row
+
+    # --idents picks explicit rows; unknown names are reported not fatal
+    assert dump.main([str(tmp_path), "--timeline",
+                      "--idents", "up_total,ghost"]) == 0
+    out = capsys.readouterr().out
+    assert ident in out and "ghost" in out and "not found" in out
+
+    # --window clips the axis
+    assert dump.main([str(tmp_path), "--timeline", "--window", "0.5"]) == 0
+
+    # a dir without a timeline exits 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert dump.main([str(empty), "--timeline"]) == 2
+
+
+def test_dump_watch_rides_timeline_store(tmp_path, capsys):
+    from distriflow_tpu.obs import dump
+
+    rows = [
+        {"kind": "telemetry_snapshot", "snapshot_time": 50.0,
+         "counter:up{role=c}": 0.0, "gauge:q": 4.0},
+        {"kind": "telemetry_snapshot", "snapshot_time": 51.0,
+         "counter:up{role=c}": 12.0, "gauge:q": 4.0},
+    ]
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(rows[0]) + "\n")
+    assert dump.main([str(tmp_path), "--watch", "--iterations", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "up{role=c}=0" in out
+
+    # append the next snapshot between polls: the second poll reports
+    # the windowed delta across the two in-store samples
+    import threading
+
+    def _append():
+        time.sleep(0.15)
+        with open(path, "a") as f:
+            f.write(json.dumps(rows[1]) + "\n")
+
+    th = threading.Thread(target=_append)
+    th.start()
+    assert dump.main([str(tmp_path), "--watch", "--iterations", "2",
+                      "--interval", "0.4"]) == 0
+    th.join()
+    out = capsys.readouterr().out
+    delta_line = [ln for ln in out.splitlines() if "watch[2]" in ln][0]
+    assert "up{role=c} 0->12" in delta_line
+    assert "q" not in delta_line.split(";", 1)[1]  # unmoved gauge omitted
+
+    # an unchanged newest row between polls prints "no change"
+    assert dump.main([str(tmp_path), "--watch", "--iterations", "2",
+                      "--interval", "0.01"]) == 0
+    out = capsys.readouterr().out
+    assert "no change" in out
