@@ -43,6 +43,23 @@ import time
 import traceback
 
 FAST = bool(int(os.environ.get("BENCH_FAST", "0")))
+# round-18 kernel-round plumbing (docs/PERFORMANCE.md §4d):
+#  - BENCH_LEGS="cifar_sync,transformer,mobilenet" runs only the named legs
+#    (exact bench_* suffix) — the ledger-recording runs for the kernel
+#    round re-measure the three training rows without paying for the
+#    serving matrix;
+#  - BENCH_CPU_SCALE=1 shrinks the training legs to sizes a TPU-less host
+#    can time and unlocks the host-matmul-peak MFU basis (rows say so via
+#    mfu_basis — never comparable with a TPU row);
+#  - BENCH_RUN_ID pins the ledger run id so baseline-then-best sequencing
+#    is auditable (bench-r18-kernel-baseline / bench-r18-kernel-fused);
+#  - BENCH_ROOFLINE=pre18 projects the PRE-round-18 kernel cost model
+#    (two-kernel spilled-tile attention backward, unfused depthwise+GN)
+#    so the ledger carries a BEFORE row for the bound_by flip.
+LEGS = {s.strip() for s in os.environ.get("BENCH_LEGS", "").split(",")
+        if s.strip()}
+CPU_SCALE = bool(int(os.environ.get("BENCH_CPU_SCALE", "0")))
+ROOFLINE_MODE = os.environ.get("BENCH_ROOFLINE", "post18")
 # wall-clock budget for the whole matrix. Round-4 discipline: legs SHRINK
 # when behind schedule (time_left() below), never silently skip; failures
 # retry once and embed a short traceback tail in the row itself. Round-5
@@ -226,23 +243,229 @@ def _timed_chunked(trainer, make_chunk, steps, rounds, batch, reps=3,
     }
 
 
-def _mfu_or_none(trainer, batch, step_seconds):
-    try:
-        mfu = round(trainer.mfu(batch, step_seconds=step_seconds), 4)
-    except ValueError as e:  # unknown device kind (CPU runs) / no flop counts
-        log(f"mfu unavailable: {e}")
+_HOST_PEAK = []  # measured once per process
+
+
+def _host_peak_flops():
+    """Measured host matmul throughput (jitted bf16 1024^3, best of 5) —
+    the per-chip peak MFU denominator on hosts whose device kind has no
+    published figure (BENCH_CPU_SCALE runs). Rows computed against it say
+    so via ``mfu_basis``: a host-basis MFU is comparable across CPU runs
+    of this bench, never with a TPU row."""
+    if not _HOST_PEAK:
+        import jax
+        import jax.numpy as jnp
+
+        n = 1024
+        f = jax.jit(lambda a, b: (a @ b).astype(jnp.float32))
+        a = jnp.ones((n, n), jnp.bfloat16)
+        _fetch(f(a, a))
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            _fetch(f(a, a))
+            best = min(best, time.perf_counter() - t0)
+        _HOST_PEAK.append(2 * n ** 3 / best)
+        log(f"host matmul peak: {_HOST_PEAK[0] / 1e9:.1f} GFLOP/s "
+            f"(mfu_basis=host_matmul_peak)")
+    return _HOST_PEAK[0]
+
+
+def _mfu_basis():
+    """Which peak the row's mfu divides by — None on device kinds with a
+    published figure (the default basis needs no label)."""
+    import jax
+
+    from distriflow_tpu.train.sync import SyncTrainer
+
+    kind = jax.devices()[0].device_kind.lower()
+    if any(k in kind for k in SyncTrainer.PEAK_BF16_FLOPS):
         return None
+    return "host_matmul_peak"
+
+
+def _mfu_or_none(trainer, batch, step_seconds, mode="sync"):
+    try:
+        mfu = round(
+            trainer.mfu(batch, step_seconds=step_seconds, gauge_mode=mode), 4)
+    except ValueError as e:  # unknown device kind (CPU runs) / no flop counts
+        if not CPU_SCALE:
+            log(f"mfu unavailable: {e}")
+            return None
+        try:  # CPU recording runs: measured host-peak basis (labeled)
+            mfu = round(
+                trainer.mfu(batch, step_seconds=step_seconds,
+                            peak_flops_per_chip=_host_peak_flops(),
+                            gauge_mode=mode), 4)
+        except ValueError as e2:
+            log(f"mfu unavailable even at host peak: {e2}")
+            return None
     # live-gauge cross-check (docs/OBSERVABILITY.md §6): mfu() mirrors its
-    # result into train_mfu{mode=sync} for the health sentinel — the bench
+    # result into train_mfu{mode=<mode>} for the health sentinel — the bench
     # reads the gauge back so a drift between the row and the SLO surface
-    # cannot go unnoticed
+    # cannot go unnoticed. ``mode`` keys the per-workload series (sync /
+    # async / mobilenet): the round-18 fix — previously this found ONLY
+    # mode="sync", so non-sync rows were never gauge-audited and concurrent
+    # rows clobbered one label
     from distriflow_tpu.obs.telemetry import get_telemetry
 
-    g = get_telemetry().registry.find("train_mfu", mode="sync")
+    g = get_telemetry().registry.find("train_mfu", mode=mode)
     live = getattr(g, "value", None) if g is not None else None
     if live is None or abs(live - mfu) > 1e-3:
-        log(f"WARN live train_mfu gauge {live!r} != row mfu {mfu}")
+        log(f"WARN live train_mfu{{mode={mode}}} gauge {live!r} != row mfu {mfu}")
     return mfu
+
+
+def _pre18_cost_model(cats):
+    """Rewind the kernel-family tally to the PRE-round-18 schedules so a
+    ``BENCH_ROOFLINE=pre18`` run records the BEFORE projection the
+    ``bound_by`` flip is measured against. Model flops are identical by
+    construction (the reworks change schedule, not math); what moves is
+    executed work and traffic:
+
+    - ``attention_bwd`` -> ``attention_bwd_unfused``: the two-kernel
+      backward re-derives P per pass (7 matmul units + 2 exps vs the
+      fused kernel's 5 + 1) and, pre-18, inherited the FORWARD tile
+      sizes — which spill VMEM at backward arithmetic (the measured 10x
+      cliff now pinned at the ``_BWD_BLOCK_CAP`` comment). The renamed
+      category picks up the spilled-tile efficiency from
+      ``PHASE_EFFICIENCY`` instead of the fused kernel's.
+    - ``depthwise_gn`` -> ``depthwise_gn_unfused``: three XLA ops
+      (depthwise conv, GN stats+affine, relu6) round-trip the activation
+      through HBM ~3x per direction vs the fused single sweep, and the
+      backward keeps residuals instead of the remat recompute (hw_flops
+      = model flops). Bytes scale 3x; efficiency drops to the measured
+      unfused VPU figure.
+    """
+    out = {}
+    for name, cat in cats.items():
+        cat = dict(cat)
+        if name == "attention_bwd":
+            unit = cat["flops"] / 4.0
+            cat["hw_flops"] = 7.0 * unit
+            cat["transcendentals"] = cat.get("transcendentals", 0.0) * 2.0
+            name = "attention_bwd_unfused"
+        elif name == "depthwise_gn":
+            cat["hw_flops"] = cat["flops"]
+            cat["bytes_accessed"] = cat.get("bytes_accessed", 0.0) * 3.0
+            name = "depthwise_gn_unfused"
+        out[name] = cat
+    return out
+
+
+def _emit_modeled_round(report, workload):
+    """Mirror a roofline projection into the trace stream as ONE modeled
+    step round — a ``round`` root plus flat per-phase children sharing a
+    trace_id, the exact shape the assembler's step-round path consumes —
+    then read the assembled attribution back. The projected ``bound_by``
+    therefore flows through the SAME taxonomy and code path as a measured
+    round's (docs/OBSERVABILITY.md §5); spans carry ``modeled=true`` so a
+    timeline reader can never mistake projection for measurement."""
+    from distriflow_tpu.obs.telemetry import get_telemetry
+
+    tracer = get_telemetry().tracer
+    tid = f"roofline-{workload}-{ROOFLINE_MODE}"
+    mark = _trace_mark()
+    tracer.emit("round", trace_id=tid,
+                dur_ms=report["step_time_s"] * 1e3, modeled=True)
+    for name, ph in report["phases"].items():
+        tracer.emit(name, trace_id=tid, dur_ms=ph["time_s"] * 1e3,
+                    modeled=True, bound=ph["bound"])
+    return _assemble_since(mark).attribution().get("bound_by")
+
+
+def _publish_structs(batch, published_b):
+    """ShapeDtypeStructs of ``batch`` with the leading dim rescaled to the
+    PUBLISHED batch size. CPU_SCALE shrinks the *timed* batch, but the
+    roofline must project the TPU workload's flop/byte ratio, not the
+    sliver's — a B=64 conv step is HBM-bound on weight reads that B=2048
+    amortizes 32x, which would misattribute ``bound_by``. Shapes only:
+    ``cost_analysis`` lowers and ``pallas_cost_of`` eval_shapes, so
+    nothing is allocated or executed at the published size."""
+    import jax
+
+    return jax.tree.map(
+        lambda v: jax.ShapeDtypeStruct(
+            (published_b,) + tuple(v.shape[1:]), v.dtype), batch)
+
+
+def _roofline_fields(trainer, batch, step_s, workload, extra_categories=None):
+    """Projected-v5e roofline fields for a training row (round 18): the
+    step program's cost analysis drives ``ops/roofline.py`` and the row
+    gains ``mfu_roofline`` (projected MFU at v5e peak) + ``bound_by``
+    (the phase owning the largest projected time slice).
+
+    On TPU the Pallas categories come straight from the trainer's
+    analysis and the projection is cross-checked against the measured
+    step (``roofline_err``). On CPU hosts two corrections keep it honest:
+    interpret mode lowers kernel bodies to plain HLO that XLA's analysis
+    already counted, so the Pallas hw share leaves the XLA remainder; and
+    kernels too slow to RUN interpreted at bench scale (flash attention,
+    the fused depthwise+GN — interpret unrolls the grid at trace time)
+    contribute through ``extra_categories``, a trace-time tally of the
+    kernel-enabled step (costs are recorded at trace time,
+    ops/flop_count.py, so eval_shape suffices) whose model flops move out
+    of the XLA remainder they replace."""
+    try:
+        from distriflow_tpu.ops import default_interpret
+        from distriflow_tpu.ops.roofline import roofline_report
+
+        analysis = trainer.cost_analysis(batch)
+        by_cat = {k: dict(v) for k, v
+                  in (analysis.get("pallas_by_category") or {}).items()}
+        interp = default_interpret()
+        xla_rem = float(analysis.get("xla_flops", 0.0))
+        if interp:
+            xla_rem -= float(analysis.get("pallas_hw_flops", 0.0))
+        for name, cat in (extra_categories or {}).items():
+            if name not in by_cat:  # already a Pallas phase -> not in xla
+                xla_rem -= float(cat.get("flops", 0.0))
+            by_cat[name] = dict(cat)
+        if ROOFLINE_MODE == "pre18":
+            by_cat = _pre18_cost_model(by_cat)
+        xla_rem = max(xla_rem, 0.0)
+        model_flops = xla_rem + sum(
+            float(c.get("flops", 0.0)) for c in by_cat.values())
+        xla_bytes = max(
+            float(analysis.get("bytes accessed", 0.0))
+            - sum(float(c.get("bytes_accessed", 0.0))
+                  for c in by_cat.values()), 0.0)
+        if interp:
+            # CPU-compiled "bytes accessed" counts im2col materialization
+            # and unfused temporaries that TPU lowering keeps on-chip (a
+            # MobileNet step claims 61 GB where real param+batch traffic
+            # is ~2 GB) — that memory leg would drown every compute phase.
+            # Floor the XLA remainder analytically instead: optimizer
+            # param traffic (~3 passes: read params + grads, write
+            # update) plus batch I/O. Kernel-phase activation traffic —
+            # the dominant activation term in these models — stays exact
+            # through the tally's own bytes columns above.
+            import jax as _jax
+            import numpy as _np
+            p_bytes = sum(
+                int(_np.prod(v.shape)) * _np.dtype(v.dtype).itemsize
+                for v in _jax.tree.leaves(trainer.get_params()))
+            b_bytes = sum(
+                int(_np.prod(v.shape)) * _np.dtype(v.dtype).itemsize
+                for v in _jax.tree.leaves(batch))
+            xla_bytes = 3.0 * p_bytes + b_bytes
+        rep = roofline_report(by_cat, model_flops, xla_flops=xla_rem,
+                              xla_bytes=xla_bytes,
+                              measured_step_s=None if interp else step_s)
+        bound = _emit_modeled_round(rep, workload) or rep["bound_by"]
+        log(f"{workload} roofline[{ROOFLINE_MODE}]: "
+            f"mfu_roofline={rep['mfu_roofline']:.4f} bound_by={bound} "
+            + " ".join(f"{n}={p['time_s'] * 1e3:.3f}ms({p['bound'][0]})"
+                       for n, p in sorted(rep["phases"].items())))
+        fields = {"mfu_roofline": round(rep["mfu_roofline"], 4),
+                  "bound_by": bound}
+        if "model_error" in rep:
+            fields["roofline_err"] = round(rep["model_error"], 3)
+        return fields
+    except Exception:
+        log(f"--- roofline projection failed for {workload} ---\n"
+            f"{traceback.format_exc()}")
+        return {}
 
 
 def _phase_digest(role):
@@ -364,7 +587,10 @@ def bench_cifar_sync(n_chips):
     # winner: 6.2 ms vs 12.6 f32. r02 ran f32 @ B=512: 200k samples/s, 0.22.
     import jax.numpy as jnp
 
-    B = 2048
+    # CPU_SCALE: a B=256 bf16 conv step measures ~32 s on a single-core
+    # XLA:CPU host (B=8 ~1 s) — B=64 x 2-step chunks keep the whole leg
+    # within ~2 min while the roofline fields stay shape-exact
+    B = 64 if CPU_SCALE else 2048
     mesh = data_parallel_mesh(jax.devices())
     trainer = SyncTrainer(cifar_convnet(dtype=jnp.bfloat16), mesh=mesh,
                           learning_rate=0.01)
@@ -376,8 +602,8 @@ def bench_cifar_sync(n_chips):
     # 12: a 16-step chunk re-crosses the lane-padding cliff (the
     # [K, B, 32, 32, 3] copy tiles T(8,128) and pads channels 3 -> 128 —
     # 42.7x HBM blowup, 16 GB, compile fails)
-    steps = 8 if FAST else 12
-    reps = 3 if FAST else 6
+    steps = 2 if CPU_SCALE else (8 if FAST else 12)
+    reps = 1 if CPU_SCALE else (3 if FAST else 6)
     chunk = _device_chunk(trainer, steps, B, (32, 32, 3), 10)
     # rounds=6: each differenced sample then spans 60 steps (~420 ms of
     # device work) — the tunnel's bimodal dispatch jitter averages down.
@@ -385,8 +611,9 @@ def bench_cifar_sync(n_chips):
     # the slowest — cold dispatch-path effects, not steady state — and it
     # alone set the r03/r04 mfu floor below the 0.30 bar.
     r = _timed_chunked(trainer, None, steps=steps,
-                       rounds=3 if FAST else 6, batch=B, reps=reps,
-                       device_chunk=chunk, warm_rounds=2)
+                       rounds=2 if CPU_SCALE else (3 if FAST else 6),
+                       batch=B, reps=reps, device_chunk=chunk,
+                       warm_rounds=0 if CPU_SCALE else 2)
     lat_x = rng.randn(B, 32, 32, 3).astype(np.float32)
     lat_y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, B)]
     mfu = _mfu_or_none(trainer, (lat_x, lat_y), r["step_ms"] / 1e3)
@@ -402,7 +629,7 @@ def bench_cifar_sync(n_chips):
         f"med={mfu_med}, step_ms samples={[round(s, 3) for s in ss]}, "
         f"dispatch {r['dispatch_ms']} ms, batch {B} bf16, "
         f"final_loss {r['final_loss']:.4f})")
-    return {
+    row = {
         "config": "cifar10_convnet_sync",
         "metric": "samples/sec/chip",
         "value": round(r["samples_per_sec"] / n_chips, 1),
@@ -411,6 +638,15 @@ def bench_cifar_sync(n_chips):
         "mfu_min": mfu_min,
         "mfu_med": mfu_med,
     }
+    if mfu is not None and _mfu_basis():
+        row["mfu_basis"] = _mfu_basis()
+    # round-18 leg (c): the row names its projected binding phase so the
+    # 0.30-floor gap is attributed, not just observed (PERFORMANCE.md §4d)
+    rl_batch = (_publish_structs((lat_x, lat_y), 2048) if CPU_SCALE
+                else (lat_x, lat_y))
+    row.update(_roofline_fields(trainer, rl_batch, r["step_ms"] / 1e3,
+                                "cifar10_convnet_sync"))
+    return row
 
 
 def bench_torch_cifar():
@@ -562,6 +798,11 @@ def bench_cifar_async(matrix):
     elapsed = time.perf_counter() - start
     processed = n_batches - 2 * K  # minus warm batches
     sps = processed * B / elapsed
+    # MFU for the async row (round-18 satellite): per-batch grad flops over
+    # the per-batch wall — host-coordination-bound by design, but now the
+    # row mirrors into train_mfu{mode=async} and is gauge-audited like
+    # every other MFU row
+    mfu = _mfu_or_none(trainer, B, elapsed / max(processed, 1), mode="async")
     uploads = max(
         trainer.applied_updates + trainer.rejected_updates - warm_uploads, 1)
 
@@ -687,6 +928,7 @@ def bench_cifar_async(matrix):
         "config": "cifar10_convnet_async_bounded_staleness",
         "metric": "samples/sec",
         "value": round(sps, 1),
+        "mfu": mfu,
         "pct_of_sync": pct,
         "applied": trainer.applied_updates,
         "rejected": trainer.rejected_updates,
@@ -995,7 +1237,11 @@ def bench_mobilenet(n_chips):
     # they look. Round-5 (verdict #5): the depthwise/groupnorm levers built
     # in round 4 are now actually exercised — the leg measures
     # {conv, shift} x {flax, onepass} and reports the winner as the row.
-    B, size, classes = 256, 96, 100  # imagenet-subset shapes (experiments/)
+    # CPU_SCALE: one bf16 MobileNet step measures ~4.3 s/sample on
+    # XLA:CPU (34.5 s at B=8) — B=2 single-step chunks or the leg alone
+    # blows the budget
+    B, size, classes = (2 if CPU_SCALE else 256), 96, 100  # experiments/
+    pub_b = 256  # published batch: roofline projects the TPU workload
     import jax.numpy as jnp
 
     mesh = data_parallel_mesh(jax.devices())
@@ -1005,13 +1251,21 @@ def bench_mobilenet(n_chips):
 
     best = None
     results = {}
-    if SLOW:
-        combos = [("conv", "flax")]  # minimum: the stable-winner family
-    elif time_left() < 120:
+    # round-18: the fused Pallas depthwise+GN block is a measured
+    # candidate in every TPU tier (it IS the round's point — even a SLOW
+    # window measures it against the stable winner). CPU recording runs
+    # cannot TIME it (interpret mode unrolls the B x channel-block grid at
+    # trace time); there it contributes through the roofline tally below.
+    if CPU_SCALE:
         combos = [("conv", "flax"), ("shift", "onepass")]
+    elif SLOW:
+        combos = [("conv", "flax"), ("fused", "flax")]
+    elif time_left() < 120:
+        combos = [("conv", "flax"), ("fused", "flax"), ("shift", "onepass")]
     else:
         combos = [("conv", "flax"), ("shift", "flax"), ("conv", "onepass"),
-                  ("shift", "onepass")]
+                  ("shift", "onepass"), ("fused", "flax")]
+    trainers = {}
     for dw, gn in combos:
         trainer = SyncTrainer(
             mobilenet_v2(image_size=size, classes=classes, dtype=jnp.bfloat16,
@@ -1022,12 +1276,18 @@ def bench_mobilenet(n_chips):
         # picks a (8,128)-tiled layout that lane-pads the trailing channel
         # dim 3 -> 128 (a 42x HBM blowup, >19 GB — compile fails); reps=4
         # to suppress the tunnel's bimodal differencing at short chunks
-        chunk = _device_chunk(trainer, 8, B, (size, size, 3), classes)
-        r = _timed_chunked(trainer, None, steps=8, rounds=3, batch=B,
-                           reps=3 if time_left() < 90 else 4,
-                           device_chunk=chunk)
-        mfu = _mfu_or_none(trainer, (x1, y1), r["step_ms"] / 1e3)
+        steps = 1 if CPU_SCALE else 8
+        chunk = _device_chunk(trainer, steps, B, (size, size, 3), classes)
+        r = _timed_chunked(trainer, None, steps=steps,
+                           rounds=2 if CPU_SCALE else 3, batch=B,
+                           reps=1 if CPU_SCALE else
+                           (3 if time_left() < 90 else 4),
+                           device_chunk=chunk,
+                           warm_rounds=0 if CPU_SCALE else 1)
+        mfu = _mfu_or_none(trainer, (x1, y1), r["step_ms"] / 1e3,
+                           mode="mobilenet")
         results[f"{dw}+{gn}"] = (r, mfu)
+        trainers[f"{dw}+{gn}"] = trainer
         log(f"#5 mobilenet_v2[{dw}+{gn}]: {r['samples_per_sec']:.0f} "
             f"samples/s ({r['step_ms']:.2f} ms/step, mfu={mfu})")
         if best is None or r["step_ms"] < results[best][0]["step_ms"]:
@@ -1035,7 +1295,7 @@ def bench_mobilenet(n_chips):
     r, mfu = results[best]
     log(f"#5 mobilenet_v2 winner: {best} "
         f"(all: {({k: round(v[0]['step_ms'], 2) for k, v in results.items()})})")
-    return {
+    row = {
         "config": "mobilenet_v2_sync",
         "metric": "samples/sec/chip",
         "value": round(r["samples_per_sec"] / n_chips, 1),
@@ -1043,6 +1303,32 @@ def bench_mobilenet(n_chips):
         "mfu": mfu,
         "impl": best,
     }
+    if mfu is not None and _mfu_basis():
+        row["mfu_basis"] = _mfu_basis()
+    extra = None
+    if "fused" not in best or ROOFLINE_MODE == "pre18":
+        # the winner's analysis carries no depthwise_gn category (CPU, or
+        # fused lost the timing, or a pre18 run that needs the work
+        # visible as its own phase for _pre18_cost_model to rewind): cost
+        # it by trace alone — eval_shape of the fused spec records the
+        # tally without compiling anything
+        from distriflow_tpu.ops.flop_count import pallas_cost_of
+
+        fspec = mobilenet_v2(image_size=size, classes=classes,
+                             dtype=jnp.bfloat16, depthwise_impl="fused",
+                             gn_impl="flax")
+        tally = pallas_cost_of(
+            jax.value_and_grad(fspec.loss_fn),
+            jax.eval_shape(fspec.init, jax.random.PRNGKey(0)),
+            *_publish_structs((x1, y1), pub_b))
+        extra = {k: v for k, v in tally["by_category"].items()
+                 if k == "depthwise_gn"}
+    rl_batch = (_publish_structs((x1, y1), pub_b) if pub_b != B
+                else (x1, y1))
+    row.update(_roofline_fields(trainers[best], rl_batch,
+                                r["step_ms"] / 1e3, "mobilenet_v2_sync",
+                                extra_categories=extra))
+    return row
 
 
 # -- serving: InferenceServer micro-batching speedup -----------------------
@@ -2170,9 +2456,11 @@ def bench_moe(n_chips, matrix):
 
 
 def _bench_lm(n_chips, *, name, d_model, n_layers, d_ff, batch, steps, rounds,
-              reps):
+              reps, publish_batch=None):
     """Shared transformer-LM leg body (flagship + large share everything
-    but the dims)."""
+    but the dims). ``publish_batch``: the row's published TPU batch when
+    the TIMED batch was CPU-scaled down — the roofline fields project at
+    this size (shapes only, nothing executes there)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -2182,6 +2470,7 @@ def _bench_lm(n_chips, *, name, d_model, n_layers, d_ff, batch, steps, rounds,
     from distriflow_tpu.train.sync import SyncTrainer
 
     B, S = batch, 1024
+    pub_b = publish_batch or B
     cfg = TransformerConfig(
         vocab_size=32000, d_model=d_model, n_heads=8, n_layers=n_layers,
         d_ff=d_ff, max_seq=S, dtype=jnp.bfloat16)
@@ -2201,7 +2490,8 @@ def _bench_lm(n_chips, *, name, d_model, n_layers, d_ff, batch, steps, rounds,
                 np.asarray(t[:, :, 1:], np.int32))
 
     r = _timed_chunked(trainer, make_chunk, steps=steps, rounds=rounds,
-                       batch=B, reps=reps)
+                       batch=B, reps=reps,
+                       warm_rounds=0 if CPU_SCALE else 1)
     x1, y1 = (v[0] for v in make_chunk(1))
     # EXACT mfu: Pallas custom-call model-FLOPs (flash attention fwd+bwd,
     # fused CE) are tallied analytically into the numerator
@@ -2215,7 +2505,7 @@ def _bench_lm(n_chips, *, name, d_model, n_layers, d_ff, batch, steps, rounds,
         f"({r['step_ms']:.2f} ms/step, mfu={mfu}, {n_params/1e6:.0f}M params, "
         f"loss={spec.loss}, d{d_model} x {n_layers}L ff{d_ff}, S={S}, B={B}, "
         f"bf16, final_loss {r['final_loss']:.4f})")
-    return {
+    row = {
         "config": f"transformer_lm_{name}",
         "metric": "tokens/sec/chip",
         "value": round(toks / n_chips, 1),
@@ -2223,12 +2513,47 @@ def _bench_lm(n_chips, *, name, d_model, n_layers, d_ff, batch, steps, rounds,
         "mfu": mfu,
         "params_m": round(n_params / 1e6, 1),
     }
+    if mfu is not None and _mfu_basis():
+        row["mfu_basis"] = _mfu_basis()
+    extra = None
+    from distriflow_tpu.ops import default_interpret
+
+    if default_interpret():
+        # flash never RUNS on this host (interpret unrolls the grid at
+        # trace time — minutes of compile at S=1024) but its analytic cost
+        # tally is a trace-time artifact: eval_shape of the flash-enabled
+        # step is enough to cost the kernels this row runs on TPU
+        import dataclasses
+
+        from distriflow_tpu.ops.flop_count import pallas_cost_of
+
+        fspec = transformer_lm(
+            dataclasses.replace(cfg, use_flash_attention=True),
+            mesh=mesh, example_seq=S)
+        tally = pallas_cost_of(jax.value_and_grad(fspec.loss_fn),
+                               trainer.get_params(),
+                               *_publish_structs((x1, y1), pub_b))
+        extra = {k: v for k, v in tally["by_category"].items()
+                 if k.startswith("attention")}
+    rl_batch = (_publish_structs((x1, y1), pub_b) if pub_b != B
+                else (x1, y1))
+    row.update(_roofline_fields(trainer, rl_batch, r["step_ms"] / 1e3,
+                                f"transformer_lm_{name}",
+                                extra_categories=extra))
+    return row
 
 
 def bench_transformer(n_chips):
     # rounds=3 (round 5): the r05 in-matrix run caught a slow window at
     # rounds=2 (248k tok/s vs 309-318k across standalone reruns) — a
     # longer differenced span rides out transient tunnel/chip slowdowns
+    if CPU_SCALE:  # smallest differenceable config that keeps S/L/d intact
+        # (B=1 steps at S=1024 measure ~12.5 s each on XLA:CPU — four
+        # dispatches is the budget, and S must NOT shrink: the projected
+        # bound_by rides on the attention/xla flop ratio at the real S)
+        return _bench_lm(n_chips, name="flagship", d_model=512,
+                         n_layers=FLAGSHIP_LAYERS, d_ff=2048, batch=1,
+                         steps=1, rounds=2, reps=1, publish_batch=8)
     return _bench_lm(n_chips, name="flagship", d_model=512,
                      n_layers=FLAGSHIP_LAYERS, d_ff=2048, batch=8,
                      steps=3 if FAST else 6, rounds=2 if FAST else 3,
@@ -2269,6 +2594,8 @@ def _floor_retry(matrix, fn, args):
     row = matrix[-1]
     floor = _MFU_FLOORS.get(row.get("config"))
     measured = row.get("mfu_min") or row.get("mfu")
+    if row.get("mfu_basis"):  # host-basis MFU: the floors are TPU bars
+        return
     if not floor or not measured or measured >= floor:
         return
     if time_left() < 45:
@@ -2301,13 +2628,16 @@ _DROP_ORDER = [
     "top2_dispatch_ms", "top2_expert_ms",
     "idle_ms", "overlap_ms", "submit_ms",
     "fit_ms", "drain_ms", "dispatch_ms", "ceiling_sps", "seq_ms", "conc_ms",
+    "roofline_err", "mfu_basis",
     "params_m", "round_ms", "workers", "step_ms", "mfu_med", "top2_mfu",
     "top2_tok_s", "i8_ms_tok_1k", "hbm_frac_4k", "wall_ms",
     "unattributed_ms", "topk_int8_bytes", "topk_int8_reduction_x",
     "topk_fraction", "down_bytes_per_broadcast", "dense_bytes",
     "up_bytes_per_update", "reduction_x",
-    # bound_by drops dead last: it is the one column the ROADMAP-4 overlap
-    # work pins its before/after on
+    # mfu_roofline and bound_by drop dead last: they are the columns the
+    # ROADMAP-4 overlap work and the round-18 kernel bars pin their
+    # before/after on
+    "mfu_roofline",
     "bound_by",
 ]
 
@@ -2364,6 +2694,8 @@ def main() -> None:
     matrix = []
 
     def run(fn, *args):
+        if LEGS and fn.__name__.removeprefix("bench_") not in LEGS:
+            return  # kernel-round recording runs name their legs
         t0 = time.monotonic()
         # shrink-not-skip: every leg runs (sized down via time_left());
         # one retry absorbs transients, and a double failure embeds a
@@ -2438,6 +2770,8 @@ def main() -> None:
     baselines = {}
     for name, fn in (("mnist_mlp_sync", bench_torch_mlp),
                      ("cifar10_convnet_sync", bench_torch_cifar)):
+        if not any(e.get("config") == name for e in matrix):
+            continue  # leg filtered out (BENCH_LEGS) or failed rowless
         try:
             baselines[name] = fn()
         except Exception as e:  # torch missing/broken must not kill the bench
@@ -2456,7 +2790,9 @@ def main() -> None:
         from distriflow_tpu.obs.ledger import BenchLedger
 
         ledger = BenchLedger()
-        run_id = f"bench-{int(_T0)}"
+        # BENCH_RUN_ID pins the id for the kernel-round's baseline-then-
+        # best sequencing (the two recordings must be tellable apart)
+        run_id = os.environ.get("BENCH_RUN_ID") or f"bench-{int(_T0)}"
         for entry in matrix:
             cfg = entry.get("config")
             if not cfg or "error" in entry:

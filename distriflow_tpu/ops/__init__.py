@@ -7,12 +7,18 @@ for the ops XLA's default fusion leaves on the table:
 - :func:`flash_attention` — fused online-softmax attention (never
   materializes the [S, S] score matrix in HBM);
 - :func:`fused_softmax_cross_entropy` — per-row logsumexp CE over the vocab
-  dim without materializing softmax probabilities.
+  dim without materializing softmax probabilities;
+- :func:`depthwise3x3_groupnorm` — depthwise-3x3 + GroupNorm + ReLU6 in one
+  VMEM-resident sweep (MobileNet's two measured hot spots fused).
 
 Kernels compile on TPU and fall back to interpret mode on CPU (tests), via
 :func:`default_interpret`.
 """
 
+from distriflow_tpu.ops.depthwise_gn import (  # noqa: F401
+    depthwise3x3_groupnorm,
+    depthwise_gn_supported,
+)
 from distriflow_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from distriflow_tpu.ops.fused_ce import (  # noqa: F401
     fused_softmax_cross_entropy,

@@ -8,18 +8,31 @@ The [S, S] score matrix never hits HBM — forward OR backward:
   ``blockwise_attention`` (``distriflow_tpu/parallel/ring_attention.py``),
   which is this kernel's correctness oracle. The per-row logsumexp is written
   out as a residual.
-- **Backward**: two kernels over the saved (q, k, v, o, lse) — probabilities
-  are recomputed per tile as ``exp(s - lse)`` (no second softmax pass), and
-  with ``delta = rowsum(do * o)`` the score gradient is the closed form
-  ``ds = p * (dp - delta)``. The dq kernel accumulates over K/V tiles; the
-  dk/dv kernel accumulates over Q tiles. All four matmuls per tile hit the
-  MXU with float32 accumulation.
+- **Backward**: ONE fused kernel over the saved (q, k, v, o, lse) —
+  probabilities are recomputed per tile as ``exp(s - lse)`` (no second
+  softmax pass), and with ``delta = rowsum(do * o)`` the score gradient is
+  the closed form ``ds = p * (dp - delta)``. The fused kernel materializes
+  P **once per tile pair** and produces dK/dV (accumulated over Q tiles in
+  VMEM scratch) and per-KV-block dQ partials (reduced outside the kernel)
+  in the same sweep: 5 matmuls + 1 exp per tile pair, versus 7 matmuls +
+  2 exps for the pre-round-18 two-kernel layout that recomputed S and P
+  independently for dQ and for dK/dV. The dQ partials cost ``n_kv`` f32
+  copies of Q in HBM, so the fused path is gated to small KV-block counts
+  (``_FUSED_BWD_MAX_KV_BLOCKS``); long-context shapes keep the two-kernel
+  layout, whose VMEM and HBM stay O(block · D).
+
+Backward tiles no longer inherit the forward's: the backward's arithmetic
+intensity is different (5 matmuls + dq-partial traffic per tile pair) and
+is autotuned per dtype/shape by :func:`_bwd_autotune` — callers can still
+pin ``bwd_block_q``/``bwd_block_k`` explicitly. ``bwd_compute_dtype``
+optionally runs the backward matmuls in a narrower dtype (bf16) with f32
+accumulators — opt-in, because the default must preserve the documented
+f32 gradient tolerances (tests/test_ops.py pins atol 3e-5 at f32).
 
 Grids put batch*head and the output-tile axis in parallel dimensions (Mosaic
 runs them concurrently) and the reduction axis innermost-sequential (VMEM
 scratch persists across it). Causal masking predicates away fully-masked
-tiles (~half the compute each direction). VMEM usage is O(block · D)
-regardless of sequence length — long-context safe.
+tiles (~half the compute each direction).
 """
 
 from __future__ import annotations
@@ -256,6 +269,94 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _dkvq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dqp_ref, dk_acc, dv_acc,
+                 *, block_q, block_k, n_q, causal, scale):
+    """Fused backward: dK, dV AND dQ partials in one sweep.
+
+    The two-kernel layout pays the score recompute twice — _dq_kernel and
+    _dkv_kernel each rebuild s and p for every tile pair (7 matmuls + 2
+    exps per pair). Here P is materialized ONCE per pair and feeds all
+    three gradients: 5 matmuls + 1 exp. The catch is the Pallas revisit
+    rule — an output block may be written by only one grid slice — and dq
+    accumulates over the K axis while dk/dv accumulate over Q. Resolution:
+    dk/dv keep the VMEM-scratch recurrence over the innermost-sequential Q
+    axis; dq is emitted as PER-KV-BLOCK f32 partials into a
+    ``[n_kv, BH, S, D]`` output where each (kv-block, q-block) pair owns a
+    unique write-once block, and the cheap cross-KV sum runs outside the
+    kernel as ordinary XLA.
+    """
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _tile():
+        # native-dtype matmuls + fp32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # the one P per tile pair
+        dv_acc[:] = dv_acc[:] + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # p^T @ do -> [block_k, D]
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1])
+        ds_lo = ds.astype(q.dtype)
+        # q/k are UNSCALED here (scale folds after the qk dot): dk and dq
+        # both carry the explicit scale — dk at finalize, dq in the
+        # outside-the-kernel reduction
+        dk_acc[:] = dk_acc[:] + lax.dot_general(
+            ds_lo, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # ds^T @ q -> [block_k, D]
+        dqp_ref[0, 0] = lax.dot_general(
+            ds_lo, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # ds @ k -> [block_q, D] f32 partial
+
+    if causal:
+        live = (qi + 1) * block_q > kb * block_k
+
+        @pl.when(live)
+        def _():
+            _tile()
+
+        # Pallas does NOT zero-init output blocks: a fully-masked pair still
+        # owns its dq-partial block and must write the zeros itself, or the
+        # outside reduction sums garbage
+        @pl.when(jnp.logical_not(live))
+        def _():
+            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+    else:
+        _tile()
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _resolve_interpret(interpret):
     if interpret is None:
         from distriflow_tpu.ops import default_interpret
@@ -280,6 +381,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         flops=4 * b * h * s * s * d // (2 if causal else 1),
         bytes_accessed=4 * b * h * s * d * q.dtype.itemsize,
         transcendentals=b * h * s * s // (2 if causal else 1),
+        category="attention_fwd",
     )
 
     qf = q.reshape(b * h, s, d)
@@ -348,24 +450,101 @@ def _block_caps(dtype):
     return _FWD_BLOCK_CAP_WIDE, _BWD_BLOCK_CAP_WIDE
 
 
+# The fused backward's dq partials cost n_kv f32 copies of Q in HBM
+# (written once, read once by the outside reduction). At the training
+# shapes n_kv is 1-2 and the traffic is noise next to the saved score
+# recompute; at 32k context with 1024-wide KV tiles it would be 32x Q in
+# f32 — past this many KV blocks the backward falls back to the two-kernel
+# layout, which stays O(block * D) in both VMEM and HBM.
+_FUSED_BWD_MAX_KV_BLOCKS = 8
+
+# Autotune budget: half the ~16 MB scoped-VMEM window, leaving headroom for
+# Mosaic's pipelining (double-buffered input blocks) that the analytic
+# estimate below does not model.
+_BWD_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _bwd_vmem_estimate(bq, bk, d, itemsize):
+    """Analytic per-grid-step VMEM working set of the fused backward."""
+    est = 2 * bq * d * itemsize + 2 * bk * d * itemsize  # q/do + k/v blocks
+    est += 2 * bq * _LANES * 4                           # lse + delta
+    est += 2 * bk * d * 4                                # dk/dv accumulators
+    est += bq * d * 4                                    # dq-partial out block
+    est += bq * bk * 4                                   # f32 score tile
+    return est
+
+
+def _bwd_autotune(s, d, compute_dtype):
+    """Backward tile pick — the backward no longer inherits forward tiles.
+
+    Its arithmetic intensity differs from the forward's (5 matmuls + dq
+    partial traffic per tile pair vs 2 matmuls), so the right tile is
+    chosen here: the largest sublane-aligned divisor of ``s`` under the
+    measured per-dtype cap whose working set passes the VMEM model. The
+    measured caps remain HARD ceilings, not starting points the model may
+    override upward: the analytic estimate is optimistic exactly where it
+    hurt before — round 2's 512-wide f32 tiles passed a naive byte count
+    yet spilled scoped VMEM for a real 10x cliff (_BWD_BLOCK_CAP note).
+    """
+    _, cap = _block_caps(compute_dtype)
+    itemsize = jnp.dtype(compute_dtype).itemsize
+    target = cap
+    while target > 8:
+        bq = _aligned_block(s, target)
+        bk = _aligned_block(s, target)
+        if _bwd_vmem_estimate(bq, bk, d, itemsize) <= _BWD_VMEM_BUDGET:
+            return bq, bk
+        target //= 2
+    return _aligned_block(s, 8), _aligned_block(s, 8)
+
+
 def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
-                    g_lse=None):
+                    g_lse=None, bwd_block_q=None, bwd_block_k=None,
+                    bwd_compute_dtype=None):
     interpret = _resolve_interpret(interpret)
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    _, bwd_cap = _block_caps(q.dtype)
-    bq = _aligned_block(s, min(block_q, bwd_cap))
-    bk = _aligned_block(s, min(block_k, bwd_cap))
+
+    # opt-in reduced-precision backward: matmul OPERANDS drop to
+    # compute_dtype (bf16 -> native MXU mode + half the block bytes, so the
+    # bf16 tile caps apply), accumulators and the softmax/ds math stay f32,
+    # and the returned gradients are cast back to the input dtypes. Off by
+    # default — f32 inputs keep f32 operands so the documented 3e-5
+    # gradient tolerance is undisturbed.
+    in_dtype = q.dtype
+    compute_dtype = in_dtype if bwd_compute_dtype is None else jnp.dtype(
+        bwd_compute_dtype
+    )
+
+    _, bwd_cap = _block_caps(compute_dtype)
+    auto_q, auto_k = _bwd_autotune(s, d, compute_dtype)
+    bq = auto_q if bwd_block_q is None else _aligned_block(
+        s, min(bwd_block_q, bwd_cap)
+    )
+    bk = auto_k if bwd_block_k is None else _aligned_block(
+        s, min(bwd_block_k, bwd_cap)
+    )
     n_q, n_kv = s // bq, s // bk
+    fused = n_kv <= _FUSED_BWD_MAX_KV_BLOCKS
 
     # model FLOPs of the attention backward: dV = P^T dO, dP = dO V^T,
     # dQ = dS K, dK = dS^T Q — four matmuls, 8*B*H*S*S*D (2x forward). The
-    # dq/dkv kernels ALSO recompute the scores, but that is remat overhead,
-    # excluded from MFU by convention (see ops/flop_count.py docstring).
+    # kernels ALSO recompute the scores, but that is remat overhead,
+    # excluded from MFU by convention (see ops/flop_count.py docstring);
+    # it IS counted in hw_flops, which is what the roofline divides by
+    # peak: the fused kernel runs 5 matmuls per tile pair, the two-kernel
+    # fallback 7 (s and dp each computed twice).
+    causal_div = 2 if causal else 1
+    matmul_unit = 2 * b * h * s * s * d // causal_div
     record_pallas_cost(
-        flops=8 * b * h * s * s * d // (2 if causal else 1),
-        bytes_accessed=8 * b * h * s * d * q.dtype.itemsize,
-        transcendentals=2 * b * h * s * s // (2 if causal else 1),
+        flops=4 * matmul_unit,
+        bytes_accessed=(
+            8 * b * h * s * d * compute_dtype.itemsize
+            + (2 * n_kv * b * h * s * d * 4 if fused else 0)
+        ),
+        transcendentals=(1 if fused else 2) * b * h * s * s // causal_div,
+        category="attention_bwd",
+        hw_flops=(5 if fused else 7) * matmul_unit,
     )
 
     # delta_i = rowsum(do_i * o_i): one cheap fused elementwise pass; makes
@@ -380,11 +559,51 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
         delta_rows = delta_rows - g_lse.astype(jnp.float32).reshape(b * h, s)
     delta = jnp.broadcast_to(delta_rows[:, :, None], (b * h, s, _LANES))
 
-    qf = q.reshape(b * h, s, d)
-    kf = k.reshape(b * h, s, d)
-    vf = v.reshape(b * h, s, d)
-    dof = do.reshape(b * h, s, d)
+    qf = q.reshape(b * h, s, d).astype(compute_dtype)
+    kf = k.reshape(b * h, s, d).astype(compute_dtype)
+    vf = v.reshape(b * h, s, d).astype(compute_dtype)
+    dof = do.reshape(b * h, s, d).astype(compute_dtype)
     lsef = lse  # already [B*H, S, LANES]
+    shape = (b, h, s, d)
+
+    if fused:
+        dk, dv, dqp = pl.pallas_call(
+            functools.partial(
+                _dkvq_kernel, block_q=bq, block_k=bk, n_q=n_q, causal=causal,
+                scale=scale,
+            ),
+            grid=(b * h, n_kv, n_q),
+            in_specs=[
+                pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+                pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+                pl.BlockSpec((1, bq, _LANES), lambda bh, j, i: (bh, i, 0)),
+                pl.BlockSpec((1, bq, _LANES), lambda bh, j, i: (bh, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                # dq partials: the KV-block axis leads so each (j, i) pair
+                # owns a unique write-once block (Pallas revisit rule)
+                pl.BlockSpec((1, 1, bq, d), lambda bh, j, i: (j, bh, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+                jax.ShapeDtypeStruct((n_kv, b * h, s, d), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),  # dk accumulator
+                pltpu.VMEM((bk, d), jnp.float32),  # dv accumulator
+            ],
+            interpret=interpret,
+            compiler_params=pallas_tpu_compiler_params(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+            ),
+        )(kf, vf, qf, dof, lsef, delta)
+        dq = (jnp.sum(dqp, axis=0) * scale).astype(in_dtype)
+        return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -440,12 +659,10 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(kf, vf, qf, dof, lsef, delta)
-
-    shape = (b, h, s, d)
     return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -455,6 +672,13 @@ def flash_attention(
     block_k: int = 1024,  # kernel is grid-overhead-bound, so max out tiles;
     # causal tile-skipping still operates at tile granularity for S > 1024
     interpret: Optional[bool] = None,
+    # backward tiles are autotuned (see _bwd_autotune) unless pinned here;
+    # forward block_q/block_k no longer flow into the backward
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
+    # opt-in reduced-precision backward (e.g. jnp.bfloat16): matmul operands
+    # in this dtype, f32 accumulators, gradients cast back to input dtype
+    bwd_compute_dtype: Optional[jnp.dtype] = None,
 ) -> jnp.ndarray:
     """Fused attention over ``[B, H, S, D]`` tensors.
 
@@ -463,12 +687,13 @@ def flash_attention(
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret,
+         bwd_block_q, bwd_block_k, bwd_compute_dtype):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention_with_lse(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -477,6 +702,9 @@ def flash_attention_with_lse(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
+    bwd_compute_dtype: Optional[jnp.dtype] = None,
 ):
     """Like :func:`flash_attention` but also returns the per-row logsumexp
     ``[B, H, S]`` (f32) — the residual that lets partial attentions over
@@ -487,27 +715,34 @@ def flash_attention_with_lse(
     return out, lse[..., 0].reshape(b, h, s)
 
 
-def _fwd_with_lse(q, k, v, causal, block_q, block_k, interpret):
+def _fwd_with_lse(q, k, v, causal, block_q, block_k, interpret,
+                  bwd_block_q, bwd_block_k, bwd_compute_dtype):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     b, h, s, _ = q.shape
     return (out, lse[..., 0].reshape(b, h, s)), (q, k, v, out, lse)
 
 
-def _bwd_with_lse(causal, block_q, block_k, interpret, res, g):
+def _bwd_with_lse(causal, block_q, block_k, interpret, bwd_block_q,
+                  bwd_block_k, bwd_compute_dtype, res, g):
     q, k, v, o, lse = res
     do, g_lse = g
     return _flash_backward(
-        q, k, v, o, lse, do, causal, block_q, block_k, interpret, g_lse=g_lse
+        q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+        g_lse=g_lse, bwd_block_q=bwd_block_q, bwd_block_k=bwd_block_k,
+        bwd_compute_dtype=bwd_compute_dtype,
     )
 
 
 flash_attention_with_lse.defvjp(_fwd_with_lse, _bwd_with_lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k,
+         bwd_compute_dtype, res, g):
     q, k, v, o, lse = res
     return _flash_backward(
-        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+        bwd_block_q=bwd_block_q, bwd_block_k=bwd_block_k,
+        bwd_compute_dtype=bwd_compute_dtype,
     )
 
 

@@ -13,6 +13,15 @@ backward work), not hardware FLOPs — the flash backward's score recompute is
 rematerialization overhead and is excluded, per the standard MFU definition
 (PaLM appendix B): MFU compares achieved *useful* FLOP/s against peak, so a
 kernel that recomputes does not get credit for the recompute.
+
+Round 18 adds the **hardware** side of the ledger: ``hw_flops`` is the
+FLOPs the kernel actually executes — model FLOPs PLUS recompute — and it is
+what a roofline time model must divide by peak (``ops/roofline.py``). The
+two columns make the cost of rematerialization a first-class, queryable
+number: the fused attention backward's whole win is that its ``hw_flops``
+drops from 14 to 10 matmul-units while its model FLOPs (the MFU numerator)
+stay fixed at 8. ``hw_flops`` defaults to ``flops`` for kernels that do not
+recompute.
 """
 
 from __future__ import annotations
@@ -25,12 +34,15 @@ _TALLY: ContextVar[Optional[Dict[str, float]]] = ContextVar(
     "pallas_cost_tally", default=None
 )
 
+_FIELDS = ("flops", "bytes_accessed", "transcendentals", "hw_flops")
+
 
 def record_pallas_cost(
     flops: float = 0.0,
     bytes_accessed: float = 0.0,
     transcendentals: float = 0.0,
     category: Optional[str] = None,
+    hw_flops: Optional[float] = None,
 ) -> None:
     """Add one kernel invocation's analytic cost to the active tally.
 
@@ -45,29 +57,58 @@ def record_pallas_cost(
     at compile time, invisible to an abstract trace) while the shard_map'd
     kernels trace per-shard; ``SyncTrainer.cost_analysis`` divides the CE
     share by the row-shard degree to keep the per-device convention exact.
+    The roofline model (``ops/roofline.py``) consumes the same categories
+    as its phase taxonomy, so a kernel family that wants a roofline row
+    must tag itself.
+
+    ``hw_flops``: FLOPs the kernel body actually executes (model FLOPs +
+    recompute); defaults to ``flops``. Never folded into MFU — consumed
+    only by the roofline time model.
     """
     tally = _TALLY.get()
     if tally is not None:
+        hw = float(flops if hw_flops is None else hw_flops)
         tally["flops"] += float(flops)
         tally["bytes_accessed"] += float(bytes_accessed)
         tally["transcendentals"] += float(transcendentals)
+        tally["hw_flops"] += hw
         if category is not None:
             cat = tally["by_category"].setdefault(
-                category,
-                {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0},
+                category, {f: 0.0 for f in _FIELDS},
             )
             cat["flops"] += float(flops)
             cat["bytes_accessed"] += float(bytes_accessed)
             cat["transcendentals"] += float(transcendentals)
+            cat["hw_flops"] += hw
 
 
 @contextmanager
 def tally_pallas_cost() -> Iterator[Dict[str, float]]:
     """Collect Pallas kernel costs recorded while tracing inside the block."""
-    tally = {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0,
-             "by_category": {}}
+    tally: Dict[str, float] = {f: 0.0 for f in _FIELDS}
+    tally["by_category"] = {}  # type: ignore[assignment]
     token = _TALLY.set(tally)
     try:
         yield tally
     finally:
         _TALLY.reset(token)
+
+
+def pallas_cost_of(fn, *args, **kwargs) -> Dict[str, float]:
+    """Tally of one abstract trace of ``fn(*args, **kwargs)``.
+
+    ``jax.eval_shape`` under a fresh tally — no compile, no execution, no
+    data movement. The convenience entry for tests and the roofline model:
+    both need "what would this function's kernels record?" without standing
+    up a trainer. Caveat (the PR 1 warm-cache lesson, pinned by
+    tests/test_depthwise_gn.py): a warm trace cache replays memoized
+    jaxprs and skips the Python kernel wrappers, so a zero tally from a
+    function KNOWN to contain Pallas calls means the cache ate the trace —
+    clear with ``jax.clear_caches()`` and retrace, exactly as
+    ``SyncTrainer.cost_analysis`` does.
+    """
+    import jax
+
+    with tally_pallas_cost() as tally:
+        jax.eval_shape(lambda *a: fn(*a, **kwargs), *args)
+    return tally

@@ -1,0 +1,125 @@
+"""Analytic roofline model over the Pallas cost tally.
+
+Consumes the two-column FLOP ledger from ``ops/flop_count.py`` — model
+FLOPs (the MFU numerator) and ``hw_flops`` (what the kernel actually
+executes, recompute included) — and projects per-phase step time on a
+target accelerator as ``max(compute, memory)``:
+
+    t_phase = max(hw_flops / (peak * efficiency),  bytes / hbm_bw)
+
+``efficiency`` is NOT a free parameter: per-category fractions-of-peak are
+calibrated from the round-3 measured kernel sweeps documented in
+PERFORMANCE.md §4 (bf16 1024-wide attention tiles sustained ~55% of v5e
+peak; 256-wide tiles ~13% — grid-step overhead dominates small tiles) and
+are deliberately conservative elsewhere. The model's value is
+*differential*: with efficiencies held fixed, swapping one kernel's
+(hw_flops, bytes) for another's shows how much of the measured gap a
+rework closes and which phase becomes the binding constraint — exactly
+the ``bound_by``-flip evidence the round-18 MFU bars ask for. On hosts
+with no TPU (tier-1 CI), the same report labels projections honestly as
+model output, never as measurement.
+
+The projected ``bound_by`` uses the same phase names as the trace
+assembler's step-round taxonomy, so a bench row can surface either the
+measured critical path (on TPU) or the modeled one (projection) through
+one field.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# v5e (TPU v5 lite) public specs — the flagship training target the
+# standing MFU bars were set against (train/sync.py PEAK_BF16_FLOPS).
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_HBM_BYTES_PER_S = 819e9
+
+# calibrated fraction-of-peak a COMPUTE-bound phase sustains (see module
+# docstring — round-3 measured sweeps, PERFORMANCE.md §4). "xla" covers
+# everything outside the Pallas tally: dense matmuls XLA schedules well.
+PHASE_EFFICIENCY: Dict[str, float] = {
+    "attention_fwd": 0.55,
+    "attention_bwd": 0.55,
+    "fused_ce": 0.50,
+    "depthwise_gn": 0.30,  # VPU shift-MACs + stats: no MXU contraction
+    "xla": 0.60,
+    # pre-round-18 counterfactuals, kept so a BENCH_ROOFLINE=pre18 run can
+    # record the BEFORE projection of each rework (bench.py rewinds the
+    # tally into these names): the two-kernel attention backward inherited
+    # FORWARD tile sizes, which spill VMEM at backward arithmetic — the
+    # measured 10x cliff (flash_attention.py _BWD_BLOCK_CAP comment) off
+    # the healthy 0.55; the unfused depthwise+GN chain is three separate
+    # VPU-bound XLA ops with per-op launch/layout overheads on top of the
+    # fused kernel's 0.30.
+    "attention_bwd_unfused": 0.055,
+    "depthwise_gn_unfused": 0.15,
+}
+_DEFAULT_EFFICIENCY = 0.40
+
+
+def phase_time_s(
+    hw_flops: float,
+    bytes_accessed: float,
+    phase: str,
+    peak_flops: float = V5E_PEAK_BF16_FLOPS,
+    hbm_bw: float = V5E_HBM_BYTES_PER_S,
+) -> Dict[str, float]:
+    """One phase's roofline: compute vs memory leg and which one binds."""
+    eff = PHASE_EFFICIENCY.get(phase, _DEFAULT_EFFICIENCY)
+    t_compute = hw_flops / (peak_flops * eff) if hw_flops else 0.0
+    t_memory = bytes_accessed / hbm_bw if bytes_accessed else 0.0
+    return {
+        "time_s": max(t_compute, t_memory),
+        "compute_s": t_compute,
+        "memory_s": t_memory,
+        "bound": "compute" if t_compute >= t_memory else "memory",
+    }
+
+
+def roofline_report(
+    by_category: Dict[str, Dict[str, float]],
+    model_flops: float,
+    xla_flops: float = 0.0,
+    xla_bytes: float = 0.0,
+    peak_flops: float = V5E_PEAK_BF16_FLOPS,
+    hbm_bw: float = V5E_HBM_BYTES_PER_S,
+    measured_step_s: Optional[float] = None,
+) -> Dict[str, object]:
+    """Project a step's phase times, MFU, and binding phase.
+
+    ``by_category`` is the tally's category breakdown (each entry carries
+    ``hw_flops`` and ``bytes_accessed``); ``xla_flops``/``xla_bytes`` cover
+    the non-Pallas remainder of the program (XLA's own cost analysis).
+    ``model_flops`` is the MFU numerator for the WHOLE step. Returns
+    ``mfu_roofline`` (projected), per-phase legs, and ``bound_by`` — the
+    phase owning the largest projected time slice, named with the same
+    taxonomy the trace assembler uses for measured rounds. When
+    ``measured_step_s`` is given, also reports ``model_error`` =
+    (projected - measured) / measured, a cheap honesty check where a
+    measurement exists.
+    """
+    phases: Dict[str, Dict[str, float]] = {}
+    for name, cat in by_category.items():
+        phases[name] = phase_time_s(
+            float(cat.get("hw_flops", cat.get("flops", 0.0))),
+            float(cat.get("bytes_accessed", 0.0)),
+            name, peak_flops, hbm_bw,
+        )
+    if xla_flops or xla_bytes:
+        phases["xla"] = phase_time_s(
+            float(xla_flops), float(xla_bytes), "xla", peak_flops, hbm_bw)
+    step_s = sum(p["time_s"] for p in phases.values())
+    bound_by = max(phases, key=lambda n: phases[n]["time_s"]) if phases else ""
+    report: Dict[str, object] = {
+        "phases": phases,
+        "step_time_s": step_s,
+        "mfu_roofline": (
+            float(model_flops) / (step_s * peak_flops) if step_s else 0.0
+        ),
+        "bound_by": bound_by,
+        "peak_flops": peak_flops,
+        "hbm_bw": hbm_bw,
+    }
+    if measured_step_s:
+        report["model_error"] = (step_s - measured_step_s) / measured_step_s
+    return report

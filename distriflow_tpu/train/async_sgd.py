@@ -882,3 +882,102 @@ class AsyncSGDTrainer:
         if weight is not None:
             args.append(jnp.asarray(weight, jnp.float32))
         return [float(v) for v in fn(params, *args)]
+
+    def cost_analysis(self, batch_size: int) -> Dict[str, float]:
+        """Cost of ONE per-batch grad step at ``batch_size``.
+
+        The async program of record is the K-group scan
+        (:meth:`_staged_multi_grad_for`), but its body is this per-batch
+        ``value_and_grad`` — cost is linear in K, so the per-step figure is
+        the per-upload cost divided by ``steps_per_upload``. Mirrors
+        ``SyncTrainer.cost_analysis``'s two ledgers: XLA's compiled
+        analysis (custom calls count 0) plus the Pallas trace-time tally
+        with the warm-trace-cache retrace guard (ops/flop_count.py).
+        Cached per batch size; abstract-only (nothing runs on device).
+        """
+        cache = getattr(self, "_cost_cache", None)
+        if cache is None:
+            cache = self._cost_cache = {}
+        key = int(batch_size)
+        if key not in cache:
+            params, _ = self.snapshot()  # locked read (dfcheck guarded-by)
+            if params is None:
+                params = self.init()
+            pstructs = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a), a.dtype),
+                params)
+            xs = jax.ShapeDtypeStruct(
+                (key,) + tuple(self.dataset.x.shape[1:]),
+                jnp.dtype(self.dataset.x.dtype))
+            ys = jax.ShapeDtypeStruct(
+                (key,) + tuple(self.dataset.y.shape[1:]),
+                jnp.dtype(self.dataset.y.dtype))
+            grad = jax.value_and_grad(self.spec.loss_fn)
+            analysis = jax.jit(grad).lower(
+                pstructs, xs, ys).compile().cost_analysis()
+            if isinstance(analysis, (list, tuple)):  # older jax: [dict]
+                analysis = analysis[0]
+            analysis = dict(analysis)
+            from distriflow_tpu.ops.flop_count import tally_pallas_cost
+
+            with tally_pallas_cost() as tally:
+                jax.eval_shape(grad, pstructs, xs, ys)
+            if tally["flops"] == 0.0:
+                # Pallas-free program OR a warm trace cache replaying
+                # memoized jaxprs past the kernel wrappers — clear and
+                # retrace once to disambiguate (the PR 1 fix)
+                jax.clear_caches()
+                with tally_pallas_cost() as tally:
+                    jax.eval_shape(grad, pstructs, xs, ys)
+            analysis["xla_flops"] = float(analysis.get("flops", 0.0))
+            analysis["pallas_flops"] = tally["flops"]
+            analysis["pallas_hw_flops"] = tally["hw_flops"]
+            from distriflow_tpu.ops import default_interpret
+
+            if not default_interpret():
+                analysis["flops"] = analysis["xla_flops"] + tally["flops"]
+                analysis["bytes accessed"] = (
+                    float(analysis.get("bytes accessed", 0.0))
+                    + tally["bytes_accessed"])
+            # else: interpret mode already lowered the kernel bodies to HLO
+            # XLA counted — folding would double-count
+            cache[key] = analysis
+        return cache[key]
+
+    def mfu(
+        self,
+        batch_size: int,
+        step_seconds: float,
+        peak_flops_per_chip: Optional[float] = None,
+        gauge_mode: str = "async",
+    ) -> float:
+        """Model FLOPs utilization of one async worker-step: per-batch grad
+        flops / (per-step wall x per-chip peak). ``step_seconds`` is the
+        per-BATCH wall time (elapsed / batches processed) — the async mode
+        is host-coordination-bound by design, so this is chiefly a live
+        audit surface, mirrored into ``train_mfu{mode="async"}`` so the
+        bench cross-check covers the async row like every other MFU row
+        (round-18 satellite)."""
+        if peak_flops_per_chip is None:
+            from distriflow_tpu.train.sync import SyncTrainer
+
+            kind = jax.devices()[0].device_kind
+            for key, peak in SyncTrainer.PEAK_BF16_FLOPS.items():
+                if key in kind.lower():
+                    peak_flops_per_chip = peak
+                    break
+            else:
+                raise ValueError(
+                    f"unknown device kind {kind!r}; pass peak_flops_per_chip="
+                )
+        analysis = self.cost_analysis(batch_size)
+        if not analysis.get("flops"):
+            raise ValueError(
+                "grad-step cost analysis reports no 'flops' on this "
+                f"backend (keys: {sorted(analysis)}); MFU unavailable")
+        value = float(analysis["flops"]) / (step_seconds * peak_flops_per_chip)
+        get_telemetry().gauge(
+            "train_mfu", mode=gauge_mode,
+            help="model FLOPs utilization vs peak chip FLOPs",
+        ).set(value)
+        return value

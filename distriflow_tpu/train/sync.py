@@ -422,7 +422,8 @@ class SyncTrainer:
             ).get("data", 1)
             ce = tally["by_category"].get("fused_ce")
             if ce is not None and data_degree > 1:
-                for field in ("flops", "bytes_accessed", "transcendentals"):
+                for field in ("flops", "bytes_accessed", "transcendentals",
+                              "hw_flops"):
                     tally[field] -= ce[field] * (1.0 - 1.0 / data_degree)
                     # keep the category breakdown consistent with the
                     # corrected top-level tally (round-4 advisor: a
@@ -432,12 +433,20 @@ class SyncTrainer:
             # sits inside the micro-step scan body — traced once (at
             # micro-batch shapes), executed grad_accum times
             if self.grad_accum > 1:
-                for field in ("flops", "bytes_accessed", "transcendentals"):
+                for field in ("flops", "bytes_accessed", "transcendentals",
+                              "hw_flops"):
                     tally[field] *= self.grad_accum
                     for cat in tally["by_category"].values():
                         cat[field] *= self.grad_accum
             analysis["xla_flops"] = float(analysis.get("flops", 0.0))
             analysis["pallas_flops"] = tally["flops"]
+            # hardware-FLOPs + per-kernel-family breakdown for the roofline
+            # time model (ops/roofline.py): hw_flops counts recompute that
+            # the MFU numerator deliberately excludes
+            analysis["pallas_hw_flops"] = tally["hw_flops"]
+            analysis["pallas_by_category"] = {
+                k: dict(v) for k, v in tally["by_category"].items()
+            }
             from distriflow_tpu.ops import default_interpret
 
             if not default_interpret():
@@ -463,6 +472,7 @@ class SyncTrainer:
         batch: Batch,
         step_seconds: Optional[float] = None,
         peak_flops_per_chip: Optional[float] = None,
+        gauge_mode: str = "sync",
     ) -> float:
         """Model FLOPs utilization of one step: per-device analyzed flops /
         (step time x per-chip peak).
@@ -509,9 +519,13 @@ class SyncTrainer:
         # live MFU surface: the health sentinel's mfu_floor band and the
         # bench cross-check read this gauge (docs/OBSERVABILITY.md §6);
         # set only on success so a backend without flop counts leaves the
-        # gauge unregistered rather than pinned at a stale value
+        # gauge unregistered rather than pinned at a stale value.
+        # ``gauge_mode`` keys the per-workload series (sync / mobilenet /
+        # async...) so concurrent bench rows don't clobber one label and
+        # every MFU row can audit ITS OWN gauge (round-18 satellite: the
+        # cross-check previously only ever found mode="sync")
         get_telemetry().gauge(
-            "train_mfu", mode="sync",
+            "train_mfu", mode=gauge_mode,
             help="model FLOPs utilization vs peak chip FLOPs",
         ).set(value)
         return value

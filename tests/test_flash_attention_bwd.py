@@ -1,0 +1,163 @@
+"""Flash-attention BACKWARD tests for the round-18 fused rework.
+
+The forward and its baseline gradients are covered in test_ops.py; this
+module pins what the rework changed: the fused dK/dV/dQ-partial kernel vs
+the two-kernel fallback (selected by the ``_FUSED_BWD_MAX_KV_BLOCKS``
+gate), the backward-specific autotune with its measured VMEM-cliff caps,
+the opt-in bf16 backward compute mode, and the 5-vs-7-matmul hw_flops
+cost split the roofline consumes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# NOTE: ops/__init__ re-exports the flash_attention FUNCTION, which
+# shadows the submodule for ``import ... as`` — go through import_module.
+import importlib
+
+fa = importlib.import_module("distriflow_tpu.ops.flash_attention")
+flash_attention = fa.flash_attention
+from distriflow_tpu.ops.flop_count import pallas_cost_of
+from distriflow_tpu.parallel.ring_attention import dense_attention
+
+pytestmark = pytest.mark.kernels
+
+
+def _qkv(b=2, h=2, s=64, d=16, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(ks[i], (b, h, s, d), dtype)
+                 for i in range(3))
+
+
+def _grads(f, q, k, v):
+    return jax.grad(lambda *a: jnp.sum(f(*a) ** 2), argnums=(0, 1, 2))(
+        q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_bwd_multiblock_vs_dense(causal):
+    """Fused path, multiple blocks on BOTH grid axes (s=64, blocks=16 ->
+    4x4 tile pairs; causal additionally exercises the fully-masked pairs
+    whose dq-partial blocks must be explicitly zero-written — Pallas does
+    not zero-init outputs)."""
+    q, k, v = _qkv()
+    dq, dk, dv = _grads(
+        lambda q, k, v: flash_attention(q, k, v, causal, 32, 32, True,
+                                        16, 16, None),
+        q, k, v)
+    rq, rk, rv = _grads(lambda q, k, v: dense_attention(q, k, v, causal),
+                        q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), atol=3e-5)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=3e-5)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_and_fallback_agree(causal):
+    """Either side of the _FUSED_BWD_MAX_KV_BLOCKS gate computes the same
+    gradients: s=128 with 16-wide KV tiles is n_kv=8 (fused, at the gate
+    edge); 8-wide tiles are n_kv=16 (two-kernel fallback)."""
+    assert fa._FUSED_BWD_MAX_KV_BLOCKS == 8
+    q, k, v = _qkv(s=128)
+
+    def run(bwd_blk):
+        return _grads(
+            lambda q, k, v: flash_attention(q, k, v, causal, 64, 64, True,
+                                            bwd_blk, bwd_blk, None),
+            q, k, v)
+
+    fused = run(16)
+    fallback = run(8)
+    dense = _grads(lambda q, k, v: dense_attention(q, k, v, causal),
+                   q, k, v)
+    for got_f, got_u, ref in zip(fused, fallback, dense):
+        np.testing.assert_allclose(np.asarray(got_f), np.asarray(got_u),
+                                   atol=3e-6)
+        np.testing.assert_allclose(np.asarray(got_f), np.asarray(ref),
+                                   atol=3e-5)
+
+
+def test_bf16_backward_compute_optin():
+    """bwd_compute_dtype=bfloat16 drops matmul OPERANDS to bf16 but keeps
+    f32 accumulators and returns f32 gradients for f32 inputs — tolerance
+    loosens to bf16 mantissa scale, not worse."""
+    q, k, v = _qkv(s=64)
+    grads = _grads(
+        lambda q, k, v: flash_attention(q, k, v, True, 32, 32, True,
+                                        16, 16, jnp.bfloat16),
+        q, k, v)
+    ref = _grads(lambda q, k, v: dense_attention(q, k, v, True), q, k, v)
+    for got, want in zip(grads, ref):
+        assert got.dtype == jnp.float32  # cast back to the input dtype
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=0.15, rtol=0.1)
+
+
+def test_block_caps_per_dtype():
+    """The measured VMEM-spill cliff (10x, _BWD_BLOCK_CAP note) is encoded
+    as HARD per-dtype ceilings: bf16 backward tiles cap at 1024, f32 at
+    256 (double the bytes per element), f32 forward at 512."""
+    assert fa._block_caps(jnp.bfloat16) == (1024, 1024)
+    assert fa._block_caps(jnp.float16) == (1024, 1024)
+    assert fa._block_caps(jnp.float32) == (512, 256)
+
+
+def test_bwd_autotune_respects_caps_and_vmem():
+    """Autotune picks the largest multiple-of-8 divisor under the dtype
+    cap, halving while the analytic working set exceeds the 8 MB budget —
+    and the cap is a ceiling the VMEM model may never override upward."""
+    # short sequence: one block, capped by s itself
+    assert fa._bwd_autotune(64, 64, jnp.float32) == (64, 64)
+    # long bf16 sequence, small head: full 1024 tiles fit the budget
+    assert fa._bwd_autotune(4096, 64, jnp.bfloat16) == (1024, 1024)
+    # f32 never exceeds its 256 cap even though VMEM would allow more
+    bq, bk = fa._bwd_autotune(4096, 64, jnp.float32)
+    assert bq == bk == 256
+    # a huge head dim blows the budget at the cap (d=2048 f32 needs ~14 MB
+    # at 256-wide tiles) -> the tile halves, and the result still
+    # satisfies the model it was chosen by
+    assert fa._bwd_vmem_estimate(256, 256, 2048, 4) > fa._BWD_VMEM_BUDGET
+    bq, bk = fa._bwd_autotune(4096, 2048, jnp.float32)
+    assert bq == bk < 256
+    assert bq % 8 == 0
+    assert fa._bwd_vmem_estimate(bq, bk, 2048, 4) <= fa._BWD_VMEM_BUDGET
+    # pinned blocks are clamped through the same cap (public entry):
+    # bwd_block_q=512 on f32 must not resurrect the spill configuration
+    q, k, v = _qkv(s=512, d=16)
+    out = flash_attention(q, k, v, False, 256, 256, True, 512, 512, None)
+    assert out.shape == q.shape  # clamped to 256 internally, still correct
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bwd_cost_split_fused_vs_fallback(causal):
+    """The tally's model/hardware split is what the roofline rides on:
+    model FLOPs are 4 matmuls (2x fwd) either way; hw_flops count 5
+    matmuls fused vs 7 in the two-kernel fallback (scores and dP each
+    recomputed twice); the fallback also pays the exp twice."""
+    b, h, s, d = 2, 2, 128, 16
+    q, k, v = _qkv(b=b, h=h, s=s, d=d)
+    div = 2 if causal else 1
+    unit = 2 * b * h * s * s * d // div
+
+    def tally(bwd_blk):
+        t = pallas_cost_of(
+            jax.grad(lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal, 64, 64, True,
+                                bwd_blk, bwd_blk, None))),
+            q, k, v)
+        return t["by_category"]["attention_bwd"]
+
+    fused = tally(16)   # n_kv = 8 -> fused
+    assert fused["flops"] == 4 * unit
+    assert fused["hw_flops"] == 5 * unit
+    assert fused["transcendentals"] == b * h * s * s // div
+
+    fb = tally(8)       # n_kv = 16 -> two-kernel fallback
+    assert fb["flops"] == 4 * unit
+    assert fb["hw_flops"] == 7 * unit
+    assert fb["transcendentals"] == 2 * b * h * s * s // div
+    # the fused path's extra bytes are the dq partials: n_kv f32 copies of Q
+    assert fused["bytes_accessed"] - fb["bytes_accessed"] == (
+        2 * 8 * b * h * s * d * 4)
