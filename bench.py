@@ -2094,6 +2094,175 @@ def bench_serving_slo(ctx=512, n_tokens=32, n_users=6, warm_waves=2):
     return row
 
 
+def bench_serving_elastic(ctx=512, n_tokens=16, n_requests=8):
+    """Round-19 row (docs/ROBUSTNESS.md §11): tier-0 tail hedging over a
+    3-replica hash-ring fleet with a scripted straggler, plus the ring's
+    structural churn costs.
+
+    The straggler leg stretches the arc owner's admission window to
+    1 s (the idle engine's gather window — a deterministic queue-side
+    stall, not a jittery sleep, and sized to dominate CPU-host compute
+    so the hedge race has one winner) and replays the same owner-routed
+    prompt ``n_requests`` times unhedged, then hedged with the 25 ms
+    tier-0 watermark. Unhedged, every request eats the stretched window;
+    hedged, the duplicate lands on the second arc owner and wins while
+    the loser retires unadmitted via hedge_cancel. Headline ``value`` is
+    the unhedged/hedged p99 ratio — how much tail the watermark buys. A
+    drain/undrain churn wave then checks goodput stays 1.0 while a
+    replica leaves and rejoins the ring, and the join/leave remap
+    fractions come from ``ring.assignment`` diffs over a fixed key set —
+    sha1-deterministic, so the ledger pins them exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distriflow_tpu.fleet import FleetRouter, HashRing, page_hashes
+    from distriflow_tpu.models.transformer import (
+        TransformerConfig,
+        transformer_lm,
+    )
+    from distriflow_tpu.obs.telemetry import Telemetry
+    from distriflow_tpu.server import InferenceServer
+    from distriflow_tpu.utils.config import ServingConfig
+
+    if SLOW or FAST or time_left() < 120:
+        ctx = ctx // 4
+
+    PAGE_SIZE = 64
+    rng = np.random.RandomState(0)
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=256, n_heads=4, n_layers=4, d_ff=1024,
+        max_seq=ctx + n_tokens, dtype=jnp.bfloat16)
+    params = transformer_lm(cfg, example_seq=128).init(jax.random.PRNGKey(0))
+
+    tel = Telemetry()
+    servers = {}
+    for name in ("A", "B", "C"):
+        s = InferenceServer(
+            cfg, params, port=0, telemetry=Telemetry(),
+            serving=ServingConfig(
+                kv_layout="paged", max_slots=2, page_size=PAGE_SIZE,
+                page_pool_pages=4 * ((ctx + n_tokens) // PAGE_SIZE + 1),
+                batch_window_s=0.02, decode_chunk=8))
+        s.transport.heartbeat_timeout = 0  # see _serving_client
+        servers[name] = s.setup()
+    router = FleetRouter(port=0, policy="ring", stats_interval_s=0.0,
+                         redial=False, telemetry=tel)
+    for name, s in servers.items():
+        router.add_replica(s.address, name=name)
+    router.setup()
+
+    def owned(owner):
+        for seed in range(4096):
+            p = np.random.default_rng(seed).integers(
+                1, 32000, size=(1, ctx)).astype(np.int32)
+            if router.ring.primary(page_hashes(p[0], PAGE_SIZE)[0]) == owner:
+                return p
+        raise AssertionError(f"no prompt owned by {owner}")
+
+    try:
+        prompts = {n: owned(n) for n in servers}
+        # compile prefill AND the measured decode-chunk path on every
+        # replica (unrouted) so no measured wall pays XLA
+        for name, s in servers.items():
+            with _serving_client(s.address) as w:
+                w.generate(prompts[name], n_tokens=n_tokens)
+        sa = servers["A"]
+
+        STRAGGLE_S = 1.0
+
+        def straggler_leg(hedged):
+            walls = []
+            with _serving_client(router.address) as c:
+                for _ in range(n_requests):
+                    t0 = time.perf_counter()
+                    c.generate(prompts["A"], n_tokens=n_tokens, tier=0)
+                    walls.append((time.perf_counter() - t0) * 1e3)
+                    if hedged:
+                        # hedged walls end while A is still inside its
+                        # stretched gather window holding the cancelled
+                        # copy; wait it out so the next request finds A
+                        # idle and pays the FULL window again — otherwise
+                        # it joins the open batch and A can win the race
+                        time.sleep(STRAGGLE_S)
+            return (float(np.percentile(walls, 50)),
+                    float(np.percentile(walls, 99)))
+
+        sa.serving.batch_window_s = STRAGGLE_S  # read at use time
+        try:
+            unhedged_p50, unhedged_p99 = straggler_leg(False)
+            router.hedge_ms[0] = 25.0
+            hedged_p50, hedged_p99 = straggler_leg(True)
+        finally:
+            router.hedge_ms.clear()
+            sa.serving.batch_window_s = 0.02
+        hedges = tel.counter_value("router_hedges_total")
+        wins = tel.counter_value("router_hedge_wins_total")
+
+        # churn wave: B leaves the ring (drain) and rejoins; its arcs'
+        # traffic fails over and comes home, nothing is dropped
+        with _serving_client(router.address) as c:
+            router.drain_replica("B")
+            for p in prompts.values():
+                c.generate(p, n_tokens=4, tier=1)
+            router.undrain_replica("B")
+            for p in prompts.values():
+                c.generate(p, n_tokens=4, tier=1)
+        accepted = sum(tel.counter_value("router_requests_total",
+                                         tier=str(t)) for t in (0, 1, 2))
+        answered = sum(tel.counter_value("router_goodput_total",
+                                         tier=str(t)) for t in (0, 1, 2))
+        goodput = answered / accepted if accepted else 0.0
+    finally:
+        router.stop()
+        for s in servers.values():
+            s.stop()
+
+    # structural remap cost, no servers involved: assignment diffs over a
+    # fixed key set are pure sha1 — exact today, exact forever
+    ring = HashRing(256)
+    ring.sync(["A", "B", "C"])
+    keys = [f"warmset-{i}".encode() for i in range(2000)]
+    base = ring.assignment(keys)
+    ring.add("D")
+    after_join = ring.assignment(keys)
+    join_frac = sum(1 for k in keys
+                    if after_join[k] != base[k]) / float(len(keys))
+    ring.remove("D")
+    assert ring.assignment(keys) == base, "join+leave did not round-trip"
+    ring.remove("A")
+    after_leave = ring.assignment(keys)
+    leave_frac = sum(1 for k in keys
+                     if after_leave[k] != base[k]) / float(len(keys))
+
+    # the median is the deterministic quantity here — every request is
+    # identically straggled — so it carries the gated headline; the p99s
+    # ride along as loosely-guarded diagnostics
+    ratio = unhedged_p50 / hedged_p50 if hedged_p50 else 0.0
+    log(f"serving_elastic: straggler p50 {unhedged_p50:.0f}ms unhedged vs "
+        f"{hedged_p50:.0f}ms hedged -> {ratio:.2f}x (p99 "
+        f"{unhedged_p99:.0f} vs {hedged_p99:.0f}ms, {hedges:g} hedges, "
+        f"{wins:g} wins), churn goodput {goodput:.3f}, remap join "
+        f"{join_frac:.3f} / leave {leave_frac:.3f}")
+    return {
+        "config": "serving_elastic",
+        "metric": "straggler TTFT p50, unhedged/hedged (3-replica ring)",
+        "value": round(ratio, 2),
+        "unhedged_p50_ms": round(unhedged_p50, 1),
+        "hedged_p50_ms": round(hedged_p50, 1),
+        "unhedged_p99_ms": round(unhedged_p99, 1),
+        "hedged_p99_ms": round(hedged_p99, 1),
+        "hedges": int(hedges),
+        "hedge_wins": int(wins),
+        "churn_goodput": round(goodput, 3),
+        "join_remap_frac": round(join_frac, 4),
+        "leave_remap_frac": round(leave_frac, 4),
+        "traffic": (f"{n_requests} tier-0 requests/leg on the straggler's "
+                    f"arc, ctx {ctx} +{n_tokens} tok, 1s scripted "
+                    f"window, 25ms watermark, 3 replicas"),
+    }
+
+
 # -- long context: 16k/32k chunked prefill + decode latency ----------------
 
 
@@ -2756,6 +2925,7 @@ def main() -> None:
         run(bench_serving_speculative)
         run(bench_serving_fleet)
         run(bench_serving_slo)
+        run(bench_serving_elastic)
         run(bench_decode, n_chips)
         run(bench_long_context)
     run(bench_mnist_sync, n_chips)

@@ -202,9 +202,15 @@ PAYLOADS["report"] = _payload(
 )
 
 #: serving replica stats poll (inference_server `_on_fleet_stats` ->
-#: fleet/registry.py).  All keys always present.
+#: fleet/registry.py).  Version 2 (round 19) adds the replica-authoritative
+#: warm set — ``warm_prefixes`` is a list of ``[chain_hash_hex, hit_count]``
+#: pairs (the hottest prefix pages by per-hash hit counters) and
+#: ``prefix_entries`` the total prefix-map population — so router shadow
+#: maps rebuild from replica truth instead of routing history alone, and
+#: the autoscaler can rank arcs by coldness.  Both are ``since=2``: a v1
+#: replica omits them and the registry reads them with ``.get``.
 PAYLOADS["fleet_stats"] = _payload(
-    "fleet_stats", 1,
+    "fleet_stats", 2,
     WireField("queue_depth", required=True),
     WireField("slots_active", required=True),
     WireField("max_slots", required=True),
@@ -217,6 +223,39 @@ PAYLOADS["fleet_stats"] = _payload(
     WireField("speculate_k", required=True),
     WireField("spec_accept_per_step", required=True),
     WireField("evicted_prefixes", required=True),
+    WireField("warm_prefixes", since=2),
+    WireField("prefix_entries", since=2),
+)
+
+#: one consistent-ring membership change (fleet/router.py `_sync_ring` ->
+#: bounded event log + run timeline).  ``epoch`` orders events without
+#: timestamps; ``members`` is the post-change membership; ``event`` names
+#: the transition (join/leave/drain/undrain/sync) and ``replica`` the
+#: replica that moved (absent for multi-member syncs).
+PAYLOADS["ring_membership"] = _payload(
+    "ring_membership", 1,
+    WireField("epoch", required=True),
+    WireField("vnodes", required=True),
+    WireField("members", required=True),
+    WireField("event"),
+    WireField("replica"),
+)
+
+#: best-effort cancel of the LOSING hedge attempt (fleet/router.py ->
+#: inference_server `_on_hedge_cancel`).  Correctness never depends on it —
+#: the replica-side dedup/in-flight gate already suppresses the duplicate —
+#: it just frees the loser's slot instead of computing an unread result.
+PAYLOADS["hedge_cancel"] = _payload(
+    "hedge_cancel", 1,
+    WireField("request_id", required=True),
+)
+
+#: hedge_cancel ack: how many in-flight admissions were flagged (0 when the
+#: request already finished or was never admitted on this replica).
+PAYLOADS["hedge_cancel_ack"] = _payload(
+    "hedge_cancel_ack", 1,
+    WireField("request_id", required=True),
+    WireField("cancelled", required=True),
 )
 
 #: generate request (inference_client -> inference_server)
@@ -309,7 +348,9 @@ PAYLOADS["hyperparam_override"] = _payload(
 #: one adaptive-controller decision (fleet/controller.py action log +
 #: doctor/bench assertions).  ``client`` is absent for fleet-wide actions
 #: (dispatch-window cap moves); ``observed`` echoes the breach detail that
-#: triggered the move.
+#: triggered the move.  The fleet autoscaler logs the same format with
+#: action scale_out/scale_in: ``replica`` names the member that moved,
+#: ``via`` how (undrain/add), ``replicas_live`` the post-action live count.
 PAYLOADS["controller_action"] = _payload(
     "controller_action", 1,
     WireField("action", required=True),
@@ -319,6 +360,9 @@ PAYLOADS["controller_action"] = _payload(
     WireField("old"),
     WireField("new"),
     WireField("observed"),
+    WireField("replica"),
+    WireField("via"),
+    WireField("replicas_live"),
 )
 
 #: dftp-flat per-leaf metadata — version 1 is dense-only; version 2 adds the

@@ -720,6 +720,233 @@ def main() -> int:
     ok &= _check("fleet failover drill (affinity routing + exactly-once)",
                  fleet_failover)
 
+    def elastic_fleet():
+        """Elastic-fleet drill (docs/ROBUSTNESS.md §11), three legs over
+        one 3-replica ring fleet. Clean leg: every request lands on its
+        chain hash's arc owner bit-identical to solo, the ring epoch is
+        stable, the tier-0 TTFT band stays silent, and the autoscaler
+        takes zero actions. Straggler leg: the arc owner's admission
+        window is stretched to 250 ms, so the 25 ms tier-0 watermark
+        fires ONE hedged duplicate at the second arc owner, which wins;
+        the loser retires UNADMITTED via hedge_cancel and the TTFT band
+        stays silent — hedging hid the straggler. Kill+rejoin leg: a
+        scripted reset kills the owner mid-decode; both in-flight
+        requests fail over bit-identical, the remap is bounded by
+        1/N + slack (measured over a fixed key set), a replayed
+        request_id is served from the dedup cache, and the probation
+        re-probe restores the EXACT pre-churn assignment."""
+        import math
+        import threading
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from distriflow_tpu.client import InferenceClient
+        from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+        from distriflow_tpu.fleet import (
+            FleetAutoscaler,
+            FleetRouter,
+            RouterClient,
+            page_hashes,
+        )
+        from distriflow_tpu.models.generate import generate
+        from distriflow_tpu.models.transformer import (
+            TransformerConfig,
+            transformer_lm,
+        )
+        from distriflow_tpu.obs import Telemetry
+        from distriflow_tpu.obs.health import HealthSentinel, default_bands
+        from distriflow_tpu.server import InferenceServer
+        from distriflow_tpu.utils.config import ServingConfig
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=48, dtype=jnp.float32, use_flash_attention=False)
+        params = transformer_lm(cfg, example_seq=16).init(
+            jax.random.PRNGKey(0))
+        ps = 16
+        tel = Telemetry()  # ONE registry: fleet-wide serving histograms
+
+        def replica():
+            return InferenceServer(
+                cfg, params, port=0, telemetry=tel,
+                serving=ServingConfig(
+                    batch_window_s=0.05, decode_chunk=4, kv_layout="paged",
+                    page_size=ps, max_slots=2, page_pool_pages=24)).setup()
+
+        def prompt(seed, plen=33):
+            rng = np.random.default_rng(seed)
+            return rng.integers(1, 64, size=(1, plen)).astype(np.int32)
+
+        def owned(ring, owner, plen=33, start=0):
+            for seed in range(start, start + 4096):
+                p = prompt(seed, plen)
+                if ring.primary(page_hashes(p[0], ps)[0]) == owner:
+                    return p
+            raise AssertionError(f"no prompt owned by {owner}")
+
+        def solo(p, n):
+            return np.asarray(generate(cfg, dict(params), p, n))
+
+        servers = {n: replica() for n in ("A", "B", "C")}
+        sa = servers["A"]
+        plan = FaultPlan(seed=13, schedule=[ScriptedFault(
+            event="generate", nth=3, action="reset")])
+        router = FleetRouter(port=0, policy="ring", stats_interval_s=0.0,
+                             redial=False, telemetry=tel)
+        # 256 vnodes: at N=3 the arc-share spread is ~1/(3*16) of the
+        # space, so the 1/N + 0.5/sqrt(V) remap bound holds with margin
+        router2 = FleetRouter(port=0, policy="ring", stats_interval_s=0.0,
+                              redial=True, ring_vnodes=256,
+                              telemetry=Telemetry())
+        try:
+            for name, srv in servers.items():
+                # the scripted reset rides ONLY router2's connection —
+                # the clean/straggler legs must never see it
+                router.add_replica(srv.address, name=name)
+            router.setup()
+
+            # -- clean leg: arc-owner routing, stable epoch, silent band,
+            #    idle autoscaler ------------------------------------------
+            epoch0 = router.ring.epoch
+            assert epoch0 == 3 and router.ring.members() == ["A", "B", "C"]
+            prompts = {n: owned(router.ring, n) for n in servers}
+            # warm every replica's compile at tier 1 (direct, unrouted)
+            # so the tier-0 band judges serving latency, not XLA
+            for name, srv in servers.items():
+                with InferenceClient(srv.address) as w:
+                    w.generate(prompts[name], 4, tier=1)
+            with RouterClient(router.address, tier=0) as c:
+                for name, p in prompts.items():
+                    for n_tok in (4, 4):
+                        out = c.generate(p, n_tok)
+                        assert c.last_replica == name, (
+                            f"{name}-owned prompt routed to "
+                            f"{c.last_replica}")
+                        assert np.array_equal(out, solo(p, n_tok))
+                router.refresh_stats()
+                assert router.ring.epoch == epoch0, "clean traffic moved the ring"
+                clean_p99 = float(tel.registry.find(
+                    "serving_ttft_ms", tier="0").summary()["p99"])
+                ceiling = clean_p99 + 200.0
+                watch = HealthSentinel(
+                    tel, bands=default_bands(ttft_p99_ms={0: ceiling}))
+                scaler = FleetAutoscaler(router, watch)
+                for _ in range(3):
+                    scaler.step()
+                assert scaler.actions() == [], (
+                    f"autoscaler acted on a clean fleet: {scaler.actions()}")
+                assert not watch.breached(), watch.breached()
+
+                # -- straggler leg: stretch A's admission window; the
+                #    tier-0 watermark hedges to the second arc owner ------
+                key = page_hashes(prompts["A"][0], ps)[0]
+                second = router.ring.lookup(key, 2)[1]
+                sa.serving.batch_window_s = 0.25  # read at use time
+                router.hedge_ms[0] = 25.0  # arm the tier-0 watermark
+                try:
+                    out = c.generate(prompts["A"], 4, request_id="hedge-1")
+                finally:
+                    router.hedge_ms.clear()
+                    sa.serving.batch_window_s = 0.05
+                assert np.array_equal(out, solo(prompts["A"], 4))
+                assert c.last_replica == second, (
+                    f"hedge won on {c.last_replica}, expected {second}")
+                hedges = tel.counter_value("router_hedges_total")
+                wins = tel.counter_value("router_hedge_wins_total")
+                cancelled = tel.counter_value("serving_hedge_cancelled_total")
+                assert hedges == 1.0 and wins == 1.0, (hedges, wins)
+                assert cancelled == hedges, (
+                    f"{cancelled:g} cancels for {hedges:g} hedges")
+                scaler.step()
+                assert scaler.actions() == [] and not watch.breached(), (
+                    "hedged straggler leaked into the TTFT band")
+
+            # -- kill+rejoin leg: fresh router (redial on), same fleet ---
+            for name, srv in servers.items():
+                router2.add_replica(
+                    srv.address, name=name,
+                    fault_plan=plan if name == "A" else None)
+            router2.setup()
+            keys = [f"warmset-{i}".encode() for i in range(600)]
+            base = router2.ring.assignment(keys)
+            # ownership is per-ring: 256 vnodes may place router1's
+            # A-owned prompt elsewhere, so re-search on router2's ring
+            p_a = owned(router2.ring, "A")
+            p_long = owned(router2.ring, "A", plen=17)
+            with RouterClient(router2.address) as c:
+                out = c.generate(p_a, 3)  # 1st on A
+                assert c.last_replica == "A"
+                assert np.array_equal(out, solo(p_a, 3))
+                router2.refresh_stats()  # A serves stats: next dial REVIVES
+                results = {}
+
+                def long_decode():
+                    with RouterClient(router2.address) as cl:
+                        results["out"] = cl.generate(p_long, 12)
+
+                t = threading.Thread(target=long_decode)
+                t.start()
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:  # A mid-decode
+                    if any(r is not None for r in sa._slot_req):
+                        break
+                    time.sleep(0.002)
+                out = c.generate(p_a, 5)  # 3rd on A: the scripted kill
+                t.join(timeout=60.0)
+                assert not t.is_alive(), "in-flight request lost"
+                assert c.last_replica != "A"
+                assert np.array_equal(out, solo(p_a, 5))
+                assert np.array_equal(results["out"], solo(p_long, 12))
+                # remap bound: only A's arcs moved, at most 1/N + slack
+                assert router2.ring.members() == ["B", "C"]
+                after = router2.ring.assignment(keys)
+                moved = [k for k in keys if after[k] != base[k]]
+                frac = len(moved) / float(len(keys))
+                bound = 1.0 / 3.0 + 0.5 / math.sqrt(router2.ring.vnodes)
+                assert frac <= bound, f"remap {frac:.3f} > {bound:.3f}"
+                assert all(base[k] == "A" for k in moved), (
+                    "a surviving replica's keys moved")
+                # exactly-once: replay a completed id on the survivor
+                survivor = servers[c.last_replica]
+                with InferenceClient(survivor.address) as direct:
+                    first = direct.generate(p_a, 5,
+                                            request_id="elastic-replay")
+                    admitted = survivor.batched_requests
+                    again = direct.generate(p_a, 5,
+                                            request_id="elastic-replay")
+                    assert np.array_equal(first, again)
+                    assert survivor.batched_requests == admitted, (
+                        "dedup double-applied")
+                # rejoin: the probation re-probe restores the EXACT
+                # pre-churn placement
+                router2.refresh_stats()
+                assert router2.ring.members() == ["A", "B", "C"]
+                assert router2.registry.get("A").revivals == 1
+                assert router2._tel.counter_value(
+                    "router_replica_revivals_total") == 1.0
+                assert router2.ring.assignment(keys) == base, (
+                    "rejoin did not restore the pre-churn assignment")
+                out = c.generate(p_a, 4)  # 1st on the NEW connection
+                assert c.last_replica == "A"
+                assert np.array_equal(out, solo(p_a, 4))
+        finally:
+            router.stop()
+            router2.stop()
+            for srv in servers.values():
+                srv.stop()
+        return (f"clean: 6 requests on their arc owners bit-identical, "
+                f"epoch stable at {epoch0}, TTFT band silent (p99 "
+                f"{clean_p99:.0f} ms), autoscaler idle; straggler: 250 ms "
+                f"window on A -> 1 hedge, won on {second}, loser cancelled "
+                f"unadmitted, band still silent; kill+rejoin: remap "
+                f"{frac:.0%} <= {bound:.0%} (A's arcs only), replay served "
+                "from dedup cache, revival restored the exact assignment")
+
+    ok &= _check("elastic fleet drill (ring placement + tail hedging + "
+                 "kill/rejoin remap)", elastic_fleet)
+
     def kill_and_resume():
         """Hard-stop an async training run at a seeded-random mid-run point,
         restart a FRESH server (new object, fresh dataset instance — the

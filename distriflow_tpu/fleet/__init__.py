@@ -10,12 +10,19 @@ simulated training clients through churn + chaos and audits exactly-once
 accounting and convergence at quiescence; ``AdaptiveController`` closes
 the telemetry loop by pushing per-client hyperparam overrides and a
 fleet-wide dispatch-window cap on SLO breaches.
+
+Round 19 (docs/ROBUSTNESS.md §11): the elastic serving fleet —
+``HashRing`` consistent prefix placement that survives membership churn,
+``FleetAutoscaler`` closing the serving SLO loop over membership itself,
+probation revival for dead replicas, and tier-scoped tail hedging with
+exactly-once suppression of the losing attempt.
 """
 
 from distriflow_tpu.fleet.client import RouterClient
-from distriflow_tpu.fleet.controller import AdaptiveController
+from distriflow_tpu.fleet.controller import AdaptiveController, FleetAutoscaler
 from distriflow_tpu.fleet.prefix_hash import page_hashes, shareable_pages
 from distriflow_tpu.fleet.registry import ReplicaRegistry, ReplicaState
+from distriflow_tpu.fleet.ring import HashRing
 from distriflow_tpu.fleet.router import FleetRouter
 from distriflow_tpu.fleet.soak import (
     SoakConfig,
@@ -27,7 +34,9 @@ from distriflow_tpu.fleet.soak import (
 
 __all__ = [
     "AdaptiveController",
+    "FleetAutoscaler",
     "FleetRouter",
+    "HashRing",
     "RouterClient",
     "ReplicaRegistry",
     "ReplicaState",

@@ -17,6 +17,7 @@ post-evict route doesn't chase warmth that is no longer there.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import OrderedDict
@@ -25,6 +26,14 @@ from typing import Any, Dict, List, Optional
 #: per-replica shadow-map entry cap — bounds router memory regardless of
 #: traffic mix; LRU within one replica's map (touch on hit, evict cold)
 SHADOW_CAP = 4096
+
+#: probation re-probe backoff (round 19): the FIRST re-probe after a
+#: death is immediate (a torn connection to a healthy server heals on
+#: the next stats poll, exactly the pre-probation behaviour), then each
+#: failed probe doubles the jittered wait so a truly dead replica costs
+#: one dial attempt per backoff window instead of one per poll
+PROBE_BASE_S = 0.5
+PROBE_MAX_S = 10.0
 
 
 class ReplicaState:
@@ -44,6 +53,11 @@ class ReplicaState:
         self.outstanding = 0             # requests forwarded, not yet acked
         self.routed = 0                  # requests ever routed here
         self.rr_seq = 0                  # insertion order, the final tie-break
+        # probation (round 19): a dead replica is re-probed on a jittered
+        # exponential backoff instead of every poll — and instead of never
+        self.probe_at = 0.0              # monotonic time the next probe may run
+        self.probe_backoff_s = 0.0       # current backoff rung (0 = first probe)
+        self.revivals = 0                # dead -> live transitions survived
 
     # -- read helpers (racy reads are fine: stats are advisory) ------------
 
@@ -99,6 +113,12 @@ class ReplicaRegistry:
         with self._lock:
             return self._replicas.get(name)
 
+    def remove(self, name: str) -> Optional[ReplicaState]:
+        """Forget a replica entirely (autoscaler decommission after its
+        drain completed). Returns the removed row, caller closes conn."""
+        with self._lock:
+            return self._replicas.pop(name, None)
+
     def all(self) -> List[ReplicaState]:
         with self._lock:
             return list(self._replicas.values())
@@ -115,20 +135,56 @@ class ReplicaRegistry:
 
     # -- liveness / stats --------------------------------------------------
 
-    def mark_live(self, name: str) -> None:
+    def mark_live(self, name: str) -> bool:
+        """Mark alive; resets the probation backoff. Returns True when
+        this was a REVIVAL (the replica was dead) — the router counts
+        those on ``router_replica_revivals_total``."""
         with self._lock:
             r = self._replicas.get(name)
-            if r is not None:
-                r.alive = True
+            if r is None:
+                return False
+            # first-ever dial is a JOIN, not a revival: a replica only
+            # "revives" when it had served (stats seen) before it died
+            revived = not r.alive and r.stats_t > 0.0
+            r.alive = True
+            r.probe_backoff_s = 0.0
+            r.probe_at = 0.0
+            if revived:
+                r.revivals += 1
+            return revived
 
     def mark_dead(self, name: str) -> None:
         """A dead replica's warmth is unknowable — drop the shadow map so
-        a later revival starts cold instead of chasing stale hints."""
+        a later revival starts cold instead of chasing stale hints. The
+        replica enters PROBATION, not a terminal state: the first
+        re-probe is due immediately (``probe_at`` stays in the past) and
+        each failed probe backs off via :meth:`note_probe_failure`."""
         with self._lock:
             r = self._replicas.get(name)
             if r is not None:
                 r.alive = False
                 r.shadow.clear()
+
+    def probe_due(self, name: str) -> bool:
+        """May the router re-dial this dead replica yet? (Jittered
+        backoff gate — a live replica is never 'due'.)"""
+        with self._lock:
+            r = self._replicas.get(name)
+            return (r is not None and not r.alive
+                    and time.monotonic() >= r.probe_at)
+
+    def note_probe_failure(self, name: str) -> None:
+        """A probation re-dial failed: double the backoff (capped) and
+        schedule the next probe with +/-50% jitter so a fleet of routers
+        probing one dead replica never thundering-herds its address."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return
+            r.probe_backoff_s = min(
+                PROBE_MAX_S, (r.probe_backoff_s * 2.0) or PROBE_BASE_S)
+            r.probe_at = (time.monotonic()
+                          + r.probe_backoff_s * random.uniform(0.5, 1.5))
 
     def mark_draining(self, name: str, draining: bool = True) -> None:
         with self._lock:
@@ -136,10 +192,15 @@ class ReplicaRegistry:
             if r is not None:
                 r.draining = draining
 
+    # dfcheck: payload stats=fleet_stats
     def update_stats(self, name: str, stats: Dict[str, Any]) -> None:
         """Fold one ``fleet_stats`` ack in: refresh the advisory numbers,
-        the draining flag, and FORGET any prefix hashes the replica says
-        it evicted since the last poll (the satellite-2 contract)."""
+        the draining flag, FORGET any prefix hashes the replica says it
+        evicted since the last poll, and LEARN the replica-authoritative
+        warm set from the v2 ``warm_prefixes`` hit counters (round 19:
+        shadow maps rebuild from replica truth, not routing history
+        alone — a restarted router, or a revived replica whose shadow
+        was dropped at death, recovers warmth on the next poll)."""
         with self._lock:
             r = self._replicas.get(name)
             if r is None:
@@ -153,6 +214,20 @@ class ReplicaRegistry:
                     r.shadow.pop(bytes.fromhex(hexdigest), None)
                 except (ValueError, TypeError):
                     continue
+            # v2 field — absent from pre-round-19 replicas, so .get only.
+            # warmth() judges membership (the consecutive-run walk), so
+            # folding an entry whose chain depth we never routed is safe:
+            # the value stores the replica-reported hit count, advisory.
+            for entry in stats.get("warm_prefixes") or ():
+                try:
+                    h = bytes.fromhex(entry[0])
+                    hits = int(entry[1])
+                except (ValueError, TypeError, IndexError):
+                    continue
+                r.shadow[h] = hits
+                r.shadow.move_to_end(h)
+            while len(r.shadow) > self.shadow_cap:
+                r.shadow.popitem(last=False)
 
     # -- shadow prefix map -------------------------------------------------
 
@@ -212,6 +287,7 @@ class ReplicaRegistry:
                     "address": r.address,
                     "alive": r.alive,
                     "draining": r.draining,
+                    "revivals": r.revivals,
                     "routed": r.routed,
                     "outstanding": r.outstanding,
                     "shadow_entries": len(r.shadow),

@@ -34,10 +34,36 @@ Three routing planes compose per request:
   one answer at the front door, and greedy/seeded decode makes the
   replayed result bit-identical.
 
+Round 19 adds the **elastic** planes (docs/ROBUSTNESS.md §11):
+
+* ``policy="ring"``: prefix -> replica placement through a consistent
+  hash ring (``fleet/ring.py``) keyed on the prompt's FIRST chain hash
+  — a pure function of live membership, so replicas join/leave under
+  traffic with only their ring arcs remapping (~1/N of the warm set)
+  while shadow-map warmth stays the metrics/diagnostics plane. The
+  ring tracks ``registry.live()`` through every liveness transition
+  (``_sync_ring``); membership changes land on the run timeline and in
+  a bounded ``ring_membership`` event log.
+* **probation revival**: a dead replica is re-probed on a jittered
+  exponential backoff (``fleet/registry.py``) instead of on every poll
+  — and instead of never, which is what ``redial=False`` used to mean
+  for a replica lost to a forward failure. A successful re-dial of a
+  replica that had served before counts on
+  ``router_replica_revivals_total`` and rejoins the ring.
+* **tail hedging** (``hedge_ms={tier: watermark_ms}``): when the
+  primary attempt has not acked inside the tier's watermark, the SAME
+  ``request_id`` races against the second-warmest ring replica; the
+  first usable ack wins, the loser is cancelled server-side
+  (``hedge_cancel`` -> the replica-side dedup/in-flight gate and the
+  engine's cancel path suppress the duplicate) and both attempts
+  assemble into ONE trace round via the request-id merge.
+
 Metrics (docs/OBSERVABILITY.md §1): ``router_requests_total{tier}``,
 ``router_affinity_hits_total``, ``router_shed_total{tier}``,
 ``router_failovers_total``, ``router_replicas_live``,
-``router_goodput_total{tier}``, ``router_hedge_candidates_total``.
+``router_goodput_total{tier}``, ``router_hedge_candidates_total``,
+``router_hedges_total``, ``router_hedge_wins_total``,
+``router_replica_revivals_total``.
 Tracing (docs/OBSERVABILITY.md §11): when the inbound payload carries a
 ``trace_id`` header the router emits one ``route`` span per forwarding
 attempt (replica, policy, affinity depth, shed/failover verdict), so
@@ -47,10 +73,12 @@ router's run dir alone.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -63,6 +91,7 @@ from distriflow_tpu.comm.transport import (
 )
 from distriflow_tpu.fleet.prefix_hash import page_hashes
 from distriflow_tpu.fleet.registry import ReplicaRegistry, ReplicaState
+from distriflow_tpu.fleet.ring import DEFAULT_VNODES, HashRing
 from distriflow_tpu.obs import get_telemetry
 from distriflow_tpu.utils.logging import VerboseLogger
 from distriflow_tpu.utils.serialization import deserialize_array, unpack_bytes
@@ -98,10 +127,12 @@ class FleetRouter:
         stats_interval_s: float = 0.5,
         redial: bool = True,
         request_timeout: float = ROUTE_TIMEOUT_S,
+        ring_vnodes: int = DEFAULT_VNODES,
+        hedge_ms: Optional[Dict[int, float]] = None,
         telemetry: Any = None,
         verbose: Optional[bool] = None,
     ):
-        if policy not in ("affinity", "round_robin", "least_loaded"):
+        if policy not in ("affinity", "round_robin", "least_loaded", "ring"):
             raise ValueError(f"unknown routing policy {policy!r}")
         self.policy = policy
         self.shed_depth = dict(DEFAULT_SHED_DEPTH if shed_depth is None
@@ -111,8 +142,21 @@ class FleetRouter:
         self.stats_interval_s = float(stats_interval_s)
         self.redial = bool(redial)
         self.request_timeout = float(request_timeout)
+        # tail hedging watermark per tier, in ms; None/missing tier = off.
+        # Default OFF: hedging doubles worst-case per-request replica load,
+        # so it is an explicit opt-in for the tiers whose tail matters.
+        self.hedge_ms = dict(hedge_ms) if hedge_ms else {}
         self.logger = VerboseLogger("FleetRouter", verbose)
         self.registry = ReplicaRegistry()
+        # the consistent ring tracks registry.live() through _sync_ring on
+        # every liveness/draining transition — maintained under ALL
+        # policies (the autoscaler reads arc shares even when routing is
+        # affinity-based), consulted by _pick only under policy="ring"
+        self.ring = HashRing(ring_vnodes)
+        self._ring_lock = threading.Lock()
+        # bounded ring_membership event log (comm/schema.py payload),
+        # newest last — the doctor drill and snapshot read it
+        self._membership_log: Deque[Dict[str, Any]] = deque(maxlen=256)  # guarded-by: _ring_lock
         self.transport = ServerTransport(host, port)
         self.transport.on("model_info", self._on_info)
         self.transport.on("generate", self._on_generate)
@@ -157,6 +201,17 @@ class FleetRouter:
             "router_hedge_candidates_total",
             help="answered requests that needed >=1 failover (a hedge "
                  "fired at submit time would have beaten the retry)")
+        self._m_hedges = tel.counter(
+            "router_hedges_total",
+            help="hedged duplicate attempts actually fired (same "
+                 "request_id raced against a second replica)")
+        self._m_hedge_wins = tel.counter(
+            "router_hedge_wins_total",
+            help="hedged attempts whose duplicate acked first (the "
+                 "primary lost the race and was cancelled)")
+        self._m_revivals = tel.counter(
+            "router_replica_revivals_total",
+            help="dead replicas revived by a probation re-probe")
         # the router is a fleet citizen too: its own row (plus one row
         # per replica from the registry view routing actually used)
         # merges into ``tel.snapshot()["fleet"]`` so ``dump --fleet`` on
@@ -175,7 +230,24 @@ class FleetRouter:
         self._fault_plans[name] = fault_plan
         self._dial(state)
         self._note_live()
+        self._sync_ring(event="join", replica=name)
         return name
+
+    def remove_replica(self, name: str) -> bool:
+        """Forget a replica entirely (autoscaler decommission after its
+        drain completed); its ring arcs remap to the survivors."""
+        state = self.registry.remove(name)
+        if state is None:
+            return False
+        self._fault_plans.pop(name, None)
+        if state.conn is not None:
+            try:
+                state.conn.close()
+            except Exception:
+                pass
+        self._note_live()
+        self._sync_ring(event="leave", replica=name)
+        return True
 
     def _dial(self, state: ReplicaState) -> bool:
         conn = ClientTransport(state.address,
@@ -186,6 +258,7 @@ class FleetRouter:
         except Exception as e:
             self.logger.log(f"dial {state.name} ({state.address}): {e!r}")
             self.registry.mark_dead(state.name)
+            self.registry.note_probe_failure(state.name)
             return False
         old, state.conn = state.conn, conn
         if old is not None:
@@ -193,7 +266,9 @@ class FleetRouter:
                 old.close()
             except Exception:
                 pass
-        self.registry.mark_live(state.name)
+        if self.registry.mark_live(state.name):
+            self._m_revivals.inc()
+            self.logger.log(f"replica {state.name} revived from probation")
         return True
 
     def setup(self) -> "FleetRouter":
@@ -234,12 +309,16 @@ class FleetRouter:
             self.refresh_stats()
 
     def refresh_stats(self) -> None:
-        """Poll every replica's ``fleet_stats`` once; a dead replica is
-        re-dialed first when ``redial`` is on (self-healing after a torn
-        connection to a still-running server)."""
+        """Poll every replica's ``fleet_stats`` once. A dead replica is
+        re-probed first when ``redial`` is on AND its probation backoff
+        has elapsed (``registry.probe_due`` — the first probe after a
+        death is immediate, so a torn connection to a healthy server
+        still heals on the next poll; consecutive failures back off)."""
         for state in self.registry.all():
             if not state.alive:
-                if not (self.redial and self._dial(state)):
+                if not (self.redial
+                        and self.registry.probe_due(state.name)
+                        and self._dial(state)):
                     continue
             conn = state.conn
             if conn is None:
@@ -254,10 +333,12 @@ class FleetRouter:
             if isinstance(stats, dict):
                 self.registry.update_stats(state.name, stats)
         self._note_live()
+        self._sync_ring()
 
     def _on_replica_lost(self, name: str) -> None:
         self.registry.mark_dead(name)
         self._note_live()
+        self._sync_ring(event="leave", replica=name)
         self.logger.log(f"replica {name} lost")
 
     def _note_live(self) -> None:
@@ -274,9 +355,61 @@ class FleetRouter:
                                      timeout=STATS_TIMEOUT_S)
         except (ConnectionLost, AckTimeout):
             self.registry.mark_dead(name)
+            self._note_live()
+            self._sync_ring(event="leave", replica=name)
             return False
         self.registry.mark_draining(name, True)
+        self._sync_ring(event="drain", replica=name)
         return bool(ack)
+
+    def undrain_replica(self, name: str) -> bool:
+        """Lift a drain: the replica admits new work again and rejoins
+        the ring (the autoscaler's scale-OUT fast path — a drained
+        standby is warm and already dialed)."""
+        state = self.registry.get(name)
+        if state is None or state.conn is None:
+            return False
+        try:
+            ack = state.conn.request("drain", {"enable": False},
+                                     timeout=STATS_TIMEOUT_S)
+        except (ConnectionLost, AckTimeout):
+            self.registry.mark_dead(name)
+            self._note_live()
+            self._sync_ring(event="leave", replica=name)
+            return False
+        self.registry.mark_draining(name, False)
+        self._sync_ring(event="undrain", replica=name)
+        return bool(ack)
+
+    # -- consistent ring (round 19) ----------------------------------------
+
+    def _sync_ring(self, event: Optional[str] = None,
+                   replica: Optional[str] = None) -> bool:
+        """Reconcile ring membership with ``registry.live()`` (alive and
+        not draining). Called on every liveness/draining transition; a
+        change appends one ``ring_membership`` event (bounded log + run
+        timeline) stamped with the post-change epoch."""
+        names = [r.name for r in self.registry.live()]
+        with self._ring_lock:
+            if not self.ring.sync(names):
+                return False
+            evt = {
+                "epoch": self.ring.epoch,
+                "vnodes": self.ring.vnodes,
+                "members": self.ring.members(),
+                "event": event or "sync",
+                "replica": replica,
+            }  # dfcheck: payload ring_membership
+            self._membership_log.append(evt)
+        self._tel.timeline.event("ring_membership", **evt)
+        self.logger.log(f"ring epoch {evt['epoch']}: {evt['event']} "
+                        f"{replica or ''} -> {evt['members']}")
+        return True
+
+    def ring_membership(self) -> List[Dict[str, Any]]:
+        """The bounded ``ring_membership`` event log, oldest first."""
+        with self._ring_lock:
+            return list(self._membership_log)
 
     # -- routing -----------------------------------------------------------
 
@@ -292,8 +425,11 @@ class FleetRouter:
         if not cands:
             return None
         # speculative preference: long decodes narrow to spec replicas
-        # whose live accept rate clears the floor (unknown = assume ok)
-        if n_tokens >= self.long_decode_tokens:
+        # whose live accept rate clears the floor (unknown = assume ok).
+        # Skipped under ring placement — ring owners are a pure function
+        # of membership, and narrowing would reintroduce load-coupled
+        # placement exactly where churn-stability is the point.
+        if self.policy != "ring" and n_tokens >= self.long_decode_tokens:
             spec = [r for r in cands if r.speculate_k > 0 and (
                 r.spec_accept_per_step is None
                 or r.spec_accept_per_step
@@ -303,6 +439,19 @@ class FleetRouter:
         depths = {r.name: (self.registry.warmth(r.name, hashes)
                            if r.prefix_capable else 0)
                   for r in cands}
+        if self.policy == "ring" and hashes:
+            # owner order for the prompt's FIRST chain hash; the first
+            # candidate in that order wins, so an excluded/dead owner
+            # fails over to the NEXT arc owner — still deterministic in
+            # (membership, key), which is what bounds remap under churn
+            with self._ring_lock:
+                order = self.ring.lookup(hashes[0], n=len(self.ring))
+            by_name = {r.name: r for r in cands}
+            for nm in order:
+                r = by_name.get(nm)
+                if r is not None:
+                    return r, depths[r.name]
+            # ring empty or owners all excluded: fall through to load
         if self.policy == "round_robin":
             with self._rr_lock:
                 chosen = cands[self._rr_next % len(cands)]
@@ -334,7 +483,14 @@ class FleetRouter:
         return ack
 
     def _on_snapshot(self, client_id: str, payload: Any) -> Dict[str, Any]:
-        return {"policy": self.policy, "replicas": self.registry.snapshot()}
+        with self._ring_lock:
+            ring = {"epoch": self.ring.epoch,
+                    "vnodes": self.ring.vnodes,
+                    "members": self.ring.members(),
+                    "arc_share": {n: round(self.ring.arc_share(n), 4)
+                                  for n in self.ring.members()}}
+        return {"policy": self.policy, "ring": ring,
+                "replicas": self.registry.snapshot()}
 
     def _on_forward_beam(self, client_id: str, payload: Any) -> Dict[str, Any]:
         ack, _, _, _ = self._submit("beam", payload, [], 0, set())
@@ -360,8 +516,13 @@ class FleetRouter:
             return {"shed": True, "tier": tier, "queue_depth": depth}
         hashes = self._prompt_hashes(payload)
         n_tokens = int(payload.get("n_tokens", 0))
-        ack, state, aff_depth, failovers = self._submit(
-            "generate", payload, hashes, n_tokens, set())
+        hedge_after = self.hedge_ms.get(tier)
+        if hedge_after is not None and self.registry.live_count() >= 2:
+            ack, state, aff_depth, failovers = self._submit_hedged(
+                payload, hashes, n_tokens, float(hedge_after))
+        else:
+            ack, state, aff_depth, failovers = self._submit(
+                "generate", payload, hashes, n_tokens, set())
         if state is None:
             return ack  # whole-fleet drain refusal: not an accepted request
         self._m_requests[tier].inc()
@@ -478,6 +639,134 @@ class FleetRouter:
                              **extra)
             return ack, state, depth, failovers
 
+    # -- tail hedging (round 19) -------------------------------------------
+
+    @staticmethod
+    def _usable(ack: Any) -> bool:
+        """An ack that answers the request: a dict that is neither a
+        transport exception nor a drain refusal (handler errors arrive
+        as None)."""
+        return isinstance(ack, dict) and ack.get("refused") != "draining"
+
+    def _submit_hedged(
+        self, payload: Dict[str, Any], hashes: List[bytes], n_tokens: int,
+        hedge_after_ms: float,
+    ) -> Tuple[Dict[str, Any], Optional[ReplicaState], int, int]:
+        """Hedged generate (Dean & Barroso, "The Tail at Scale"): submit
+        to the primary placement; when no ack lands inside the tier's
+        watermark, race the SAME ``request_id`` against the next-ranked
+        replica (under ring placement, the second arc owner — the
+        "second-warmest" in consistent-hash order). First USABLE ack
+        wins; the loser gets a best-effort server-side ``hedge_cancel``
+        and its admission is suppressed by the replica's dedup/in-flight
+        gate, so at most one replica ever computes the result to
+        completion. Both attempts share the request_id, so the trace
+        assembler merges them into ONE round (the PR 15 idempotency-key
+        merge) — the chaos-churn invariant the elastic tests pin."""
+        pick = self._pick(hashes, n_tokens, exclude=set())
+        if pick is None:
+            # no live replica: the serial path owns the drain/raise logic
+            return self._submit("generate", payload, hashes, n_tokens, set())
+        primary, p_depth = pick
+        results: "queue.Queue[Tuple[ReplicaState, int, Any, float, float]]" \
+            = queue.Queue()
+
+        def attempt(state: ReplicaState, depth: int) -> None:
+            self.registry.note_submit(state.name)
+            a_start, a_mono = time.time(), time.monotonic()
+            try:
+                ack: Any = state.conn.request(
+                    "generate", payload, timeout=self.request_timeout)
+            except (ConnectionLost, AckTimeout) as e:
+                ack = e
+            finally:
+                self.registry.note_done(state.name)
+            results.put((state, depth, ack, a_start, a_mono))
+
+        threading.Thread(target=attempt, args=(primary, p_depth),
+                         daemon=True, name="hedge-primary").start()
+        racing: List[ReplicaState] = [primary]
+        hedged = False
+        try:
+            first = results.get(timeout=hedge_after_ms / 1000.0)
+        except queue.Empty:
+            first = None
+        if first is None:
+            hpick = self._pick(hashes, n_tokens, exclude={primary.name})
+            if hpick is not None:
+                hstate, h_depth = hpick
+                hedged = True
+                self._m_hedges.inc()
+                self._route_span(payload, "hedge", replica=hstate.name,
+                                 depth=h_depth)
+                threading.Thread(target=attempt, args=(hstate, h_depth),
+                                 daemon=True, name="hedge-duplicate").start()
+                racing.append(hstate)
+            first = results.get()
+        # first usable ack wins; wait on the straggler only when the
+        # first arrival is itself unusable (its replica died/refused)
+        arrivals = [first]
+        if len(racing) == 2 and not self._usable(first[2]):
+            arrivals.append(results.get())
+        winner = next((a for a in arrivals if self._usable(a[2])), None)
+        failovers = 0
+        if winner is None:
+            # every racer failed: book-keep each failure exactly as the
+            # serial loop would, then fall back to it with both tried
+            tried: set = set()
+            for state, depth, ack, a_start, a_mono in arrivals:
+                tried.add(state.name)
+                failovers += 1
+                self._m_failovers.inc()
+                if isinstance(ack, Exception):
+                    self.logger.log(
+                        f"generate on {state.name} failed: {ack!r}")
+                    self.registry.mark_dead(state.name)
+                    self._note_live()
+                    self._sync_ring(event="leave", replica=state.name)
+                    verdict = f"failover:{type(ack).__name__}"
+                elif isinstance(ack, dict):
+                    self.registry.mark_draining(state.name, True)
+                    self._sync_ring(event="drain", replica=state.name)
+                    verdict = "failover:draining"
+                else:
+                    verdict = "failover:handler_error"
+                self._route_span(payload, verdict, replica=state.name,
+                                 depth=depth, start=a_start, mono=a_mono)
+            ack2, st2, d2, f2 = self._submit(
+                "generate", payload, hashes, n_tokens, tried)
+            return ack2, st2, d2, failovers + f2
+        state, depth, ack, a_start, a_mono = winner
+        if hedged:
+            if state is not primary:
+                self._m_hedge_wins.inc()
+            loser = racing[1] if state is primary else racing[0]
+            self._cancel_attempt(loser, payload)
+        extra: Dict[str, Any] = {"failovers": failovers, "hedged": hedged}
+        meta = ack.get("serving")
+        if isinstance(meta, dict):
+            for k in ("ttft_ms", "tpot_ms"):
+                if meta.get(k) is not None:
+                    extra[k] = meta[k]
+        self._route_span(payload, "forwarded", replica=state.name,
+                         depth=depth, start=a_start, mono=a_mono, **extra)
+        return ack, state, depth, failovers
+
+    def _cancel_attempt(self, state: ReplicaState, payload: Dict[str, Any]) -> None:
+        """Best-effort server-side cancel of the LOSING hedge attempt:
+        the replica flags the request_id cancelled, so it is skipped at
+        admission or retired at the next decode-chunk boundary instead
+        of computing a result nobody will read. Purely an efficiency
+        move — correctness is already held by the dedup gate."""
+        conn = state.conn
+        if conn is None:
+            return
+        cancel = {"request_id": payload.get("request_id")}  # dfcheck: payload hedge_cancel
+        try:
+            conn.request("hedge_cancel", cancel, timeout=STATS_TIMEOUT_S)
+        except (ConnectionLost, AckTimeout):
+            pass  # the loser may be the replica that just died
+
     def _route_span(self, payload: Dict[str, Any], verdict: str,
                     replica: Optional[str] = None, depth: int = 0,
                     start: Optional[float] = None,
@@ -513,6 +802,10 @@ class FleetRouter:
                 "goodput": int(sum(c.value
                                    for c in self._m_goodput.values())),
                 "affinity_hits": int(self._m_affinity.value),
+                "hedges": int(self._m_hedges.value),
+                "hedge_wins": int(self._m_hedge_wins.value),
+                "revivals": int(self._m_revivals.value),
+                "ring_epoch": self.ring.epoch,
             }
         }
         for name, snap in self.registry.snapshot().items():

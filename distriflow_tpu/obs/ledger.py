@@ -132,6 +132,29 @@ BANDS: Dict[str, Dict[str, Dict[str, float]]] = {
         "shed": {"warn_pct": 1e9, "regress_pct": 1e9},
         "failovers": {"warn_pct": 1e9, "regress_pct": 1e9},
     },
+    "serving_elastic": {
+        # round-19 elastic-fleet row (docs/ROBUSTNESS.md §11): "value" is
+        # the unhedged/hedged straggler p50 ratio. Every request is
+        # identically straggled by a scripted 1 s admission window, so
+        # the medians are window-dominated and steady; the ratio and the
+        # p50s get serving-latency slack. The p99s are single-worst-wall
+        # loopback times on shared runners — guarded very loosely. Hedge
+        # counters and churn goodput are structural (every straggler
+        # request hedges and the second owner wins; drain drops nothing)
+        # and the join/leave remap fractions are sha1-deterministic over
+        # a fixed key set — ANY movement there is a real ring change, so
+        # they get wire-size-tight bands.
+        "value": {"warn_pct": 25.0, "regress_pct": 60.0},
+        "unhedged_p50_ms": {"warn_pct": 30.0, "regress_pct": 80.0},
+        "hedged_p50_ms": {"warn_pct": 30.0, "regress_pct": 80.0},
+        "unhedged_p99_ms": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "hedged_p99_ms": {"warn_pct": 50.0, "regress_pct": 150.0},
+        "hedges": {"warn_pct": 0.5, "regress_pct": 2.0},
+        "hedge_wins": {"warn_pct": 0.5, "regress_pct": 2.0},
+        "churn_goodput": {"warn_pct": 0.5, "regress_pct": 2.0},
+        "join_remap_frac": {"warn_pct": 0.5, "regress_pct": 2.0},
+        "leave_remap_frac": {"warn_pct": 0.5, "regress_pct": 2.0},
+    },
     "fleet_soak": {
         # churn+chaos soak row (docs/ROBUSTNESS.md §10): the run itself
         # enforces the exactness invariants (it raises on violation), so

@@ -259,6 +259,7 @@ class InferenceServer:
         self.transport.on("score", self._on_score)
         self.transport.on("fleet_stats", self._on_fleet_stats)
         self.transport.on("drain", self._on_drain)
+        self.transport.on("hedge_cancel", self._on_hedge_cancel)
         self.transport.on_disconnect = self._on_client_disconnect
         # fleet-router plane (round 13; docs/PERFORMANCE.md §7h):
         # draining refuses NEW generates with a structured ack (in-flight
@@ -277,6 +278,12 @@ class InferenceServer:
         # (one router) — appends on the scheduler thread, drains on a
         # handler thread, both ends atomic on a deque.
         self._evicted_prefixes: Deque[bytes] = deque(maxlen=512)
+        # per-prefix-page hit counters (round 19): chain hash -> times an
+        # admission reused it. Single-writer (scheduler thread, in
+        # _reserve); pruned when the entry leaves _prefix_map. The top
+        # entries ship as fleet_stats v2 ``warm_prefixes`` so router
+        # shadow maps rebuild from replica truth, not routing history.
+        self._prefix_hit_counts: Dict[bytes, int] = {}
         # per-server plain stat fields for the stats ack: the obs
         # registry may be process-shared across in-process replicas
         # (tests/bench), so fleet routing signals must not read it
@@ -412,6 +419,13 @@ class InferenceServer:
         self._m_prefix_hits = tel.counter(
             "serving_prefix_hits_total",
             help="admissions that reused a cached prefix")
+        self._m_dedup_hits = tel.counter(
+            "serving_dedup_hits_total",
+            help="duplicate request_ids suppressed by the dedup gate "
+                 "(cached-ack returns + in-flight parks)")
+        self._m_hedge_cancelled = tel.counter(
+            "serving_hedge_cancelled_total",
+            help="in-flight requests flagged cancelled by hedge_cancel")
         self._m_prefix_tokens = tel.counter(
             "serving_prefix_tokens_saved_total",
             help="prompt tokens skipped via prefix-cache reuse")
@@ -560,6 +574,17 @@ class InferenceServer:
                 evicted.append(self._evicted_prefixes.popleft().hex())
             except IndexError:
                 break
+        # v2 warm set: the hottest prefix pages by replica-side hit
+        # count, as [chain_hash_hex, hits] pairs. The dict is mutated on
+        # the scheduler thread; a resize mid-iteration raises
+        # RuntimeError, in which case this poll ships an empty warm set
+        # (advisory — the next poll catches up)
+        try:
+            counts = list(self._prefix_hit_counts.items())
+        except RuntimeError:
+            counts = []
+        counts.sort(key=lambda kv: -kv[1])
+        warm = [[h.hex(), int(n)] for h, n in counts[:256]]
         paged = self._paged
         return {
             "queue_depth": self._queue.qsize() + len(self._backlog),
@@ -576,7 +601,30 @@ class InferenceServer:
             "speculate_k": self._spec_k,
             "spec_accept_per_step": self.spec_accept_per_step,
             "evicted_prefixes": evicted,
+            "warm_prefixes": warm,
+            "prefix_entries": len(self._prefix_map),
         }
+
+    # dfcheck: payload payload=hedge_cancel -> hedge_cancel_ack
+    def _on_hedge_cancel(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Cancel the LOSING attempt of a hedged request (round 19): flag
+        every in-flight admission carrying this request_id so it is
+        skipped at the backlog head or retired at the next decode-chunk
+        boundary — the same cancel path a client disconnect takes.
+        Correctness never depends on this ack: the dedup/in-flight gate
+        already guarantees at-most-one compute per replica; cancelling
+        just stops a lost race from finishing a result nobody reads."""
+        rid = str(payload.get("request_id"))
+        cancelled = 0
+        with self._inflight_lock:
+            for reqs in self._inflight.values():
+                for req in reqs:
+                    if req.request_id == rid and not req.cancelled:
+                        req.cancelled = True
+                        cancelled += 1
+        if cancelled:
+            self._m_hedge_cancelled.inc(cancelled)
+        return {"request_id": rid, "cancelled": cancelled}
 
     # dfcheck: payload payload=generate_request -> generate_ack
     def _on_generate(self, client_id: str, payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -602,12 +650,14 @@ class InferenceServer:
             cached = self._req_results.get(rid)
             if cached is not None:
                 self._req_results.move_to_end(rid)
+                self._m_dedup_hits.inc()
                 return cached
             gate = self._req_live.get(rid)
             if gate is None and not self._draining:
                 self._req_live[rid] = threading.Event()
         if gate is not None:
             # duplicate of an in-flight request: ride the original
+            self._m_dedup_hits.inc()
             gate.wait(timeout=600.0)
             with self._dedup_lock:
                 cached = self._req_results.get(rid)
@@ -835,6 +885,7 @@ class InferenceServer:
         while shortfall > 0 and self._prefix_map:
             _h, pg = self._prefix_map.popitem(last=False)
             self._evicted_prefixes.append(_h)
+            self._prefix_hit_counts.pop(_h, None)
             shortfall -= self._pool.unref([pg])
 
     # dfcheck: pairs acquire=_reserve release=_release_plan|_retire_slot counter=_m_pages_freed mode=state
@@ -873,6 +924,11 @@ class InferenceServer:
                 self._m_prefix_hits.inc()
                 self._m_prefix_tokens.inc(
                     len(plan["shared"]) * self.serving.page_size)
+                # round-19 warm-set counters: every chain hash this row
+                # reused gets a hit (scheduler thread, single writer)
+                for hj in plan["hashes"][:len(plan["shared"])]:
+                    self._prefix_hit_counts[hj] = (
+                        self._prefix_hit_counts.get(hj, 0) + 1)
             self._m_pages_alloc.inc(
                 len(plan["shared"]) + len(plan["owned"]) + len(plan["draft"]))
         req.page_plan = plans
@@ -1473,6 +1529,7 @@ class InferenceServer:
             while self._prefix_map:
                 _h, pg = self._prefix_map.popitem(last=False)
                 self._evicted_prefixes.append(_h)
+                self._prefix_hit_counts.pop(_h, None)
                 freed += self._pool.unref([pg])
             self._note_occupancy()
             self.verify_pool_conservation("release_prefix_cache")

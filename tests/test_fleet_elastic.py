@@ -1,0 +1,564 @@
+"""Elastic serving fleet (round 19; docs/ROBUSTNESS.md §11).
+
+Pins the churn-correctness contracts the elastic plane makes:
+
+- the consistent ring remaps at most ``1/N + slack`` of the key space on
+  a single join or leave (property test over memberships N=2..8), moved
+  keys transfer ONLY to the joiner / away from the leaver, and a
+  leave+rejoin restores the identical assignment — placement is a pure
+  function of membership;
+- under ``policy="ring"`` a replica killed mid-decode fails over to the
+  next arc owner with bit-identical outputs, the ring membership log
+  records the leave, the probation re-probe revives it on the next
+  poll (``router_replica_revivals_total``), and the whole churn episode
+  assembles into one trace round per request with ZERO orphan spans;
+- a hedged duplicate (same request_id raced against the second arc
+  owner) is suppressed exactly once: the loser is flagged by
+  ``hedge_cancel`` and retired unadmitted, counters reconcile
+  (cancellations across the fleet == hedges fired), and the dedup gate
+  never sees a same-replica duplicate;
+- probation backoff doubles with +/-50% jitter up to the cap, and only
+  a dead replica that had SERVED before counts as a revival;
+- the ``FleetAutoscaler`` scales out on a sustained latency breach
+  (warm-standby undrain first, cold address dial second), refuses to
+  flap inside its cooldown, scales in the coldest arc only after a
+  clean-idle streak, and scales out again on a shed-counter delta;
+- a fresh router rebuilds a replica's warm shadow map from the
+  ``fleet_stats`` v2 ``warm_prefixes`` hit counters alone.
+
+Tiny CPU transformer; deliberately NOT in conftest's slow set — tier-1
+exercises the elastic path every run.
+"""
+
+import math
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distriflow_tpu.client import InferenceClient, RequestShed
+from distriflow_tpu.comm.transport import FaultPlan, ScriptedFault
+from distriflow_tpu.fleet import (
+    FleetAutoscaler,
+    FleetRouter,
+    HashRing,
+    RouterClient,
+    page_hashes,
+)
+from distriflow_tpu.fleet.registry import PROBE_BASE_S, PROBE_MAX_S, ReplicaRegistry
+from distriflow_tpu.models.generate import generate
+from distriflow_tpu.models.transformer import TransformerConfig, transformer_lm
+from distriflow_tpu.obs.telemetry import Telemetry
+from distriflow_tpu.obs.trace_assembler import assemble
+from distriflow_tpu.server import InferenceServer
+from distriflow_tpu.utils.config import ServingConfig
+
+pytestmark = [pytest.mark.fleetserve, pytest.mark.elastic]
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=48,
+    dtype=jnp.float32, use_flash_attention=False,
+)
+PS = 16  # 3 pages per slot
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer_lm(CFG, example_seq=16).init(jax.random.PRNGKey(0))
+
+
+def _replica(params, telemetry, **serving_kw):
+    kw = dict(batch_window_s=0.05, decode_chunk=4, kv_layout="paged",
+              page_size=PS, max_slots=2, page_pool_pages=24)
+    kw.update(serving_kw)
+    return InferenceServer(CFG, params, port=0, telemetry=telemetry,
+                           serving=ServingConfig(**kw)).setup()
+
+
+@pytest.fixture()
+def fleet(params):
+    """Two paged replicas with PRIVATE telemetry registries plus a
+    router factory (the test_fleet_router idiom)."""
+    tel_a, tel_b = Telemetry(), Telemetry()
+    sa = _replica(params, tel_a)
+    sb = _replica(params, tel_b)
+    made = []
+
+    def mk_router(**kw):
+        plan_a = kw.pop("fault_plan_a", None)
+        kw.setdefault("stats_interval_s", 0.0)  # tests drive refresh_stats
+        kw.setdefault("redial", False)
+        kw.setdefault("telemetry", Telemetry())
+        router = FleetRouter(port=0, **kw)
+        router.add_replica(sa.address, name="A", fault_plan=plan_a)
+        router.add_replica(sb.address, name="B")
+        made.append(router)
+        return router.setup()
+
+    yield sa, sb, tel_a, tel_b, mk_router
+    for router in made:
+        router.stop()
+    sa.stop()
+    sb.stop()
+
+
+@pytest.fixture()
+def trio(params, tmp_path):
+    """Three replicas sharing ONE telemetry (so cross-endpoint spans
+    land in one tracer — the orphan-round audit needs the whole story)
+    plus a router factory on the same registry."""
+    tel = Telemetry(save_dir=str(tmp_path))
+    servers = [_replica(params, tel) for _ in range(3)]
+    made = []
+
+    def mk_router(**kw):
+        plan_a = kw.pop("fault_plan_a", None)
+        kw.setdefault("stats_interval_s", 0.0)
+        kw.setdefault("redial", False)
+        kw.setdefault("telemetry", tel)
+        router = FleetRouter(port=0, **kw)
+        router.add_replica(servers[0].address, name="A", fault_plan=plan_a)
+        router.add_replica(servers[1].address, name="B")
+        router.add_replica(servers[2].address, name="C")
+        made.append(router)
+        return router.setup()
+
+    yield servers, tel, mk_router
+    for router in made:
+        router.stop()
+    for s in servers:
+        s.stop()
+
+
+def _prompt(seed, plen=33, batch=1):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, CFG.vocab_size, size=(batch, plen)).astype(np.int32)
+
+
+def _solo(params, prompt, n):
+    return np.asarray(generate(CFG, dict(params), prompt, n))
+
+
+def _owned_prompt(ring, owner, plen=33, start_seed=0):
+    """A prompt whose FIRST chain hash the ring places on ``owner`` —
+    ring placement is deterministic, so seed-search is too."""
+    for seed in range(start_seed, start_seed + 4096):
+        p = _prompt(seed, plen=plen)
+        if ring.primary(page_hashes(p[0], PS)[0]) == owner:
+            return p
+    raise AssertionError(f"no prompt owned by {owner} in 4096 seeds")
+
+
+# -- the ring itself (pure arithmetic, no servers) -------------------------
+
+
+def test_ring_remap_bound_on_join_and_leave():
+    """Single join/leave moves at most ``1/N_after + slack`` of the key
+    space (slack = 0.5/sqrt(vnodes), ~4 sigma of the arc-share spread),
+    moved keys transfer ONLY to the joiner / away from the leaver, and
+    removing the joiner restores the base assignment EXACTLY."""
+    keys = [f"chain-hash-{i}".encode() for i in range(1500)]
+    for n in range(2, 9):
+        ring = HashRing()
+        for i in range(n):
+            ring.add(f"m{i}")
+        slack = 0.5 / math.sqrt(ring.vnodes)
+        base = ring.assignment(keys)
+        epoch0 = ring.epoch
+
+        ring.add("joiner")
+        assert ring.epoch == epoch0 + 1
+        after_join = ring.assignment(keys)
+        moved = [k for k in keys if after_join[k] != base[k]]
+        assert len(moved) / len(keys) <= 1.0 / (n + 1) + slack, (
+            f"N={n} join moved {len(moved) / len(keys):.3f}")
+        assert all(after_join[k] == "joiner" for k in moved)
+
+        assert ring.remove("joiner")
+        assert ring.assignment(keys) == base  # pure function of membership
+
+        assert ring.remove("m0")
+        after_leave = ring.assignment(keys)
+        moved = [k for k in keys if after_leave[k] != base[k]]
+        assert len(moved) / len(keys) <= 1.0 / n + slack, (
+            f"N={n} leave moved {len(moved) / len(keys):.3f}")
+        assert all(base[k] == "m0" for k in moved)  # only the lost arcs
+
+
+def test_ring_invariants():
+    """Arc shares partition the key space; lookup returns distinct
+    owners in arc order; sync() is a set-diff (survivors' points never
+    move); duplicate add/remove are idempotent no-ops."""
+    ring = HashRing()
+    for nm in ("A", "B", "C"):
+        ring.add(nm)
+    assert math.isclose(sum(ring.arc_share(n) for n in ring.members()), 1.0)
+    assert ring.arc_share("ghost") == 0.0
+    key = b"some-chain-hash"
+    owners = ring.lookup(key, n=3)
+    assert sorted(owners) == ["A", "B", "C"]  # distinct, all members
+    assert ring.primary(key) == owners[0]
+    assert ring.lookup(key, n=99) == owners  # capped at membership
+
+    keys = [f"k{i}".encode() for i in range(400)]
+    base = ring.assignment(keys)
+    epoch0 = ring.epoch
+    assert not ring.add("A")  # idempotent re-add
+    assert not ring.remove("ghost")
+    assert ring.epoch == epoch0
+    assert ring.sync(["A", "B", "C", "D"])  # one join via sync
+    survivors = {k: v for k, v in base.items()
+                 if ring.assignment([k])[k] != "D"}
+    assert all(ring.primary(k) == base[k] for k in survivors)
+    assert not ring.sync(["A", "B", "C", "D"])  # no-op sync
+
+    solo = HashRing(vnodes=8)
+    solo.add("only")
+    assert solo.arc_share("only") == 1.0
+    assert solo.lookup(b"x") == ["only"]
+    empty = HashRing()
+    assert empty.lookup(b"x") == []
+    with pytest.raises(LookupError):
+        empty.primary(b"x")
+
+
+# -- ring placement through the router -------------------------------------
+
+
+def test_ring_policy_routes_to_arc_owner(fleet, params):
+    """``policy="ring"``: every request lands on its first chain hash's
+    arc owner, bit-identical to solo; the snapshot exposes the ring and
+    the membership log carries epoch-ordered join events."""
+    _sa, _sb, _ta, _tb, mk_router = fleet
+    router = mk_router(policy="ring")
+    with RouterClient(router.address) as c:
+        for owner in ("A", "B"):
+            p = _owned_prompt(router.ring, owner)
+            out = c.generate(p, 4)
+            assert c.last_replica == owner
+            assert np.array_equal(out, _solo(params, p, 4))
+    snap = router._on_snapshot("t", {})
+    assert snap["ring"]["members"] == ["A", "B"]
+    assert snap["ring"]["epoch"] == router.ring.epoch
+    assert math.isclose(sum(snap["ring"]["arc_share"].values()), 1.0)
+    log = router.ring_membership()
+    joins = [e for e in log if e["event"] == "join"]
+    assert [e["replica"] for e in joins] == ["A", "B"]
+    assert [e["epoch"] for e in log] == sorted(e["epoch"] for e in log)
+
+
+def test_ring_churn_kill_rejoin_bit_identical_zero_orphans(trio, params):
+    """The chaos-churn proof: a scripted reset kills the arc owner
+    mid-decode; both in-flight requests fail over to the NEXT arc owner
+    with bit-identical outputs; the ring drops the dead member; the
+    probation re-probe revives it on the next poll (counted once) and
+    its arcs come back; and the whole episode assembles into one trace
+    round per request_id with zero orphan spans."""
+    servers, tel, mk_router = trio
+    plan = FaultPlan(seed=13, schedule=[
+        ScriptedFault(event="generate", nth=3, action="reset")])
+    router = mk_router(policy="ring", fault_plan_a=plan, redial=True)
+    p_warm = _owned_prompt(router.ring, "A")
+    p_long = _owned_prompt(router.ring, "A", plen=17)
+    base_assign = None
+    with RouterClient(router.address, telemetry=tel) as c:
+        out = c.generate(p_warm, 3)  # 1st on A
+        assert c.last_replica == "A"
+        assert np.array_equal(out, _solo(params, p_warm, 3))
+        router.refresh_stats()  # A serves stats: a later dial is a REVIVAL
+        base_assign = dict(router.ring.assignment(
+            [page_hashes(p_warm[0], PS)[0], page_hashes(p_long[0], PS)[0]]))
+        results = {}
+
+        def long_decode():
+            with RouterClient(router.address, telemetry=tel) as cl:
+                results["long"] = (cl.generate(p_long, 31, seed=0),
+                                   cl.last_route)
+
+        t = threading.Thread(target=long_decode)
+        t.start()
+        sa = servers[0]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:  # wait until A is mid-decode
+            if any(r is not None for r in sa._slot_req):
+                break
+            time.sleep(0.002)
+        # 3rd generate on A: the scripted reset tears the connection out
+        # from under the in-flight long decode too
+        out = c.generate(p_warm, 5)
+        t.join(timeout=120.0)
+        assert not t.is_alive()
+        assert c.last_replica != "A" and c.last_route["failovers"] >= 1
+        assert np.array_equal(out, _solo(params, p_warm, 5))
+        long_out, long_route = results["long"]
+        assert long_route["replica"] != "A"
+        assert np.array_equal(long_out, _solo(params, p_long, 31))
+
+        # membership: the ring dropped A and logged the leave
+        assert "A" not in router.ring
+        assert router.ring.members() == ["B", "C"]
+        leaves = [e for e in router.ring_membership() if e["event"] == "leave"]
+        assert leaves and leaves[-1]["replica"] == "A"
+
+        # probation revival: the next poll re-dials A (probe due
+        # immediately after death), restores its arcs, and counts ONE
+        # revival — placement returns to the pre-churn assignment
+        router.refresh_stats()
+        assert "A" in router.ring and router.ring.members() == ["A", "B", "C"]
+        assert router.registry.get("A").revivals == 1
+        assert tel.counter_value("router_replica_revivals_total") == 1.0
+        assert dict(router.ring.assignment(list(base_assign))) == base_assign
+        out = c.generate(p_warm, 4)  # 1st on the NEW connection: no fault
+        assert c.last_replica == "A"
+        assert np.array_equal(out, _solo(params, p_warm, 4))
+
+    asm = assemble(tel.tracer.finished())
+    assert asm.orphans == []  # churn leaked zero spans
+    reqs = asm.requests()
+    assert len(reqs) == 4  # warm, long, failover, post-revival
+    assert len({r.attrs["request_id"] for r in reqs}) == 4
+    for r in reqs:
+        assert r.applied and r.apply_spans == 1  # exactly-once commit
+    failed_over = [r for r in reqs if r.retries >= 1]
+    assert len(failed_over) == 2  # the killed generate + the long decode
+
+
+# -- tail hedging -----------------------------------------------------------
+
+
+def test_hedge_duplicate_suppressed_exactly_once(params):
+    """Tier-0 hedging against a deterministic straggler: the arc owner
+    runs a 250 ms admission window (its engine collects the batch that
+    long before first dispatch), so the 25 ms watermark fires ONE
+    hedged duplicate at the second arc owner, which wins. The loser's
+    queued admission is flagged by ``hedge_cancel`` long before its
+    window closes and retires UNADMITTED; counters reconcile —
+    cancellations across the fleet == hedges fired — and the dedup gate
+    never fires during the race (each replica saw the request_id once)
+    but suppresses a same-replica replay of the winning id exactly
+    once."""
+    tel_a, tel_b = Telemetry(), Telemetry()
+    sa = _replica(params, tel_a, batch_window_s=0.25)  # the straggler
+    sb = _replica(params, tel_b)
+    router = FleetRouter(port=0, policy="ring", stats_interval_s=0.0,
+                         redial=False, telemetry=Telemetry(),
+                         hedge_ms={0: 25.0})
+    try:
+        router.add_replica(sa.address, name="A")
+        router.add_replica(sb.address, name="B")
+        router.setup()
+        p = _owned_prompt(router.ring, "A")
+        order = router.ring.lookup(page_hashes(p[0], PS)[0], n=2)
+        assert order == ["A", "B"]  # primary, then the hedge target
+        # pre-compile B's decode path for p's shape so the race below is
+        # decided by the straggler window, not a one-off XLA compile
+        with InferenceClient(sb.address) as cl:
+            cl.generate(_prompt(999), 3)
+        admitted_a, admitted_b = sa.batched_requests, sb.batched_requests
+
+        with RouterClient(router.address, tier=0) as c:
+            out = c.generate(p, 3, request_id="hedge-1")
+            assert np.array_equal(out, _solo(params, p, 3))
+            assert c.last_replica == "B"  # the hedged duplicate won
+
+        rtel = router._tel
+        assert rtel.counter_value("router_hedges_total") == 1.0
+        assert rtel.counter_value("router_hedge_wins_total") == 1.0
+        # exactly-once suppression: the losing attempt (primary A) was
+        # flagged while queued inside its admission window and retired
+        # without EVER reaching the engine
+        assert (tel_a.counter_value("serving_hedge_cancelled_total")
+                == rtel.counter_value("router_hedges_total"))
+        assert sa.batched_requests - admitted_a == 0  # never admitted
+        assert sb.batched_requests - admitted_b == 1  # the winner, once
+        # the dedup gate never fired: each replica saw the id ONCE
+        assert tel_a.counter_value("serving_dedup_hits_total") == 0.0
+        assert tel_b.counter_value("serving_dedup_hits_total") == 0.0
+
+        # the same gate suppresses a same-replica duplicate: replay the
+        # WINNING request_id against B — cached ack, identical bits, no
+        # new admission, dedup counter moves by exactly one
+        with InferenceClient(sb.address) as direct:
+            again = direct.generate(p, 3, request_id="hedge-1")
+            assert np.array_equal(again, out)
+            assert sb.batched_requests - admitted_b == 1  # still once
+        assert tel_b.counter_value("serving_dedup_hits_total") == 1.0
+    finally:
+        router.stop()
+        sa.stop()
+        sb.stop()
+
+
+# -- probation backoff -------------------------------------------------------
+
+
+def test_probation_backoff_doubles_with_jitter():
+    """Registry-level probation contract: first probe due immediately,
+    each failure doubles the backoff (capped) with +/-50% jitter, and
+    only a replica that had SERVED counts as a revival."""
+    reg = ReplicaRegistry()
+    reg.add("A", "127.0.0.1:0")
+    reg.mark_live("A")
+    reg.mark_dead("A")
+    assert reg.probe_due("A")  # probe_at stays in the past
+
+    expect = PROBE_BASE_S
+    for _ in range(8):
+        before = time.monotonic()
+        reg.note_probe_failure("A")
+        r = reg.get("A")
+        assert r.probe_backoff_s == expect
+        delay = r.probe_at - before
+        assert 0.5 * expect <= delay <= 1.5 * expect + 0.01
+        assert not reg.probe_due("A")  # jitter floor is 0.25 s
+        expect = min(PROBE_MAX_S, expect * 2.0)
+    assert reg.get("A").probe_backoff_s == PROBE_MAX_S  # capped
+
+    # a dial that lands before any stats is a JOIN, not a revival
+    assert reg.mark_live("A") is False
+    assert reg.get("A").revivals == 0
+    assert reg.get("A").probe_backoff_s == 0.0  # backoff reset either way
+    reg.update_stats("A", {"queue_depth": 0})
+    reg.mark_dead("A")
+    assert reg.mark_live("A") is True  # served before: a real revival
+    assert reg.get("A").revivals == 1
+    assert reg.probe_due("A") is False  # alive is never 'due'
+
+
+# -- the autoscaler ----------------------------------------------------------
+
+
+class _StubSentinel:
+    """Scripted sentinel: the autoscaler only calls ``check()``."""
+
+    def __init__(self):
+        self.hits = []
+
+    def check(self):
+        return list(self.hits)
+
+
+_TTFT_HIT = {"band": "ttft_p99_tier0", "kind": "sustained", "observed": 480.0}
+
+
+def test_autoscaler_scale_out_cooldown_scale_in_shed(fleet, params):
+    """One full control cycle: sustained-breach scale-out undrains the
+    warm standby; the cooldown refuses to act again; a clean-idle
+    streak drains the COLDEST arc back out; and a shed-counter delta
+    scales out again — membership moves one replica per poll, never
+    inside a cooldown."""
+    _sa, _sb, _ta, _tb, mk_router = fleet
+    router = mk_router(policy="ring", shed_depth={2: -1})
+    with RouterClient(router.address) as c:
+        p = _owned_prompt(router.ring, "A")
+        c.generate(p, 3)
+        c.generate(p, 3)  # shared-prefix hit: A reports warm_prefixes
+    router.refresh_stats()  # fold prefix_entries/warm_prefixes stats in
+    assert router.drain_replica("B")  # B becomes the warm standby
+    stub = _StubSentinel()
+    scaler = FleetAutoscaler(router, stub, min_replicas=1,
+                             cooldown_checks=2, scale_in_clean_checks=2)
+    rtel = router._tel
+
+    # sustained TTFT breach -> undrain the warm standby
+    stub.hits = [dict(_TTFT_HIT)]
+    acts = scaler.step()
+    assert [a["action"] for a in acts] == ["scale_out"]
+    assert acts[0]["band"] == "ttft_p99_tier0" and acts[0]["via"] == "undrain"
+    assert acts[0]["replica"] == "B" and acts[0]["observed"] == 480.0
+    assert not router.registry.get("B").draining
+    assert router.ring.members() == ["A", "B"]
+    assert rtel.counter_value("autoscaler_scale_out_total") == 1.0
+
+    # hysteresis: the breach persists but the cooldown only observes
+    assert scaler.step() == [] and scaler.step() == []
+    stub.hits = []  # breach clears; cooldown has now expired
+
+    # clean-idle streak -> scale-in the coldest arc (B: zero prefix
+    # entries vs A's warm set)
+    router.refresh_stats()
+    assert router.registry.get("A").stat("prefix_entries", 0) > 0
+    assert scaler.step() == []  # streak 1 of 2
+    acts = scaler.step()
+    assert [a["action"] for a in acts] == ["scale_in"]
+    assert acts[0]["replica"] == "B" and acts[0]["band"] == "idle"
+    assert router.registry.get("B").draining
+    assert router.ring.members() == ["A"]
+    assert rtel.counter_value("autoscaler_scale_in_total") == 1.0
+    assert scaler.step() == [] and scaler.step() == []  # cooldown again
+
+    # shed delta (capacity refusal) -> scale out the standby we just
+    # made; min_replicas floor protects the last live replica meanwhile
+    with RouterClient(router.address, tier=2, shed_retries=0) as c:
+        with pytest.raises(RequestShed):
+            c.generate(_prompt(7), 3)  # depth threshold -1 always sheds
+    acts = scaler.step()
+    assert [a["action"] for a in acts] == ["scale_out"]
+    assert acts[0]["band"].startswith("shed_delta:")
+    assert not router.registry.get("B").draining
+    assert len(scaler.actions()) == 3
+
+
+def test_autoscaler_cold_standby_and_bad_address(fleet):
+    """Cold-path scale-out dials a standby ADDRESS into the fleet; a
+    dead address is rolled back without recording an action (the breach
+    stays visible for the next poll)."""
+    sa, sb, _ta, _tb, _mk = fleet
+    tel = Telemetry()
+    router = FleetRouter(port=0, policy="ring", stats_interval_s=0.0,
+                         redial=False, telemetry=tel)
+    try:
+        router.add_replica(sa.address, name="A")
+        router.setup()
+        stub = _StubSentinel()
+        stub.hits = [dict(_TTFT_HIT)]
+        scaler = FleetAutoscaler(
+            router, stub, standbys=["127.0.0.1:9", sb.address],
+            cooldown_checks=0, max_replicas=2)
+        assert scaler.step() == []  # dead address: rolled back, no action
+        assert len(router.registry.all()) == 1
+        acts = scaler.step()  # next poll tries the next standby
+        assert [a["action"] for a in acts] == ["scale_out"]
+        assert acts[0]["via"] == "add"
+        assert router.registry.live_count() == 2
+        assert len(router.ring) == 2
+        assert scaler.standbys == []
+        # max_replicas cap: the breach persists but the fleet is full
+        assert scaler.step() == []
+    finally:
+        router.stop()
+
+
+# -- warm-set rebuild from fleet_stats v2 ------------------------------------
+
+
+def test_shadow_rebuilt_from_warm_prefixes(fleet, params):
+    """A FRESH router (empty shadow maps) learns a replica's warm set
+    from the ``fleet_stats`` v2 ``warm_prefixes`` hit counters on its
+    first poll — affinity warmth survives a router restart."""
+    sa, _sb, _ta, _tb, mk_router = fleet
+    router1 = mk_router(policy="ring")
+    p = _owned_prompt(router1.ring, "A")
+    with RouterClient(router1.address) as c:
+        c.generate(p, 3)
+        c.generate(p, 3)  # the re-use is what makes the prefixes WARM
+    hashes = page_hashes(p[0], PS)
+
+    tel2 = Telemetry()
+    router2 = FleetRouter(port=0, policy="ring", stats_interval_s=0.0,
+                          redial=False, telemetry=tel2)
+    try:
+        router2.add_replica(sa.address, name="A")
+        r = router2.registry.get("A")
+        assert not r.shadow  # fresh router: cold shadow
+        router2.refresh_stats()
+        assert r.shadow  # rebuilt from warm_prefixes, not learn()
+        assert router2.registry.warmth("A", hashes) > 0
+        assert r.stat("prefix_entries", 0) > 0
+        reported = {bytes.fromhex(h) for h, _ in r.stat("warm_prefixes")}
+        assert set(r.shadow) <= reported  # replica truth, nothing else
+    finally:
+        router2.stop()
